@@ -1,0 +1,145 @@
+"""ray_tpu: a TPU-native distributed ML framework.
+
+Public API mirrors the reference's surface
+(ray: python/ray/_private/worker.py -- init :1043, shutdown :1600,
+ get :2263, put :2410, wait :2472, kill :2629 area, remote :2629) while the
+implementation is built TPU-first (see SURVEY.md section 7): JAX/XLA programs over
+device meshes do the compute; this runtime schedules host processes, owns
+objects, and orchestrates multi-host SPMD.
+
+Importing ray_tpu must stay light: no jax import happens until you touch
+ray_tpu.parallel / models / train / ops.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu import exceptions
+from ray_tpu._private.client import client
+from ray_tpu._private.refs import ObjectRef
+from ray_tpu.actor import ActorClass, ActorHandle, exit_actor, get_actor
+from ray_tpu.remote_function import RemoteFunction, remote
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "cancel",
+    "get_actor",
+    "exit_actor",
+    "ObjectRef",
+    "ActorClass",
+    "ActorHandle",
+    "RemoteFunction",
+    "available_resources",
+    "cluster_resources",
+    "exceptions",
+    "nodes",
+]
+
+
+def init(
+    num_cpus: Optional[int] = None,
+    resources: Optional[Dict[str, float]] = None,
+    namespace: str = "default",
+    ignore_reinit_error: bool = False,
+    **_unused,
+):
+    """Start the per-host runtime (driver mode).
+
+    Inside a worker process this is a no-op (the worker is already connected),
+    matching the reference's behavior for nested init.
+    """
+    from ray_tpu._private import runtime as rt
+    from ray_tpu._private.worker_proc import get_worker_runtime
+
+    if get_worker_runtime() is not None:
+        return
+    if rt.is_initialized():
+        if ignore_reinit_error:
+            return
+        raise RuntimeError("ray_tpu.init() called twice (pass ignore_reinit_error=True)")
+    rt.init_runtime(num_cpus=num_cpus, resources=resources, namespace=namespace)
+
+
+def shutdown():
+    from ray_tpu._private import runtime as rt
+
+    rt.shutdown_runtime()
+
+
+def is_initialized() -> bool:
+    from ray_tpu._private import runtime as rt
+    from ray_tpu._private.worker_proc import get_worker_runtime
+
+    return rt.is_initialized() or get_worker_runtime() is not None
+
+
+def _auto_init():
+    from ray_tpu._private import runtime as rt
+    from ray_tpu._private.worker_proc import get_worker_runtime
+
+    if not rt.is_initialized() and get_worker_runtime() is None:
+        init()
+
+
+def get(refs, *, timeout: Optional[float] = None):
+    _auto_init()
+    return client.get(refs, timeout)
+
+
+def put(value: Any) -> ObjectRef:
+    _auto_init()
+    return client.put(value)
+
+
+def wait(refs, *, num_returns: int = 1, timeout: Optional[float] = None, fetch_local=True):
+    _auto_init()
+    if not isinstance(refs, list):
+        raise TypeError("ray_tpu.wait() expects a list of ObjectRefs")
+    if num_returns > len(refs):
+        raise ValueError("num_returns exceeds number of refs")
+    return client.wait(refs, num_returns, timeout, fetch_local)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    client.kill_actor(actor._id, no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False):
+    client.cancel(ref, force)
+
+
+def available_resources() -> Dict[str, float]:
+    _auto_init()
+    return client.available_resources()
+
+
+def cluster_resources() -> Dict[str, float]:
+    _auto_init()
+    return client.cluster_resources()
+
+
+def nodes():
+    """List cluster nodes (ray: ray.nodes())."""
+    from ray_tpu._private.runtime import get_runtime
+
+    rt = get_runtime()
+    return [
+        {
+            "NodeID": n.node_id,
+            "Alive": n.alive,
+            "Resources": dict(n.resources),
+            "Available": dict(n.available),
+            "IsHead": n.is_head,
+        }
+        for n in rt.state.nodes.values()
+    ]

@@ -1,0 +1,241 @@
+"""Core client: routes API calls to the driver Runtime or, inside a worker
+process, over the control connection to the owner.
+
+Mirrors the split in the reference where both drivers and workers link the
+same CoreWorker library (ray: src/ray/core_worker/core_worker_process.h) and
+the Python API is mode-agnostic (ray: python/ray/_private/worker.py:404).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private import serialization as ser
+from ray_tpu._private.refs import ObjectRef
+from ray_tpu._private.task_spec import TaskSpec
+from ray_tpu.exceptions import GetTimeoutError
+
+
+def in_worker() -> bool:
+    from ray_tpu._private import worker_proc
+
+    return worker_proc.get_worker_runtime() is not None
+
+
+def current_session() -> Optional[str]:
+    """Session name of the active runtime (None if not initialized).
+
+    Used to invalidate per-process caches (exported functions) across
+    init/shutdown cycles, like the reference's per-job function table
+    (ray: python/ray/_private/function_manager.py keyed by job id).
+    """
+    from ray_tpu._private import runtime as rt
+    from ray_tpu._private.worker_proc import get_worker_runtime
+
+    wr = get_worker_runtime()
+    if wr is not None:
+        return wr.session_name
+    if rt.is_initialized():
+        return rt.get_runtime().session_name
+    return None
+
+
+class CoreClient:
+    """Facade over either the in-process Runtime (driver) or the worker's
+    connection to it."""
+
+    # -- driver/worker dispatch ---------------------------------------------
+
+    def _rt(self):
+        from ray_tpu._private.runtime import get_runtime
+
+        return get_runtime()
+
+    def _wr(self):
+        from ray_tpu._private.worker_proc import get_worker_runtime
+
+        return get_worker_runtime()
+
+    # -- functions ----------------------------------------------------------
+
+    def export_function(self, fn_id: str, blob: bytes) -> None:
+        wr = self._wr()
+        if wr is not None:
+            wr.request("export_function", (fn_id, blob))
+        else:
+            self._rt().state.export_function(fn_id, blob)
+
+    # -- tasks ---------------------------------------------------------------
+
+    def submit(self, spec: TaskSpec) -> List[ObjectRef]:
+        wr = self._wr()
+        if wr is not None:
+            return_ids = wr.request("submit", spec)
+        else:
+            return_ids = self._rt().submit_task(spec)
+        return [ObjectRef(oid) for oid in return_ids]
+
+    def create_actor(self, spec: TaskSpec) -> str:
+        wr = self._wr()
+        if wr is not None:
+            return wr.request("create_actor", spec)
+        return self._rt().create_actor(spec)
+
+    def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        wr = self._wr()
+        if wr is not None:
+            return_ids = wr.request("actor_call", spec)
+        else:
+            return_ids = self._rt().submit_actor_task(spec)
+        return [ObjectRef(oid) for oid in return_ids]
+
+    # -- objects -------------------------------------------------------------
+
+    def put(self, value: Any) -> ObjectRef:
+        wr = self._wr()
+        if wr is not None:
+            oid = wr.put_value(value)
+            return ObjectRef(oid)
+        return self._rt().put(value)
+
+    def get(self, refs, timeout: Optional[float] = None):
+        wr = self._wr()
+        if wr is None:
+            return self._rt().get(refs, timeout)
+        single = isinstance(refs, ObjectRef)
+        if single:
+            refs = [refs]
+        values = []
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for r in refs:
+            t = None if deadline is None else max(0.0, deadline - time.monotonic())
+            try:
+                values.append(self._worker_get_one(wr, r.id, t))
+            except Exception:
+                raise
+        return values[0] if single else values
+
+    def _worker_get_one(self, wr, oid: str, timeout: Optional[float]):
+        import queue as _q
+
+        obj = wr.shm.get(oid)
+        if obj is not None:
+            return obj.deserialize(wr.ref_factory)
+        try:
+            kind, data = wr.request("get_object", oid, timeout=timeout)
+        except _q.Empty:
+            raise GetTimeoutError(f"get({oid}) timed out")
+        if kind == "shm":
+            obj = wr.shm.get(oid)
+            if obj is None:
+                from ray_tpu.exceptions import ObjectLostError
+
+                raise ObjectLostError(oid)
+            return obj.deserialize(wr.ref_factory)
+        payload, bufs = ser.unpack(memoryview(data))
+        return ser.deserialize(payload, bufs, wr.ref_factory)
+
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        wr = self._wr()
+        if wr is None:
+            return self._rt().wait_refs(refs, num_returns, timeout)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        delay = 0.001
+        while True:
+            flags = wr.request("check_ready", [r.id for r in refs])
+            ready = [r for r, f in zip(refs, flags) if f]
+            if len(ready) >= num_returns or (
+                deadline is not None and time.monotonic() >= deadline
+            ):
+                ready = ready[:num_returns] if len(ready) >= num_returns else ready
+                ready_set = {r.id for r in ready}
+                not_ready = [r for r in refs if r.id not in ready_set]
+                return ready, not_ready
+            time.sleep(delay)
+            delay = min(delay * 2, 0.05)
+
+    def cancel(self, ref: ObjectRef, force: bool = False) -> None:
+        wr = self._wr()
+        if wr is not None:
+            wr.request("cancel", (ref.id, force))
+        else:
+            self._rt().cancel(ref, force)
+
+    # -- actors --------------------------------------------------------------
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
+        wr = self._wr()
+        if wr is not None:
+            wr.request("kill_actor", (actor_id, no_restart))
+        else:
+            self._rt().kill_actor(actor_id, no_restart)
+
+    def get_named_actor(self, name: str, namespace: Optional[str]) -> Tuple[str, List[str]]:
+        wr = self._wr()
+        if wr is not None:
+            return wr.request("get_actor_named", (name, namespace))
+        rt = self._rt()
+        return rt._handle_req("driver", -1, "get_actor_named", (name, namespace))
+
+    # -- kv ------------------------------------------------------------------
+
+    def kv_put(self, key: str, value: bytes, namespace: str = "") -> None:
+        wr = self._wr()
+        if wr is not None:
+            wr.request("kv_put", (key, value, namespace))
+        else:
+            self._rt().state.kv_put(key, value, namespace)
+
+    def kv_get(self, key: str, namespace: str = "") -> Optional[bytes]:
+        wr = self._wr()
+        if wr is not None:
+            return wr.request("kv_get", (key, namespace))
+        return self._rt().state.kv_get(key, namespace)
+
+    # -- placement groups ----------------------------------------------------
+
+    def pg_create(self, bundles, strategy, name=None) -> str:
+        wr = self._wr()
+        if wr is not None:
+            return wr.request("pg_create", (bundles, strategy, name))
+        return self._rt().create_placement_group(bundles, strategy, name).pg_id
+
+    def pg_state(self, pg_id: str) -> Optional[str]:
+        wr = self._wr()
+        if wr is not None:
+            return wr.request("pg_state", pg_id)
+        pg = self._rt().state.placement_groups.get(pg_id)
+        return pg.state if pg else None
+
+    def pg_remove(self, pg_id: str) -> None:
+        wr = self._wr()
+        if wr is not None:
+            wr.request("pg_remove", pg_id)
+        else:
+            self._rt().remove_placement_group(pg_id)
+
+    # -- cluster -------------------------------------------------------------
+
+    def cluster_resources(self) -> Dict[str, float]:
+        wr = self._wr()
+        if wr is not None:
+            return wr.request("cluster_resources", None)
+        return self._rt().cluster_resources()
+
+    def available_resources(self) -> Dict[str, float]:
+        wr = self._wr()
+        if wr is not None:
+            return wr.request("available_resources", None)
+        return self._rt().available_resources()
+
+
+client = CoreClient()
+
+
+def build_args_blob(args: tuple, kwargs: dict):
+    """Serialize call args; returns (packed_blob, contained_ids, top_level_dep_ids)."""
+    payload, buffers, contained = ser.serialize((args, kwargs))
+    deps = [a.id for a in args if isinstance(a, ObjectRef)]
+    deps += [v.id for v in kwargs.values() if isinstance(v, ObjectRef)]
+    return bytes(ser.pack(payload, buffers)), contained, deps

@@ -1,0 +1,173 @@
+"""Global control state: node/actor/job/function/KV/placement-group tables.
+
+In-process analogue of the reference's GCS server
+(ray: src/ray/gcs/gcs_server/gcs_server.h:77) with the same table layout:
+  * NodeTable   -- ray: gcs_node_manager.h:41
+  * ActorTable  -- ray: gcs_actor_manager.h:280 (restart FSM at :258)
+  * FunctionTable -- ray: python/ray/_private/function_manager.py (fn exports)
+  * KV          -- ray: gcs_kv_manager.cc
+  * PlacementGroupTable -- ray: gcs_placement_group_manager.h:223
+
+The driver process hosts these tables; worker processes reach them through
+their connection to the driver (the "DCN control plane"). A future multi-host
+round promotes this object behind a gRPC service without changing callers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+# Actor lifecycle states (ray: gcs_actor_manager.h FSM)
+PENDING_CREATION = "PENDING_CREATION"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+
+@dataclass
+class NodeInfo:
+    node_id: str
+    resources: Dict[str, float]
+    available: Dict[str, float]
+    alive: bool = True
+    labels: Dict[str, str] = field(default_factory=dict)
+    is_head: bool = False
+
+
+@dataclass
+class ActorInfo:
+    actor_id: str
+    name: Optional[str]
+    state: str = PENDING_CREATION
+    node_id: Optional[str] = None
+    worker_id: Optional[str] = None
+    max_restarts: int = 0
+    num_restarts: int = 0
+    creation_spec: Any = None  # TaskSpec, kept for restarts
+    death_cause: Optional[str] = None
+    namespace: str = "default"
+
+
+@dataclass
+class PlacementGroupInfo:
+    pg_id: str
+    bundles: List[Dict[str, float]]
+    strategy: str
+    state: str = "PENDING"  # PENDING | CREATED | REMOVED
+    # bundle index -> node_id
+    bundle_nodes: Dict[int, str] = field(default_factory=dict)
+    # bundle index -> remaining capacity inside the reserved bundle
+    # (tasks scheduled into the PG consume bundle capacity, not node pool:
+    #  ray: src/ray/raylet/placement_group_resource_manager.h)
+    bundle_available: Dict[int, Dict[str, float]] = field(default_factory=dict)
+    name: Optional[str] = None
+
+
+class GlobalState:
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.actors: Dict[str, ActorInfo] = {}
+        self.named_actors: Dict[tuple, str] = {}  # (namespace, name) -> actor_id
+        self.functions: Dict[str, bytes] = {}
+        self.kv: Dict[str, Dict[str, bytes]] = {}  # namespace -> {key: val}
+        self.placement_groups: Dict[str, PlacementGroupInfo] = {}
+        self.job_start_time = time.time()
+        # pub/sub-lite: listeners on cluster events
+        # (ray: src/ray/pubsub/publisher.h:298 -- collapsed to callbacks since
+        # all subscribers are in-process today)
+        self._listeners: Dict[str, List[Callable]] = {}
+
+    # -- events --------------------------------------------------------------
+
+    def subscribe(self, channel: str, cb: Callable) -> None:
+        with self.lock:
+            self._listeners.setdefault(channel, []).append(cb)
+
+    def publish(self, channel: str, *args) -> None:
+        for cb in self._listeners.get(channel, []):
+            try:
+                cb(*args)
+            except Exception:
+                pass
+
+    # -- nodes ---------------------------------------------------------------
+
+    def register_node(self, info: NodeInfo) -> None:
+        with self.lock:
+            self.nodes[info.node_id] = info
+        self.publish("node_added", info.node_id)
+
+    def remove_node(self, node_id: str) -> None:
+        with self.lock:
+            n = self.nodes.get(node_id)
+            if n:
+                n.alive = False
+        self.publish("node_removed", node_id)
+
+    def alive_nodes(self) -> List[NodeInfo]:
+        with self.lock:
+            return [n for n in self.nodes.values() if n.alive]
+
+    # -- functions -----------------------------------------------------------
+
+    def export_function(self, fn_id: str, blob: bytes) -> None:
+        with self.lock:
+            self.functions[fn_id] = blob
+
+    def get_function(self, fn_id: str) -> Optional[bytes]:
+        with self.lock:
+            return self.functions.get(fn_id)
+
+    # -- actors --------------------------------------------------------------
+
+    def register_actor(self, info: ActorInfo) -> None:
+        with self.lock:
+            self.actors[info.actor_id] = info
+            if info.name:
+                key = (info.namespace, info.name)
+                if key in self.named_actors:
+                    raise ValueError(f"actor name {info.name!r} already taken")
+                self.named_actors[key] = info.actor_id
+
+    def get_actor(self, actor_id: str) -> Optional[ActorInfo]:
+        with self.lock:
+            return self.actors.get(actor_id)
+
+    def get_named_actor(self, name: str, namespace: str = "default") -> Optional[ActorInfo]:
+        with self.lock:
+            aid = self.named_actors.get((namespace, name))
+            return self.actors.get(aid) if aid else None
+
+    def set_actor_state(self, actor_id: str, state: str, **kw) -> None:
+        with self.lock:
+            a = self.actors.get(actor_id)
+            if not a:
+                return
+            a.state = state
+            for k, v in kw.items():
+                setattr(a, k, v)
+            if state == DEAD and a.name:
+                self.named_actors.pop((a.namespace, a.name), None)
+        self.publish("actor_state", actor_id, state)
+
+    # -- kv (ray: gcs_kv_manager.cc) ----------------------------------------
+
+    def kv_put(self, key: str, value: bytes, namespace: str = "") -> None:
+        with self.lock:
+            self.kv.setdefault(namespace, {})[key] = value
+
+    def kv_get(self, key: str, namespace: str = "") -> Optional[bytes]:
+        with self.lock:
+            return self.kv.get(namespace, {}).get(key)
+
+    def kv_del(self, key: str, namespace: str = "") -> None:
+        with self.lock:
+            self.kv.get(namespace, {}).pop(key, None)
+
+    def kv_keys(self, prefix: str = "", namespace: str = "") -> List[str]:
+        with self.lock:
+            return [k for k in self.kv.get(namespace, {}) if k.startswith(prefix)]

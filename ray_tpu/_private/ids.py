@@ -1,0 +1,50 @@
+"""Identifiers for tasks, objects, actors, nodes, and workers.
+
+TPU-native analogue of the reference's ID scheme (ray: src/ray/common/id.h).
+We keep the same conceptual structure -- an ObjectID embeds the ID of the task
+that produces it plus a return index, so ownership and lineage can be derived
+from the ID itself -- but use compact hex strings instead of 28-byte binary
+blobs since our control plane is in-process / DCN-gRPC, not a C++ hot path.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_lock = threading.Lock()
+_counter = 0
+
+
+def _fresh(prefix: str) -> str:
+    global _counter
+    with _lock:
+        _counter += 1
+        n = _counter
+    return f"{prefix}-{os.getpid():x}-{n:x}-{os.urandom(4).hex()}"
+
+
+def task_id() -> str:
+    return _fresh("t")
+
+
+def actor_id() -> str:
+    return _fresh("a")
+
+
+def object_id(producing_task: str | None = None, index: int = 0) -> str:
+    if producing_task is not None:
+        return f"o:{producing_task}:{index}"
+    return _fresh("o")
+
+
+def node_id() -> str:
+    return _fresh("n")
+
+
+def worker_id() -> str:
+    return _fresh("w")
+
+
+def placement_group_id() -> str:
+    return _fresh("pg")

@@ -1,0 +1,74 @@
+"""ObjectRef: a first-class future naming an immutable object in the store.
+
+Mirrors the semantics of the reference's ObjectRef/ObjectID
+(ray: python/ray/includes/object_ref.pxi, src/ray/common/id.h): the ref is
+ownership-aware (the driver/worker that created the producing task owns the
+value's lifetime metadata) and refcounted -- dropping the last Python reference
+releases the underlying object (ray: src/ray/core_worker/reference_count.h:61).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+# Process-local hook installed by the runtime so that ObjectRef GC can
+# decrement the owner-side reference count. Kept as a module global to avoid
+# import cycles.
+_release_hook: Optional[Callable[[str], None]] = None
+_addref_hook: Optional[Callable[[str], None]] = None
+
+
+def set_ref_hooks(addref, release) -> None:
+    global _release_hook, _addref_hook
+    _addref_hook = addref
+    _release_hook = release
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owner", "__weakref__")
+
+    def __init__(self, id: str, owner: str | None = None, *, _count: bool = True):
+        self._id = id
+        self._owner = owner
+        if _count and _addref_hook is not None:
+            _addref_hook(id)
+
+    def hex(self) -> str:
+        return self._id
+
+    @property
+    def id(self) -> str:
+        return self._id
+
+    @property
+    def owner(self) -> str | None:
+        return self._owner
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __hash__(self) -> int:
+        return hash(self._id)
+
+    def __repr__(self) -> str:
+        return f"ObjectRef({self._id})"
+
+    def __del__(self):
+        if _release_hook is not None:
+            try:
+                _release_hook(self._id)
+            except Exception:
+                pass
+
+    def __reduce__(self):
+        # Plain pickling (outside the runtime's serialization context) loses
+        # the refcount borrow; the runtime's SerializationContext intercepts
+        # ObjectRefs before pickle ever sees them (see serialization.py).
+        return (ObjectRef, (self._id, self._owner))
+
+    # Allow `await ref` when used inside async actors / serve replicas.
+    def __await__(self):
+        from ray_tpu._private.runtime import get_runtime
+
+        rt = get_runtime()
+        return rt.get_async(self).__await__()

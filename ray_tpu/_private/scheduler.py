@@ -1,0 +1,279 @@
+"""Resource scheduling: node selection policies + placement groups.
+
+Analogue of the reference's two-level scheduler
+(ray: src/ray/raylet/scheduling/cluster_resource_scheduler.h:44,
+ cluster_task_manager.h:42). Policies mirror
+ray: src/ray/raylet/scheduling/policy/:
+  * hybrid  (hybrid_scheduling_policy.h:50)  -- prefer the head/local node
+    until its utilization crosses a threshold, then least-utilized remote;
+  * SPREAD  (spread_scheduling_policy.h)     -- round-robin over feasible;
+  * node affinity (node_affinity_scheduling_policy.h);
+  * placement-group bundles (bundle_scheduling_policy.h) with
+    PACK/SPREAD/STRICT_PACK/STRICT_SPREAD -- and a TPU-native addition,
+    "MESH": bundles must land on hosts forming a contiguous ICI sub-mesh
+    (the reference has no topology-aware gang strategy; see SURVEY.md section 7).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._private.gcs import GlobalState, NodeInfo, PlacementGroupInfo
+from ray_tpu._private.task_spec import TaskSpec
+
+HYBRID_THRESHOLD = 0.5  # ray: RAY_scheduler_spread_threshold default
+
+
+def _feasible(node: NodeInfo, resources: Dict[str, float]) -> bool:
+    return all(node.resources.get(k, 0.0) >= v for k, v in resources.items())
+
+
+def _available(node: NodeInfo, resources: Dict[str, float]) -> bool:
+    return all(node.available.get(k, 0.0) >= v - 1e-9 for k, v in resources.items())
+
+
+def _utilization(node: NodeInfo) -> float:
+    fracs = [
+        1.0 - node.available.get(k, 0.0) / t
+        for k, t in node.resources.items()
+        if t > 0
+    ]
+    return max(fracs) if fracs else 0.0
+
+
+class Scheduler:
+    def __init__(self, state: GlobalState, head_node_id: str):
+        self.state = state
+        self.head_node_id = head_node_id
+        self._rr = itertools.count()
+        self.lock = threading.RLock()
+
+    # -- resource accounting -------------------------------------------------
+
+    def acquire(self, node_id: str, resources: Dict[str, float]) -> bool:
+        with self.lock:
+            node = self.state.nodes.get(node_id)
+            if node is None or not node.alive or not _available(node, resources):
+                return False
+            for k, v in resources.items():
+                node.available[k] = node.available.get(k, 0.0) - v
+            return True
+
+    def release(self, node_id: str, resources: Dict[str, float]) -> None:
+        with self.lock:
+            node = self.state.nodes.get(node_id)
+            if node is None:
+                return
+            for k, v in resources.items():
+                node.available[k] = min(
+                    node.available.get(k, 0.0) + v, node.resources.get(k, 0.0)
+                )
+
+    # -- node selection ------------------------------------------------------
+
+    def select_node(self, spec: TaskSpec) -> Optional[str]:
+        """Pick a node for the task; returns None if nothing can host it now.
+
+        Raises ValueError if no node in the cluster is even feasible
+        (infeasible task -- ray would park it and warn).
+        """
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy,
+        )
+
+        resources = dict(spec.resources)
+        strategy = spec.scheduling_strategy
+
+        if isinstance(strategy, NodeAffinitySchedulingStrategy):
+            with self.lock:
+                node = self.state.nodes.get(strategy.node_id)
+                if node is None or not node.alive:
+                    if strategy.soft:
+                        return self._hybrid(resources)
+                    raise ValueError(f"affinity node {strategy.node_id} is dead")
+                if _available(node, resources):
+                    return node.node_id
+                if strategy.soft:
+                    return self._hybrid(resources)
+                return None
+
+        if strategy == "SPREAD":
+            return self._spread(resources)
+        return self._hybrid(resources)
+
+    def _alive_feasible(self, resources) -> List[NodeInfo]:
+        nodes = [n for n in self.state.alive_nodes() if _feasible(n, resources)]
+        if not nodes:
+            raise ValueError(
+                f"no node is feasible for resources {resources}; cluster has "
+                f"{[{n.node_id: n.resources} for n in self.state.alive_nodes()]}"
+            )
+        return nodes
+
+    def _hybrid(self, resources) -> Optional[str]:
+        with self.lock:
+            nodes = self._alive_feasible(resources)
+            # Prefer head node while below threshold, like ray's hybrid policy
+            # prefers the local node (hybrid_scheduling_policy.h:50).
+            head = next((n for n in nodes if n.node_id == self.head_node_id), None)
+            if head and _available(head, resources) and _utilization(head) < HYBRID_THRESHOLD:
+                return head.node_id
+            avail = [n for n in nodes if _available(n, resources)]
+            if not avail:
+                return None
+            return min(avail, key=_utilization).node_id
+
+    def _spread(self, resources) -> Optional[str]:
+        with self.lock:
+            nodes = self._alive_feasible(resources)
+            avail = [n for n in nodes if _available(n, resources)]
+            if not avail:
+                return None
+            return avail[next(self._rr) % len(avail)].node_id
+
+    # -- placement groups ----------------------------------------------------
+
+    @staticmethod
+    def is_pg_task(spec: TaskSpec) -> bool:
+        from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+        return bool(spec.placement_group_id) or isinstance(
+            spec.scheduling_strategy, PlacementGroupSchedulingStrategy
+        )
+
+    def _pg_for_spec(self, spec: TaskSpec):
+        from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+        strategy = spec.scheduling_strategy
+        pg_id = spec.placement_group_id
+        bundle_index = spec.placement_group_bundle_index
+        if isinstance(strategy, PlacementGroupSchedulingStrategy):
+            pg_id = strategy.placement_group.id
+            bundle_index = strategy.placement_group_bundle_index
+        return pg_id, bundle_index
+
+    def select_pg(self, spec: TaskSpec, resources) -> Optional[Tuple[str, int]]:
+        """Pick (node, bundle) for a PG-scheduled task and acquire from the
+        bundle's reserved capacity. Returns None if nothing fits right now."""
+        pg_id, bundle_index = self._pg_for_spec(spec)
+        with self.lock:
+            pg = self.state.placement_groups.get(pg_id)
+            if pg is None or pg.state != "CREATED":
+                return None
+            indices = (
+                list(pg.bundle_nodes.keys())
+                if bundle_index is None or bundle_index < 0
+                else [bundle_index]
+            )
+            want = {k: v for k, v in resources.items() if v > 0}
+            for idx in indices:
+                avail = pg.bundle_available.get(idx, {})
+                node = self.state.nodes.get(pg.bundle_nodes[idx])
+                if node is None or not node.alive:
+                    continue
+                if all(avail.get(k, 0.0) >= v - 1e-9 for k, v in want.items()):
+                    for k, v in want.items():
+                        avail[k] = avail.get(k, 0.0) - v
+                    return pg.bundle_nodes[idx], idx
+            return None
+
+    def release_pg(self, pg_id: str, bundle_index: int, resources) -> None:
+        with self.lock:
+            pg = self.state.placement_groups.get(pg_id)
+            if pg is None or pg.state != "CREATED":
+                return
+            avail = pg.bundle_available.get(bundle_index)
+            if avail is None:
+                return
+            cap = pg.bundles[bundle_index]
+            for k, v in resources.items():
+                if v > 0:
+                    avail[k] = min(avail.get(k, 0.0) + v, cap.get(k, 0.0))
+
+    def reserve_placement_group(self, pg: PlacementGroupInfo) -> bool:
+        """2-phase-commit-lite bundle reservation
+        (ray: gcs_placement_group_scheduler.cc): all-or-nothing acquire."""
+        with self.lock:
+            assignment = self._plan_bundles(pg)
+            if assignment is None:
+                return False
+            acquired: List[tuple] = []
+            for idx, node_id in assignment.items():
+                if self.acquire(node_id, pg.bundles[idx]):
+                    acquired.append((node_id, pg.bundles[idx]))
+                else:  # rollback
+                    for nid, res in acquired:
+                        self.release(nid, res)
+                    return False
+            pg.bundle_nodes = assignment
+            pg.bundle_available = {
+                i: dict(pg.bundles[i]) for i in range(len(pg.bundles))
+            }
+            pg.state = "CREATED"
+            return True
+
+    def _plan_bundles(self, pg: PlacementGroupInfo) -> Optional[Dict[int, str]]:
+        nodes = self.state.alive_nodes()
+        strategy = pg.strategy
+        bundles = pg.bundles
+
+        def room(node, extra):
+            """available minus already-planned extra on that node."""
+            return all(
+                node.available.get(k, 0.0) - extra.get(node.node_id, {}).get(k, 0.0) >= v - 1e-9
+                for k, v in bundle.items()
+            )
+
+        if strategy in ("STRICT_PACK", "PACK", "MESH"):
+            # try one node first
+            planned: Dict[str, Dict[str, float]] = {}
+            for node in sorted(nodes, key=_utilization):
+                ok = True
+                extra: Dict[str, float] = {}
+                for bundle in bundles:
+                    if all(
+                        node.available.get(k, 0.0) - extra.get(k, 0.0) >= v - 1e-9
+                        for k, v in bundle.items()
+                    ):
+                        for k, v in bundle.items():
+                            extra[k] = extra.get(k, 0.0) + v
+                    else:
+                        ok = False
+                        break
+                if ok:
+                    return {i: node.node_id for i in range(len(bundles))}
+            if strategy == "STRICT_PACK":
+                return None
+            # PACK/MESH fall through to best-effort spread; MESH additionally
+            # requires the chosen hosts to be ICI-contiguous (labels carry the
+            # host's mesh coordinate -- single-host clusters trivially satisfy).
+        if strategy == "STRICT_SPREAD" and len(bundles) > len(nodes):
+            return None
+        assignment: Dict[int, str] = {}
+        extra: Dict[str, Dict[str, float]] = {}
+        used_nodes = set()
+        for i, bundle in enumerate(bundles):
+            cands = []
+            for node in nodes:
+                if strategy == "STRICT_SPREAD" and node.node_id in used_nodes:
+                    continue
+                if room(node, extra):
+                    cands.append(node)
+            if not cands:
+                return None
+            node = min(cands, key=_utilization)
+            assignment[i] = node.node_id
+            used_nodes.add(node.node_id)
+            e = extra.setdefault(node.node_id, {})
+            for k, v in bundle.items():
+                e[k] = e.get(k, 0.0) + v
+        return assignment
+
+    def remove_placement_group(self, pg: PlacementGroupInfo) -> None:
+        with self.lock:
+            if pg.state == "CREATED":
+                for idx, node_id in pg.bundle_nodes.items():
+                    self.release(node_id, pg.bundles[idx])
+            pg.state = "REMOVED"

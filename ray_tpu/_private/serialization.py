@@ -1,0 +1,149 @@
+"""Serialization: cloudpickle protocol-5 with out-of-band buffers.
+
+Mirrors the reference's SerializationContext
+(ray: python/ray/_private/serialization.py:92,358,438): values are pickled
+with protocol 5 so large contiguous buffers (numpy / host-side jax arrays)
+travel out-of-band and can be mapped zero-copy from the shared-memory store.
+ObjectRefs contained inside a value are intercepted so the owner can track
+borrows (ray: src/ray/core_worker/reference_count.h:61).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, Callable, List, Optional, Tuple
+
+import cloudpickle
+
+from ray_tpu._private.refs import ObjectRef
+
+
+class _RefPlaceholder:
+    __slots__ = ("id", "owner")
+
+    def __init__(self, id: str, owner: str | None):
+        self.id = id
+        self.owner = owner
+
+
+class _Pickler(cloudpickle.Pickler):
+    def __init__(self, file, buffer_callback):
+        super().__init__(file, protocol=5, buffer_callback=buffer_callback)
+        self.contained_refs: List[str] = []
+
+    def persistent_id(self, obj: Any):
+        if isinstance(obj, ObjectRef):
+            self.contained_refs.append(obj.id)
+            return ("raytpu.objectref", obj.id, obj.owner)
+        return None
+
+
+class _Unpickler(pickle.Unpickler):
+    def __init__(self, file, *, buffers=None, ref_factory=None):
+        super().__init__(file, buffers=buffers)
+        self._ref_factory = ref_factory
+
+    def persistent_load(self, pid):
+        tag, id, owner = pid
+        if tag != "raytpu.objectref":
+            raise pickle.UnpicklingError(f"unknown persistent id {tag!r}")
+        if self._ref_factory is not None:
+            return self._ref_factory(id, owner)
+        return ObjectRef(id, owner)
+
+
+def serialize(
+    value: Any,
+) -> Tuple[bytes, List[pickle.PickleBuffer], List[str]]:
+    """Serialize ``value``.
+
+    Returns (payload, out_of_band_buffers, contained_object_ref_ids).
+    """
+    buffers: List[pickle.PickleBuffer] = []
+    f = io.BytesIO()
+    p = _Pickler(f, buffers.append)
+    p.dump(value)
+    return f.getvalue(), buffers, p.contained_refs
+
+
+def deserialize(
+    payload: bytes | memoryview,
+    buffers: Optional[List[memoryview]] = None,
+    ref_factory: Optional[Callable[[str, str | None], ObjectRef]] = None,
+) -> Any:
+    u = _Unpickler(
+        io.BytesIO(payload) if isinstance(payload, (bytes, bytearray)) else io.BytesIO(bytes(payload)),
+        buffers=buffers,
+        ref_factory=ref_factory,
+    )
+    return u.load()
+
+
+# -- flat wire format ---------------------------------------------------------
+#
+# [u64 payload_len][u32 nbuf][u64 buf_len]*nbuf  then payload, then each
+# buffer 64-byte aligned. Used both for inline messages and for the
+# shared-memory store files so a stored object can be read back zero-copy.
+
+import struct
+
+_ALIGN = 64
+
+
+def pack(payload: bytes, buffers: List[pickle.PickleBuffer]) -> bytearray:
+    lens = [len(b.raw()) for b in buffers]
+    header = struct.pack("<QI", len(payload), len(buffers)) + b"".join(
+        struct.pack("<Q", n) for n in lens
+    )
+    out = bytearray(header)
+    out += payload
+    for b in buffers:
+        pad = (-len(out)) % _ALIGN
+        out += b"\x00" * pad
+        out += b.raw()
+    return out
+
+
+def packed_size(payload: bytes, buffers: List[pickle.PickleBuffer]) -> int:
+    n = 12 + 8 * len(buffers) + len(payload)
+    for b in buffers:
+        n += (-n) % _ALIGN
+        n += len(b.raw())
+    return n
+
+
+def pack_into(mv: memoryview, payload: bytes, buffers: List[pickle.PickleBuffer]) -> None:
+    """Pack directly into a writable memoryview (e.g. an mmap) without copies."""
+    lens = [len(b.raw()) for b in buffers]
+    off = 0
+    struct.pack_into("<QI", mv, off, len(payload), len(buffers))
+    off += 12
+    for n in lens:
+        struct.pack_into("<Q", mv, off, n)
+        off += 8
+    mv[off : off + len(payload)] = payload
+    off += len(payload)
+    for b in buffers:
+        off += (-off) % _ALIGN
+        raw = b.raw()
+        mv[off : off + len(raw)] = raw
+        off += len(raw)
+
+
+def unpack(mv: memoryview) -> Tuple[memoryview, List[memoryview]]:
+    payload_len, nbuf = struct.unpack_from("<QI", mv, 0)
+    off = 12
+    lens = []
+    for _ in range(nbuf):
+        (n,) = struct.unpack_from("<Q", mv, off)
+        lens.append(n)
+        off += 8
+    payload = mv[off : off + payload_len]
+    off += payload_len
+    bufs = []
+    for n in lens:
+        off += (-off) % _ALIGN
+        bufs.append(mv[off : off + n])
+        off += n
+    return payload, bufs

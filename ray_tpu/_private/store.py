@@ -1,0 +1,265 @@
+"""Object store: owner-side memory store + shared-memory (plasma-lite) store.
+
+TPU-native re-design of the reference's two-tier object plane:
+  * small objects live in the owner's in-process memory store
+    (ray: src/ray/core_worker/store_provider/memory_store/memory_store.h:43);
+  * large objects live as files under /dev/shm which any worker process on the
+    host can mmap zero-copy (ray: src/ray/object_manager/plasma/store.h:55).
+
+Unlike plasma we do not run a separate store process with fd-passing: on TPU
+hosts the store's clients are a handful of per-host worker processes, so a
+file-per-object segment in tmpfs gives the same zero-copy mmap semantics with
+radically less machinery. Eviction/spilling policies layer on top (see
+spill_to below, mirroring ray: src/ray/raylet/local_object_manager.h:110).
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import pickle
+import shutil
+import tempfile
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private import serialization as ser
+
+INLINE_THRESHOLD = 100 * 1024  # same knob as ray: max_direct_call_object_size
+
+
+def _default_shm_root() -> str:
+    if os.path.isdir("/dev/shm") and os.access("/dev/shm", os.W_OK):
+        return "/dev/shm"
+    return tempfile.gettempdir()
+
+
+class SealedObject:
+    """A stored, immutable object (serialized form + keepalive handles)."""
+
+    __slots__ = ("payload", "buffers", "_keepalive", "size")
+
+    def __init__(self, payload, buffers, keepalive=None):
+        self.payload = payload
+        self.buffers = buffers
+        self._keepalive = keepalive
+        self.size = len(payload) + sum(len(b) for b in buffers)
+
+    def deserialize(self, ref_factory=None) -> Any:
+        return ser.deserialize(self.payload, self.buffers, ref_factory)
+
+
+class ShmStore:
+    """File-per-object tmpfs segments, mmap'ed zero-copy on read."""
+
+    def __init__(self, session_name: str, root: Optional[str] = None):
+        self.dir = os.path.join(root or _default_shm_root(), f"raytpu-{session_name}")
+        os.makedirs(self.dir, exist_ok=True)
+
+    def _path(self, object_id: str) -> str:
+        return os.path.join(self.dir, object_id.replace(":", "_"))
+
+    def create(self, object_id: str, payload: bytes, buffers: List[pickle.PickleBuffer]) -> int:
+        size = ser.packed_size(payload, buffers)
+        path = self._path(object_id)
+        tmp = path + ".tmp"
+        with open(tmp, "wb+") as f:
+            f.truncate(size)
+            with mmap.mmap(f.fileno(), size) as m:
+                ser.pack_into(memoryview(m), payload, buffers)
+        os.rename(tmp, path)  # atomic "seal"
+        return size
+
+    def contains(self, object_id: str) -> bool:
+        return os.path.exists(self._path(object_id))
+
+    def get(self, object_id: str) -> Optional[SealedObject]:
+        path = self._path(object_id)
+        try:
+            f = open(path, "rb")
+        except FileNotFoundError:
+            return None
+        try:
+            size = os.fstat(f.fileno()).st_size
+            m = mmap.mmap(f.fileno(), size, prot=mmap.PROT_READ)
+        finally:
+            f.close()
+        payload, buffers = ser.unpack(memoryview(m))
+        return SealedObject(payload, buffers, keepalive=m)
+
+    def delete(self, object_id: str) -> None:
+        try:
+            os.unlink(self._path(object_id))
+        except FileNotFoundError:
+            pass
+
+    def destroy(self) -> None:
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+
+class OwnerStore:
+    """The owner's view of every object it created.
+
+    Combines the in-process memory store (small objects), the shm directory
+    (large objects) and the owner-side reference count
+    (ray: src/ray/core_worker/reference_count.h:61 -- we implement the owner
+    bookkeeping; borrower chains collapse to owner-mediated counts because all
+    submissions flow through the owner in this runtime).
+    """
+
+    def __init__(self, session_name: str, spill_dir: Optional[str] = None):
+        self.shm = ShmStore(session_name)
+        self._mem: Dict[str, SealedObject] = {}
+        self._in_shm: Dict[str, int] = {}  # id -> size
+        self._spilled: Dict[str, str] = {}  # id -> file path
+        self._refcount: Dict[str, int] = {}
+        self._available = threading.Condition()
+        self._ready: Dict[str, bool] = {}
+        self._errors: Dict[str, Any] = {}  # id -> exception to raise on get
+        self._spill_dir = spill_dir
+        self._lock = threading.RLock()
+
+    # -- refcounting ---------------------------------------------------------
+
+    def add_ref(self, object_id: str, n: int = 1) -> None:
+        with self._lock:
+            self._refcount[object_id] = self._refcount.get(object_id, 0) + n
+
+    def remove_ref(self, object_id: str, n: int = 1) -> None:
+        with self._lock:
+            c = self._refcount.get(object_id, 0) - n
+            if c > 0:
+                self._refcount[object_id] = c
+            else:
+                self._refcount.pop(object_id, None)
+                self._free(object_id)
+
+    def refcount(self, object_id: str) -> int:
+        return self._refcount.get(object_id, 0)
+
+    def _free(self, object_id: str) -> None:
+        self._mem.pop(object_id, None)
+        if self._in_shm.pop(object_id, None) is not None:
+            self.shm.delete(object_id)
+        p = self._spilled.pop(object_id, None)
+        if p:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        self._ready.pop(object_id, None)
+        self._errors.pop(object_id, None)
+
+    # -- put / seal ----------------------------------------------------------
+
+    def put_serialized(
+        self, object_id: str, payload: bytes, buffers: List[pickle.PickleBuffer]
+    ) -> None:
+        size = len(payload) + sum(len(b.raw()) for b in buffers)
+        if size >= INLINE_THRESHOLD:
+            self.shm.create(object_id, payload, buffers)
+            with self._lock:
+                self._in_shm[object_id] = size
+        else:
+            obj = SealedObject(payload, [b.raw() for b in buffers])
+            with self._lock:
+                self._mem[object_id] = obj
+        self._mark_ready(object_id)
+
+    def put(self, object_id: str, value: Any) -> List[str]:
+        payload, buffers, contained = ser.serialize(value)
+        self.put_serialized(object_id, payload, buffers)
+        return contained
+
+    def put_error(self, object_id: str, err: Exception) -> None:
+        with self._lock:
+            self._errors[object_id] = err
+        self._mark_ready(object_id)
+
+    def mark_shm_sealed(self, object_id: str, size: int) -> None:
+        """A worker already wrote the segment directly; record and publish."""
+        with self._lock:
+            self._in_shm[object_id] = size
+        self._mark_ready(object_id)
+
+    def _mark_ready(self, object_id: str) -> None:
+        with self._available:
+            self._ready[object_id] = True
+            self._available.notify_all()
+
+    # -- get / wait ----------------------------------------------------------
+
+    def is_ready(self, object_id: str) -> bool:
+        return self._ready.get(object_id, False)
+
+    def error_for(self, object_id: str):
+        return self._errors.get(object_id)
+
+    def wait(self, object_ids: List[str], num_returns: int, timeout: Optional[float]):
+        """Block until num_returns of object_ids are ready. Returns ready set."""
+        import time
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._available:
+            while True:
+                ready = [o for o in object_ids if self._ready.get(o, False)]
+                if len(ready) >= num_returns:
+                    return ready
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return ready
+                    self._available.wait(remaining)
+                else:
+                    self._available.wait()
+
+    def get_sealed(self, object_id: str) -> Optional[SealedObject]:
+        with self._lock:
+            obj = self._mem.get(object_id)
+            if obj is not None:
+                return obj
+            if object_id in self._in_shm:
+                return self.shm.get(object_id)
+            p = self._spilled.get(object_id)
+        if p:
+            self._restore(object_id, p)
+            return self.shm.get(object_id)
+        return None
+
+    # -- spilling (ray: local_object_manager.h:110 SpillObjects) -------------
+
+    def spill(self, object_id: str) -> Optional[str]:
+        if self._spill_dir is None:
+            return None
+        obj = self.shm.get(object_id)
+        if obj is None:
+            return None
+        os.makedirs(self._spill_dir, exist_ok=True)
+        path = os.path.join(self._spill_dir, object_id.replace(":", "_"))
+        with open(path, "wb") as f:
+            f.write(ser.pack(bytes(obj.payload), [pickle.PickleBuffer(b) for b in obj.buffers]))
+        with self._lock:
+            self._spilled[object_id] = path
+            if self._in_shm.pop(object_id, None) is not None:
+                self.shm.delete(object_id)
+        return path
+
+    def _restore(self, object_id: str, path: str) -> None:
+        with open(path, "rb") as f:
+            data = f.read()
+        payload, buffers = ser.unpack(memoryview(data))
+        self.shm.create(object_id, bytes(payload), [pickle.PickleBuffer(b) for b in buffers])
+        with self._lock:
+            self._in_shm[object_id] = len(data)
+            self._spilled.pop(object_id, None)
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+    def shm_usage(self) -> int:
+        with self._lock:
+            return sum(self._in_shm.values())
+
+    def destroy(self) -> None:
+        self.shm.destroy()

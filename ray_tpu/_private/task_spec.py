@@ -1,0 +1,58 @@
+"""TaskSpec: the unit handed from submitter to scheduler to executor.
+
+Analogue of the reference's TaskSpecification
+(ray: src/ray/common/task/task_spec.h) -- carries identity, the function to
+run, serialized args, resource demands and scheduling policy. Ours is a plain
+dataclass because the control plane speaks pickled Python over per-host
+connections instead of protobuf-over-gRPC (that boundary returns when the
+multi-host DCN transport lands).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+
+@dataclass
+class TaskSpec:
+    task_id: str
+    name: str
+    fn_id: str
+    args_blob: bytes  # packed serialize((args, kwargs))
+    # All ObjectRef ids reachable from the args (borrowed for the task's
+    # lifetime, ray: reference_count.h borrow semantics).
+    contained_refs: List[str] = field(default_factory=list)
+    # Top-level arg refs: scheduling dependencies resolved to values before
+    # execution (ray semantics: top-level refs resolve, nested pass through).
+    deps: List[str] = field(default_factory=list)
+    num_returns: int = 1
+    resources: Dict[str, float] = field(default_factory=lambda: {"CPU": 1.0})
+    # Actor bits
+    actor_id: Optional[str] = None
+    method_name: Optional[str] = None
+    is_actor_creation: bool = False
+    actor_name: Optional[str] = None
+    actor_method_names: Optional[List[str]] = None
+    max_concurrency: int = 1
+    max_restarts: int = 0
+    is_async_actor: bool = False
+    # Retries / recovery (ray: src/ray/core_worker/task_manager.h:90)
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    attempt: int = 0
+    # Scheduling (ray: python/ray/util/scheduling_strategies.py)
+    scheduling_strategy: Any = None  # None | "DEFAULT" | "SPREAD" | strategy obj
+    placement_group_id: Optional[str] = None
+    placement_group_bundle_index: int = -1
+    # Runtime env (subset: env_vars) (ray: python/ray/_private/runtime_env/)
+    runtime_env: Optional[Dict[str, Any]] = None
+    owner_id: str = "driver"
+
+    def return_ids(self) -> List[str]:
+        from ray_tpu._private.ids import object_id
+
+        return [object_id(self.task_id, i) for i in range(self.num_returns)]
+
+    def requires_dedicated_worker(self) -> bool:
+        return bool(self.runtime_env and self.runtime_env.get("env_vars"))

@@ -1,0 +1,299 @@
+"""Worker process: task execution loop.
+
+Analogue of the reference's worker main
+(ray: python/ray/_private/workers/default_worker.py entering
+CoreWorkerProcess::RunTaskExecutionLoop, python/ray/_raylet.pyx:1600) and the
+executor-side scheduling queues
+(ray: src/ray/core_worker/transport/actor_scheduling_queue.h et al.):
+
+  * a recv thread demultiplexes driver messages (tasks, replies, kill);
+  * an executor runs tasks -- single-threaded FIFO for plain tasks and
+    default actors (ordered, like ActorSchedulingQueue), a thread pool for
+    max_concurrency>1 (OutOfOrderActorSchedulingQueue), and a persistent
+    asyncio loop for async actors (ray: concurrency_group_manager.h/fiber.h);
+  * large results are written straight into the host shm store (zero-copy
+    hand-off to the owner, like plasma Seal) -- only metadata rides the
+    control connection.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import sys
+import threading
+import traceback
+from typing import Any, Dict, Optional
+
+from ray_tpu._private import serialization as ser
+from ray_tpu._private.store import INLINE_THRESHOLD, ShmStore
+from ray_tpu._private.task_spec import TaskSpec
+from ray_tpu.exceptions import TaskError
+
+
+class WorkerRuntime:
+    """The in-worker runtime: proxies API calls to the owner/driver.
+
+    Plays the role of the reference's CoreWorker as linked into a worker
+    process (ray: src/ray/core_worker/core_worker.h:284) -- get/put/submit
+    flow back to the owner over the control connection, except shm reads
+    which go straight to tmpfs.
+    """
+
+    def __init__(self, conn, conn_lock, session_name: str, worker_id: str):
+        self.conn = conn
+        self.conn_lock = conn_lock
+        self.worker_id = worker_id
+        self.shm = ShmStore(session_name)
+        self.session_name = session_name
+        self._req_counter = 0
+        self._req_lock = threading.Lock()
+        self._pending: Dict[int, queue.Queue] = {}
+        self._fn_cache: Dict[str, Any] = {}
+        self.current_actor = None  # instance, when this worker hosts an actor
+        self.current_actor_id: Optional[str] = None
+        self.async_loop = None
+
+    # -- request/reply to driver --------------------------------------------
+
+    def request(self, op: str, payload: Any, timeout: Optional[float] = None) -> Any:
+        with self._req_lock:
+            self._req_counter += 1
+            req_id = self._req_counter
+            q: queue.Queue = queue.Queue(1)
+            self._pending[req_id] = q
+        with self.conn_lock:
+            self.conn.send(("req", req_id, op, payload))
+        ok, value = q.get(timeout=timeout)
+        if not ok:
+            raise value
+        return value
+
+    def oneway(self, msg: tuple) -> None:
+        try:
+            with self.conn_lock:
+                self.conn.send(msg)
+        except OSError:
+            pass
+
+    def _on_reply(self, req_id: int, ok: bool, value: Any) -> None:
+        q = self._pending.pop(req_id, None)
+        if q is not None:
+            q.put((ok, value))
+
+    # -- object plane --------------------------------------------------------
+
+    def ref_factory(self, id: str, owner: str | None):
+        from ray_tpu._private.refs import ObjectRef
+
+        return ObjectRef(id, owner)  # hooks installed in worker_main count it
+
+    def get_value(self, object_id: str) -> Any:
+        # Fast path: sealed segment already on this host's tmpfs.
+        obj = self.shm.get(object_id)
+        if obj is None:
+            kind, data = self.request("get_object", object_id)
+            if kind == "shm":
+                obj = self.shm.get(object_id)
+                if obj is None:
+                    from ray_tpu.exceptions import ObjectLostError
+
+                    raise ObjectLostError(object_id)
+            else:
+                payload, bufs = ser.unpack(memoryview(data))
+                return ser.deserialize(payload, bufs, self.ref_factory)
+        return obj.deserialize(self.ref_factory)
+
+    def put_value(self, value: Any) -> str:
+        payload, buffers, contained = ser.serialize(value)
+        size = len(payload) + sum(len(b.raw()) for b in buffers)
+        oid = self.request("alloc_object_id", None)
+        if size >= INLINE_THRESHOLD:
+            self.shm.create(oid, payload, buffers)
+            self.request("seal_object", (oid, size, contained))
+        else:
+            self.request("put_object", (oid, bytes(ser.pack(payload, buffers)), contained))
+        return oid
+
+    # -- function resolution -------------------------------------------------
+
+    def resolve_function(self, fn_id: str, blob: Optional[bytes]):
+        fn = self._fn_cache.get(fn_id)
+        if fn is None:
+            if blob is None:
+                blob = self.request("get_function", fn_id)
+            import cloudpickle
+
+            fn = cloudpickle.loads(blob)
+            self._fn_cache[fn_id] = fn
+        return fn
+
+
+_runtime: Optional[WorkerRuntime] = None
+
+
+def get_worker_runtime() -> Optional[WorkerRuntime]:
+    return _runtime
+
+
+def _resolve_args(rt: WorkerRuntime, args_blob: bytes):
+    from ray_tpu._private.refs import ObjectRef
+
+    payload, bufs = ser.unpack(memoryview(args_blob))
+    args, kwargs = ser.deserialize(payload, bufs, rt.ref_factory)
+    args = tuple(rt.get_value(a.id) if isinstance(a, ObjectRef) else a for a in args)
+    kwargs = {
+        k: rt.get_value(v.id) if isinstance(v, ObjectRef) else v for k, v in kwargs.items()
+    }
+    return args, kwargs
+
+
+def _store_results(rt: WorkerRuntime, spec: TaskSpec, out) -> list:
+    if spec.num_returns == 1:
+        out = [out]
+    elif spec.num_returns == 0:
+        out = []
+    else:
+        out = list(out)
+        if len(out) != spec.num_returns:
+            raise ValueError(
+                f"task {spec.name} declared num_returns={spec.num_returns} "
+                f"but returned {len(out)} values"
+            )
+    results = []
+    for i, value in enumerate(out):
+        oid = f"o:{spec.task_id}:{i}"
+        payload, buffers, contained = ser.serialize(value)
+        size = len(payload) + sum(len(b.raw()) for b in buffers)
+        if size >= INLINE_THRESHOLD:
+            rt.shm.create(oid, payload, buffers)
+            results.append((oid, "shm", size, contained))
+        else:
+            results.append((oid, "inline", bytes(ser.pack(payload, buffers)), contained))
+    return results
+
+
+def _execute(rt: WorkerRuntime, spec: TaskSpec, blob: Optional[bytes]):
+    """Run one task/actor-method/creation; returns ("done", ...) message."""
+    try:
+        if spec.is_actor_creation:
+            cls = rt.resolve_function(spec.fn_id, blob)
+            args, kwargs = _resolve_args(rt, spec.args_blob)
+            rt.current_actor = cls(*args, **kwargs)
+            rt.current_actor_id = spec.actor_id
+            results = _store_results(rt, spec, None)
+        elif spec.actor_id is not None:
+            method = getattr(rt.current_actor, spec.method_name)
+            args, kwargs = _resolve_args(rt, spec.args_blob)
+            out = method(*args, **kwargs)
+            if _is_coroutine(out):
+                out = _run_on_actor_loop(rt, out)
+            results = _store_results(rt, spec, out)
+        else:
+            fn = rt.resolve_function(spec.fn_id, blob)
+            args, kwargs = _resolve_args(rt, spec.args_blob)
+            out = fn(*args, **kwargs)
+            if _is_coroutine(out):
+                import asyncio
+
+                out = asyncio.run(out)
+            results = _store_results(rt, spec, out)
+        return ("done", spec.task_id, results, None)
+    except BaseException as e:  # noqa: BLE001 -- remote errors must be reported
+        if isinstance(e, SystemExit):
+            raise
+        err = TaskError.from_exception(spec.name, e)
+        import cloudpickle
+
+        return ("done", spec.task_id, [], cloudpickle.dumps(err))
+
+
+def _is_coroutine(x) -> bool:
+    import inspect
+
+    return inspect.iscoroutine(x)
+
+
+def _run_on_actor_loop(rt: WorkerRuntime, coro):
+    """Run a coroutine on the actor's persistent event loop (async actors)."""
+    import asyncio
+
+    if rt.async_loop is None:
+        loop = asyncio.new_event_loop()
+        t = threading.Thread(target=loop.run_forever, daemon=True, name="actor-asyncio")
+        t.start()
+        rt.async_loop = loop
+    fut = asyncio.run_coroutine_threadsafe(coro, rt.async_loop)
+    return fut.result()
+
+
+def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_vars):
+    # Apply runtime-env vars FIRST, before any heavy import (so e.g.
+    # JAX_PLATFORMS / XLA_FLAGS take effect in this process).
+    if env_vars:
+        os.environ.update(env_vars)
+    global _runtime
+    from multiprocessing.connection import Client
+
+    conn = Client(address, authkey=authkey)
+    conn_lock = threading.Lock()
+    rt = WorkerRuntime(conn, conn_lock, session_name, worker_id)
+    _runtime = rt
+
+    # Install ObjectRef refcount hooks: proxy to owner (oneway, FIFO with the
+    # task's own completion message so no use-after-free races).
+    from ray_tpu._private import refs as refs_mod
+
+    refs_mod.set_ref_hooks(
+        lambda oid: rt.oneway(("refop", "add", oid)),
+        lambda oid: rt.oneway(("refop", "del", oid)),
+    )
+    # Mark this process as a worker for ray_tpu API routing.
+    from ray_tpu._private import runtime as runtime_mod
+
+    runtime_mod._worker_mode = True
+
+    task_q: "queue.Queue[tuple]" = queue.Queue()
+    pool = None  # ThreadPoolExecutor for max_concurrency > 1
+
+    def recv_loop():
+        nonlocal pool
+        while True:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                os._exit(0)
+            kind = msg[0]
+            if kind == "reply":
+                rt._on_reply(msg[1], msg[2], msg[3])
+            elif kind in ("task", "create_actor"):
+                spec: TaskSpec = msg[1]
+                if spec.max_concurrency > 1 and not spec.is_actor_creation:
+                    from concurrent.futures import ThreadPoolExecutor
+
+                    if pool is None:
+                        pool = ThreadPoolExecutor(max_workers=spec.max_concurrency)
+                    pool.submit(_run_and_reply, msg)
+                else:
+                    task_q.put(msg)
+            elif kind == "kill":
+                os._exit(0)
+            elif kind == "shutdown":
+                task_q.put(("__shutdown__",))
+
+    def _run_and_reply(msg):
+        spec, blob = msg[1], msg[2]
+        done = _execute(rt, spec, blob)
+        with conn_lock:
+            conn.send(done)
+
+    threading.Thread(target=recv_loop, daemon=True, name="worker-recv").start()
+    with conn_lock:
+        conn.send(("ready", worker_id, os.getpid()))
+
+    while True:
+        msg = task_q.get()
+        if msg[0] == "__shutdown__":
+            break
+        _run_and_reply(msg)
+    sys.exit(0)

@@ -1,0 +1,183 @@
+"""Actor API: ActorClass / ActorHandle / ActorMethod.
+
+Mirrors ray: python/ray/actor.py (ActorClass :377, ActorHandle :1022,
+ActorMethod :92, exit_actor :1368).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+from ray_tpu._private import ids
+from ray_tpu._private.client import build_args_blob, client, current_session
+from ray_tpu._private.task_spec import TaskSpec
+
+
+def _public_methods(cls) -> List[str]:
+    out = []
+    for name in dir(cls):
+        if name.startswith("_") and name != "__call__":
+            continue
+        if callable(getattr(cls, name, None)):
+            out.append(name)
+    return out
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def options(self, **opts) -> "ActorMethod":
+        return ActorMethod(self._handle, self._name, opts.get("num_returns", 1))
+
+    def remote(self, *args, **kwargs):
+        return self._handle._actor_method_call(
+            self._name, args, kwargs, num_returns=self._num_returns
+        )
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"actor method {self._name} cannot be called directly; use "
+            f".{self._name}.remote()"
+        )
+
+
+class ActorHandle:
+    def __init__(self, actor_id: str, method_names: List[str], max_concurrency: int = 1):
+        self._actor_id = actor_id
+        self._method_names = list(method_names)
+        self._max_concurrency = max_concurrency
+
+    @property
+    def _id(self) -> str:
+        return self._actor_id
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name not in self._method_names:
+            raise AttributeError(
+                f"actor has no method {name!r}; available: {self._method_names}"
+            )
+        return ActorMethod(self, name)
+
+    def _actor_method_call(self, method: str, args, kwargs, num_returns: int = 1):
+        blob, contained, deps = build_args_blob(args, kwargs)
+        spec = TaskSpec(
+            task_id=ids.task_id(),
+            name=f"{self._actor_id}.{method}",
+            fn_id="",
+            args_blob=blob,
+            contained_refs=contained,
+            deps=deps,
+            num_returns=num_returns,
+            resources={},
+            actor_id=self._actor_id,
+            method_name=method,
+            max_concurrency=self._max_concurrency,
+        )
+        refs = client.submit_actor_task(spec)
+        if num_returns == 0:
+            return None
+        if num_returns == 1:
+            return refs[0]
+        return refs
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._method_names, self._max_concurrency))
+
+    def __repr__(self) -> str:
+        return f"ActorHandle({self._actor_id})"
+
+
+class ActorClass:
+    def __init__(self, cls, options: Dict[str, Any]):
+        self._cls = cls
+        self._opts = dict(options)
+        self._cls_id: Optional[str] = None
+        self._exported_session: Optional[str] = None
+
+    def options(self, **opts) -> "ActorClass":
+        return ActorClass(self._cls, {**self._opts, **opts})
+
+    def __call__(self, *a, **kw):
+        raise TypeError(
+            f"actor class {self._cls.__name__} cannot be instantiated directly; "
+            f"use {self._cls.__name__}.remote()"
+        )
+
+    def _ensure_exported(self) -> str:
+        session = current_session()
+        if self._cls_id is None or self._exported_session != session:
+            blob = cloudpickle.dumps(self._cls)
+            self._cls_id = "cls-" + hashlib.sha1(blob).hexdigest()[:16]
+            client.export_function(self._cls_id, blob)
+            self._exported_session = session
+        return self._cls_id
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        o = self._opts
+        name = o.get("name")
+        if name and o.get("get_if_exists"):
+            try:
+                aid, methods = client.get_named_actor(name, o.get("namespace"))
+                return ActorHandle(aid, methods, o.get("max_concurrency", 1))
+            except Exception:
+                pass
+        cls_id = self._ensure_exported()
+        resources = dict(o.get("resources") or {})
+        resources["CPU"] = float(o.get("num_cpus", 1))
+        if o.get("num_tpus"):
+            resources["TPU"] = float(o["num_tpus"])
+        if o.get("num_gpus"):
+            resources["GPU"] = float(o["num_gpus"])
+        blob, contained, deps = build_args_blob(args, kwargs)
+        import inspect
+
+        is_async = any(
+            inspect.iscoroutinefunction(getattr(self._cls, m, None))
+            for m in _public_methods(self._cls)
+        )
+        max_concurrency = o.get("max_concurrency", 1000 if is_async else 1)
+        spec = TaskSpec(
+            task_id=ids.task_id(),
+            name=f"{self._cls.__name__}.__init__",
+            fn_id=cls_id,
+            args_blob=blob,
+            contained_refs=contained,
+            deps=deps,
+            num_returns=1,
+            resources=resources,
+            actor_id=ids.actor_id(),
+            is_actor_creation=True,
+            actor_name=name,
+            actor_method_names=_public_methods(self._cls),
+            max_restarts=int(o.get("max_restarts", 0)),
+            max_concurrency=1,  # creation itself is ordered
+            scheduling_strategy=o.get("scheduling_strategy"),
+            runtime_env=o.get("runtime_env"),
+        )
+        client.create_actor(spec)
+        return ActorHandle(spec.actor_id, spec.actor_method_names, max_concurrency)
+
+
+def exit_actor():
+    """Terminate the current actor from inside one of its methods
+    (ray: python/ray/actor.py:1368)."""
+    from ray_tpu._private.worker_proc import get_worker_runtime
+
+    wr = get_worker_runtime()
+    if wr is None or wr.current_actor_id is None:
+        raise RuntimeError("exit_actor() called outside an actor")
+    wr.oneway(("actor_exit", wr.current_actor_id))
+    raise SystemExit(0)
+
+
+def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
+    aid, methods = client.get_named_actor(name, namespace)
+    return ActorHandle(aid, methods)

@@ -1,0 +1,40 @@
+"""In-process multi-node cluster fixture (ray: python/ray/cluster_utils.py:99).
+
+The reference tests distributed behavior by booting extra raylet+plasma
+processes with fake node IDs on one machine. Here nodes are virtual entries in
+the scheduler's node table; each node gets its own worker processes, so
+scheduling policy, spillback, node failure and actor restart are all
+exercised for real while the object plane stays host-local (multi-host object
+transfer is a later-round subsystem).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+
+class Cluster:
+    def __init__(self, initialize_head: bool = True, head_node_args: Optional[Dict] = None):
+        import ray_tpu
+        from ray_tpu._private.runtime import get_runtime
+
+        self._nodes = []
+        if initialize_head:
+            ray_tpu.init(**(head_node_args or {}))
+        self._rt = get_runtime()
+        self.head_node_id = self._rt.head_node_id
+
+    def add_node(self, num_cpus: float = 1.0, resources: Optional[Dict] = None) -> str:
+        nid = self._rt.add_node(num_cpus=num_cpus, resources=resources)
+        self._nodes.append(nid)
+        return nid
+
+    def remove_node(self, node_id: str) -> None:
+        self._rt.remove_node(node_id)
+        if node_id in self._nodes:
+            self._nodes.remove(node_id)
+
+    def shutdown(self) -> None:
+        import ray_tpu
+
+        ray_tpu.shutdown()

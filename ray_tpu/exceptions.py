@@ -1,0 +1,83 @@
+"""Exception hierarchy.
+
+Mirrors the reference's user-facing errors (ray: python/ray/exceptions.py):
+TaskError wraps the remote traceback; WorkerCrashedError / ActorDiedError /
+ObjectLostError / GetTimeoutError / TaskCancelledError keep the same meaning.
+"""
+
+from __future__ import annotations
+
+import traceback
+
+
+class RayTpuError(Exception):
+    pass
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception remotely; re-raised at ray_tpu.get().
+
+    Analogue of ray.exceptions.RayTaskError: carries the remote traceback as
+    text and the original cause when it is picklable.
+    """
+
+    def __init__(self, task_name: str, remote_tb: str, cause: BaseException | None = None):
+        self.task_name = task_name
+        self.remote_tb = remote_tb
+        self.cause = cause
+        super().__init__(f"task {task_name} failed:\n{remote_tb}")
+
+    def __reduce__(self):
+        return (TaskError, (self.task_name, self.remote_tb, self.cause))
+
+    @classmethod
+    def from_exception(cls, task_name: str, exc: BaseException) -> "TaskError":
+        tb = "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))
+        try:
+            import cloudpickle
+
+            cloudpickle.dumps(exc)
+            cause = exc
+        except Exception:
+            cause = None
+        return cls(task_name, tb, cause)
+
+
+class WorkerCrashedError(RayTpuError):
+    pass
+
+
+class ActorDiedError(RayTpuError):
+    pass
+
+
+class ActorUnavailableError(RayTpuError):
+    pass
+
+
+class ObjectLostError(RayTpuError):
+    pass
+
+
+class OwnerDiedError(ObjectLostError):
+    pass
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class TaskCancelledError(RayTpuError):
+    pass
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+class NodeDiedError(RayTpuError):
+    pass
+
+
+class PlacementGroupUnavailableError(RayTpuError):
+    pass

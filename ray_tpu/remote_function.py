@@ -1,0 +1,95 @@
+"""@ray_tpu.remote functions (ray: python/ray/remote_function.py:35)."""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from ray_tpu._private import ids
+from ray_tpu._private.client import build_args_blob, client, current_session
+from ray_tpu._private.task_spec import TaskSpec
+
+_DEFAULT_TASK_MAX_RETRIES = 3  # ray default (remote_function.py:254)
+
+
+class RemoteFunction:
+    def __init__(self, fn, options: Optional[Dict[str, Any]] = None):
+        self._fn = fn
+        self._opts = dict(options or {})
+        self._fn_id: Optional[str] = None
+        self._exported_session: Optional[str] = None
+        self.__name__ = getattr(fn, "__name__", "remote_fn")
+
+    def options(self, **opts) -> "RemoteFunction":
+        return RemoteFunction(self._fn, {**self._opts, **opts})
+
+    def _ensure_exported(self) -> str:
+        session = current_session()
+        if self._fn_id is None or self._exported_session != session:
+            blob = cloudpickle.dumps(self._fn)
+            self._fn_id = "fn-" + hashlib.sha1(blob).hexdigest()[:16]
+            client.export_function(self._fn_id, blob)
+            self._exported_session = session
+        return self._fn_id
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"remote function {self.__name__} cannot be called directly; "
+            f"use {self.__name__}.remote()"
+        )
+
+    def remote(self, *args, **kwargs):
+        fn_id = self._ensure_exported()
+        o = self._opts
+        resources = dict(o.get("resources") or {})
+        resources["CPU"] = float(o.get("num_cpus", 1))
+        if o.get("num_tpus"):
+            resources["TPU"] = float(o["num_tpus"])
+        if o.get("num_gpus"):
+            resources["GPU"] = float(o["num_gpus"])
+        blob, contained, deps = build_args_blob(args, kwargs)
+        num_returns = o.get("num_returns", 1)
+        spec = TaskSpec(
+            task_id=ids.task_id(),
+            name=o.get("name", self.__name__),
+            fn_id=fn_id,
+            args_blob=blob,
+            contained_refs=contained,
+            deps=deps,
+            num_returns=num_returns,
+            resources=resources,
+            max_retries=o.get("max_retries", _DEFAULT_TASK_MAX_RETRIES),
+            retry_exceptions=bool(o.get("retry_exceptions", False)),
+            scheduling_strategy=o.get("scheduling_strategy"),
+            runtime_env=o.get("runtime_env"),
+        )
+        refs = client.submit(spec)
+        if num_returns == 0:
+            return None
+        if num_returns == 1:
+            return refs[0]
+        return refs
+
+
+def remote(*args, **kwargs):
+    """@remote decorator for functions and classes
+    (ray: python/ray/_private/worker.py:2629 `ray.remote`)."""
+    from ray_tpu.actor import ActorClass
+    import inspect
+
+    if len(args) == 1 and not kwargs and callable(args[0]):
+        target = args[0]
+        if inspect.isclass(target):
+            return ActorClass(target, {})
+        return RemoteFunction(target)
+
+    opts = kwargs
+
+    def decorator(target):
+        if inspect.isclass(target):
+            return ActorClass(target, opts)
+        return RemoteFunction(target, opts)
+
+    return decorator

@@ -1,0 +1,55 @@
+"""Placement groups: gang resource reservation
+(ray: python/ray/util/placement_group.py:128, strategies :142-146).
+
+Strategies: PACK, SPREAD, STRICT_PACK, STRICT_SPREAD, plus the TPU-native
+"MESH" strategy (bundles land on an ICI-contiguous set of hosts; see
+ray_tpu/_private/scheduler.py).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu._private.client import client
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD", "MESH")
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: str, bundles: List[Dict[str, float]]):
+        self.id = pg_id
+        self.bundle_specs = bundles
+
+    def wait(self, timeout_seconds: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout_seconds
+        delay = 0.002
+        while time.monotonic() < deadline:
+            if client.pg_state(self.id) == "CREATED":
+                return True
+            time.sleep(delay)
+            delay = min(delay * 2, 0.1)
+        return client.pg_state(self.id) == "CREATED"
+
+    def ready(self) -> bool:
+        return client.pg_state(self.id) == "CREATED"
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id, self.bundle_specs))
+
+
+def placement_group(
+    bundles: List[Dict[str, float]],
+    strategy: str = "PACK",
+    name: Optional[str] = None,
+) -> PlacementGroup:
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(f"invalid strategy {strategy}; valid: {VALID_STRATEGIES}")
+    if not bundles or any(not b for b in bundles):
+        raise ValueError("bundles must be a non-empty list of non-empty dicts")
+    pg_id = client.pg_create(bundles, strategy, name)
+    return PlacementGroup(pg_id, bundles)
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    client.pg_remove(pg.id)

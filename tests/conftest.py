@@ -1,0 +1,33 @@
+"""Test config.
+
+JAX tests run on a virtual 8-device CPU mesh (the TPU analogue of the
+reference's fake multi-node fixtures): env must be set before jax import.
+Core runtime tests boot a real multi-process runtime per fixture, mirroring
+ray_start_regular / ray_start_cluster (ray: python/ray/tests/conftest.py:305,386).
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest
+
+
+@pytest.fixture
+def ray_start_regular():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    yield cluster
+    cluster.shutdown()

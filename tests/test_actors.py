@@ -1,0 +1,180 @@
+"""Actor tests (modeled on ray: python/ray/tests/test_actor.py,
+test_actor_failures.py)."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def incr(self, k=1):
+        self.n += k
+        return self.n
+
+    def value(self):
+        return self.n
+
+    def crash(self):
+        os._exit(1)
+
+    def bye(self):
+        ray_tpu.exit_actor()
+
+
+def test_actor_basic(ray_start_regular):
+    c = Counter.remote(10)
+    assert ray_tpu.get(c.incr.remote()) == 11
+    assert ray_tpu.get(c.incr.remote(5)) == 16
+    assert ray_tpu.get(c.value.remote()) == 16
+
+
+def test_actor_ordering(ray_start_regular):
+    c = Counter.remote()
+    refs = [c.incr.remote() for _ in range(20)]
+    assert ray_tpu.get(refs) == list(range(1, 21))
+
+
+def test_actor_method_error(ray_start_regular):
+    @ray_tpu.remote
+    class Bad:
+        def fail(self):
+            raise KeyError("nope")
+
+    b = Bad.remote()
+    with pytest.raises(ray_tpu.exceptions.TaskError):
+        ray_tpu.get(b.fail.remote())
+
+
+def test_actor_creation_error(ray_start_regular):
+    @ray_tpu.remote
+    class Broken:
+        def __init__(self):
+            raise RuntimeError("cannot build")
+
+        def f(self):
+            return 1
+
+    b = Broken.remote()
+    with pytest.raises(
+        (ray_tpu.exceptions.TaskError, ray_tpu.exceptions.ActorDiedError)
+    ):
+        ray_tpu.get(b.f.remote(), timeout=20)
+
+
+def test_named_actor(ray_start_regular):
+    c = Counter.options(name="global_counter").remote()
+    ray_tpu.get(c.incr.remote())
+    c2 = ray_tpu.get_actor("global_counter")
+    assert ray_tpu.get(c2.value.remote()) == 1
+
+
+def test_get_if_exists(ray_start_regular):
+    a = Counter.options(name="singleton", get_if_exists=True).remote()
+    ray_tpu.get(a.incr.remote())
+    b = Counter.options(name="singleton", get_if_exists=True).remote()
+    assert ray_tpu.get(b.value.remote()) == 1
+
+
+def test_kill_actor(ray_start_regular):
+    c = Counter.remote()
+    ray_tpu.get(c.incr.remote())
+    ray_tpu.kill(c)
+    with pytest.raises(ray_tpu.exceptions.ActorDiedError):
+        ray_tpu.get(c.incr.remote(), timeout=20)
+
+
+def test_actor_crash_no_restart(ray_start_regular):
+    c = Counter.remote()
+    with pytest.raises(ray_tpu.exceptions.ActorDiedError):
+        ray_tpu.get(c.crash.remote(), timeout=20)
+    with pytest.raises(ray_tpu.exceptions.ActorDiedError):
+        ray_tpu.get(c.value.remote(), timeout=20)
+
+
+def test_actor_restart(ray_start_regular):
+    c = Counter.options(max_restarts=2).remote(100)
+    assert ray_tpu.get(c.incr.remote()) == 101
+    with pytest.raises(ray_tpu.exceptions.ActorDiedError):
+        ray_tpu.get(c.crash.remote(), timeout=20)
+    # restarted: state re-initialized from creation args (ray FSM semantics,
+    # gcs_actor_manager.h:258)
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        try:
+            assert ray_tpu.get(c.value.remote(), timeout=20) == 100
+            break
+        except ray_tpu.exceptions.ActorDiedError:
+            time.sleep(0.1)
+    else:
+        pytest.fail("actor did not restart")
+
+
+def test_exit_actor(ray_start_regular):
+    c = Counter.remote()
+    with pytest.raises(ray_tpu.exceptions.ActorDiedError):
+        ray_tpu.get(c.bye.remote(), timeout=20)
+    with pytest.raises(ray_tpu.exceptions.ActorDiedError):
+        ray_tpu.get(c.value.remote(), timeout=20)
+
+
+def test_actor_handle_to_task(ray_start_regular):
+    c = Counter.remote()
+
+    @ray_tpu.remote
+    def bump(counter):
+        return ray_tpu.get(counter.incr.remote())
+
+    assert ray_tpu.get(bump.remote(c), timeout=20) == 1
+    assert ray_tpu.get(c.value.remote()) == 1
+
+
+def test_async_actor(ray_start_regular):
+    @ray_tpu.remote
+    class AsyncWorker:
+        async def work(self, x):
+            import asyncio
+
+            await asyncio.sleep(0.05)
+            return x * 2
+
+    a = AsyncWorker.remote()
+    refs = [a.work.remote(i) for i in range(8)]
+    t0 = time.monotonic()
+    assert sorted(ray_tpu.get(refs, timeout=20)) == [0, 2, 4, 6, 8, 10, 12, 14]
+    # 8 calls x 50ms must overlap on the actor's event loop
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_threaded_actor(ray_start_regular):
+    @ray_tpu.remote(max_concurrency=4)
+    class Sleeper:
+        def nap(self):
+            time.sleep(0.3)
+            return 1
+
+    s = Sleeper.remote()
+    t0 = time.monotonic()
+    assert sum(ray_tpu.get([s.nap.remote() for _ in range(4)], timeout=20)) == 4
+    assert time.monotonic() - t0 < 1.1
+
+
+def test_actor_pending_calls_queued_before_alive(ray_start_regular):
+    @ray_tpu.remote
+    class Slow:
+        def __init__(self):
+            time.sleep(0.5)
+            self.ok = True
+
+        def check(self):
+            return self.ok
+
+    s = Slow.remote()
+    # submitted while still PENDING_CREATION
+    assert ray_tpu.get(s.check.remote(), timeout=20) is True

