@@ -1,0 +1,102 @@
+"""Headline benchmark: flagship-model training throughput on real TPU.
+
+Prints ONE JSON line: tokens/sec/chip on a Llama-family decoder train step
+(fwd+bwd+adam, bf16 compute), plus achieved MFU.  vs_baseline is achieved
+MFU / 0.45 — the north-star target from BASELINE.json ("Llama-7B DDP at
+>=45% MFU"); the reference itself has no TPU numbers to compare against
+(SURVEY.md §6: GPU-only).
+
+Model is scaled to fit one chip's HBM (the driver runs single-chip); the
+multi-chip path is exercised by __graft_entry__.dryrun_multichip.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+# per-chip dense bf16 peak; longest-prefix keys first ("TPU v5p" must win
+# over "TPU v5" under the startswith lookup below)
+PEAK_BF16_FLOPS = {
+    "TPU v6 lite": 918e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 197e12,
+    "TPU v4": 275e12,
+}
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import LMTrainContext, TransformerConfig
+    from ray_tpu.parallel import MeshSpec, build_mesh
+
+    dev = jax.devices()[0]
+    peak = next(
+        (v for k, v in PEAK_BF16_FLOPS.items() if dev.device_kind.startswith(k)),
+        197e12,
+    )
+
+    # ~470M params: fits v5e HBM (16G) with bf16 params + f32 adam moments.
+    cfg = TransformerConfig(
+        vocab_size=32000,
+        d_model=1536,
+        n_layers=24,
+        n_heads=12,
+        n_kv_heads=12,
+        d_ff=4096,
+        max_seq_len=2048,
+        param_dtype=jnp.bfloat16,
+        remat=True,
+    )
+    batch_size, seq = 8, 2048
+
+    mesh = build_mesh(MeshSpec(data=1), devices=[dev])
+    ctx = LMTrainContext(cfg, mesh=mesh, strategy="dp")
+    state = ctx.init_state(seed=0)
+
+    key = jax.random.PRNGKey(1)
+    toks = jax.random.randint(key, (batch_size, seq + 1), 0, cfg.vocab_size)
+    batch = {"tokens": toks[:, :-1], "targets": toks[:, 1:]}
+
+    # warmup / compile. float() forces a host fetch — block_until_ready alone
+    # does not synchronize on the axon TPU platform.
+    for _ in range(2):
+        state, metrics = ctx.train_step(state, batch)
+    float(metrics["loss"])
+
+    steps = 10
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = ctx.train_step(state, batch)
+    # steps chain through donated state, so fetching the last loss implies
+    # all prior steps completed.
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_s = steps * batch_size * seq / dt
+    n_params = cfg.num_params()
+    # 6ND fwd+bwd (+remat recompute ≈ 8ND counted conservatively as 6ND)
+    model_flops = 6 * n_params * tokens_per_s
+    mfu = model_flops / peak
+
+    print(
+        json.dumps(
+            {
+                "metric": "train_tokens_per_sec_per_chip",
+                "value": round(tokens_per_s, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(mfu / 0.45, 4),
+                "mfu": round(mfu, 4),
+                "n_params": n_params,
+                "device": dev.device_kind,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

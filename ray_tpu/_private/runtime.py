@@ -58,6 +58,37 @@ from ray_tpu.exceptions import (
 _worker_mode = False  # set True inside worker processes (worker_proc.py)
 
 
+class _PopenHandle:
+    """subprocess.Popen adapter exposing the mp.Process surface the runtime
+    uses (terminate/join/is_alive/pid)."""
+
+    __slots__ = ("_p",)
+
+    def __init__(self, p):
+        self._p = p
+
+    def terminate(self):
+        self._p.terminate()
+
+    def kill(self):
+        self._p.kill()
+
+    def join(self, timeout=None):
+        import subprocess
+
+        try:
+            self._p.wait(timeout)
+        except subprocess.TimeoutExpired:
+            pass
+
+    def is_alive(self):
+        return self._p.poll() is None
+
+    @property
+    def pid(self):
+        return self._p.pid
+
+
 class WorkerHandle:
     __slots__ = (
         "worker_id",
@@ -149,6 +180,7 @@ class Runtime:
 
         self.workers: Dict[str, WorkerHandle] = {}
         self.idle_pool: Dict[Tuple[str, Any], List[str]] = {}  # (node, env_key) -> worker_ids
+        self.starting_pool: Dict[Tuple[str, Any], List[str]] = {}  # spawned, not yet connected
         self.tasks: Dict[str, TaskRecord] = {}
         self.actors: Dict[str, ActorRuntime] = {}
         self.ready_queue: deque = deque()
@@ -160,7 +192,10 @@ class Runtime:
         from multiprocessing.connection import Listener
 
         self._authkey = os.urandom(16)
-        self.listener = Listener(("127.0.0.1", 0), authkey=self._authkey)
+        # backlog: many workers connect at once on startup; the default
+        # backlog of 1 silently drops simultaneous handshakes (the dropped
+        # worker then blocks forever in its auth recv).
+        self.listener = Listener(("127.0.0.1", 0), backlog=128, authkey=self._authkey)
         self.address = self.listener.address
         self._shutdown = False
         self._conn_to_worker: Dict[Any, str] = {}
@@ -173,6 +208,13 @@ class Runtime:
 
         set_ref_hooks(self._addref_local, self._decref_local)
         atexit.register(self.shutdown)
+
+        # Prestart a warm worker pool (ray: src/ray/raylet/worker_pool.h:156
+        # prestarts workers per language): exec'ed workers pay a fresh
+        # interpreter start, so overlap that cost with driver setup.
+        with self.lock:
+            for _ in range(min(int(self.state.nodes[self.head_node_id].resources.get("CPU", 0)), 8)):
+                self._spawn_worker(self.head_node_id, None, None)
 
     # ------------------------------------------------------------------
     # refcounting (owner side)
@@ -187,7 +229,7 @@ class Runtime:
         with self.lock:
             if self.store.refcount(oid) == 1:
                 contained = self.contained_map.pop(oid, None)
-        self.store.remove_ref(oid)
+            self.store.remove_ref(oid)
         if contained:
             for c in contained:
                 self._decref_local(c)
@@ -204,28 +246,42 @@ class Runtime:
     # worker pool (ray: src/ray/raylet/worker_pool.h:156)
 
     def _spawn_worker(self, node_id: str, env_key, env_vars) -> WorkerHandle:
-        import multiprocessing as mp
+        # Workers are exec'ed as fresh interpreters (`python -m ..worker_proc`)
+        # rather than multiprocessing children: mp's spawn/forkserver children
+        # re-import the driver's __main__ module during bootstrap, which
+        # re-runs unguarded user scripts (and fork would inherit the driver's
+        # threads + live XLA client).  Matches the reference, whose raylet
+        # execs default_worker.py (ray: src/ray/raylet/worker_pool.h:156,
+        # python/ray/_private/workers/default_worker.py).
+        import json
+        import subprocess
         import sys
 
         wid = ids.worker_id()
-        # forkserver: workers fork from a clean single-threaded server
-        # process, so they are immune both to the driver's threads (fork
-        # deadlocks) and to the driver's live XLA/TPU client (the analogue of
-        # the reference forking workers from the raylet, not the driver --
-        # ray: src/ray/raylet/worker_pool.h:156). ~200x faster than spawn on
-        # these hosts after the one-time server start.
-        ctx = mp.get_context("forkserver")
-        from ray_tpu._private.worker_proc import worker_main
-
-        proc = ctx.Process(
-            target=worker_main,
-            args=(self.address, self._authkey, wid, self.session_name, env_vars),
-            daemon=True,
-            name=f"raytpu-worker-{wid}",
+        host, port = self.address
+        env = os.environ.copy()
+        env.update(
+            {
+                "RAY_TPU_DRIVER_HOST": host,
+                "RAY_TPU_DRIVER_PORT": str(port),
+                "RAY_TPU_AUTHKEY": self._authkey.hex(),
+                "RAY_TPU_WORKER_ID": wid,
+                "RAY_TPU_SESSION": self.session_name,
+                "RAY_TPU_ENV_VARS": json.dumps(env_vars or {}),
+            }
         )
-        proc.start()
+        # Make ray_tpu importable in the child regardless of driver cwd.
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        popen = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_proc"],
+            env=env,
+            close_fds=True,
+        )
+        proc = _PopenHandle(popen)
         handle = WorkerHandle(wid, node_id, env_key, env_vars, proc)
         self.workers[wid] = handle
+        self.starting_pool.setdefault((node_id, env_key), []).append(wid)
         return handle
 
     def _lease_worker(self, node_id: str, spec: TaskSpec) -> WorkerHandle:
@@ -236,6 +292,14 @@ class Runtime:
             wid = pool.pop()
             h = self.workers.get(wid)
             if h is not None and h.state == "idle":
+                return h
+        # A spawned-but-not-yet-connected worker is leasable: its task is
+        # queued in pending_sends and flushed on connect.
+        pool = self.starting_pool.get((node_id, env_key))
+        while pool:
+            wid = pool.pop()
+            h = self.workers.get(wid)
+            if h is not None and h.state == "starting":
                 return h
         return self._spawn_worker(node_id, env_key, env_vars)
 
@@ -286,6 +350,9 @@ class Runtime:
                 h.pending_sends = []
                 if h.state == "starting":
                     h.state = "idle"
+                    sp = self.starting_pool.get((h.node_id, h.env_key))
+                    if sp and wid in sp:
+                        sp.remove(wid)
                     self.idle_pool.setdefault((h.node_id, h.env_key), []).append(wid)
                 self._conn_to_worker[conn] = wid
             with self.lock:
@@ -294,7 +361,22 @@ class Runtime:
     def _io_loop(self):
         from multiprocessing.connection import wait as conn_wait
 
+        last_reap = 0.0
         while not self._shutdown:
+            # Reap workers that died before ever connecting (spawn failure,
+            # import crash): conn-EOF detection can't see them.
+            now = time.monotonic()
+            if now - last_reap > 0.5:
+                last_reap = now
+                with self.lock:
+                    for wid, h in list(self.workers.items()):
+                        if (
+                            h.conn is None
+                            and h.state not in ("dead",)
+                            and h.proc is not None
+                            and not h.proc.is_alive()
+                        ):
+                            self._on_worker_crash(wid)
             with self.lock:
                 conns = list(self._conn_to_worker.keys())
             if not conns:
@@ -331,10 +413,11 @@ class Runtime:
             with self.lock:
                 self._on_task_done(wid, msg[1], msg[2], msg[3])
         elif kind == "refop":
-            if msg[1] == "add":
-                self.store.add_ref(msg[2])
-            else:
-                self._decref_local(msg[2])
+            with self.lock:
+                if msg[1] == "add":
+                    self.store.add_ref(msg[2])
+                else:
+                    self._decref_local(msg[2])
         elif kind == "actor_exit":
             with self.lock:
                 ar = self.actors.get(msg[1])
@@ -752,6 +835,8 @@ class Runtime:
             for oid in rec.spec.return_ids():
                 self.store.put_error(oid, err)
                 self._object_ready(oid)
+            for c in rec.spec.contained_refs:
+                self._decref_local(c)
         for tid in list(ar.in_flight):
             rec = self.tasks.pop(tid, None)
             if rec is None:
@@ -759,6 +844,8 @@ class Runtime:
             for oid in rec.spec.return_ids():
                 self.store.put_error(oid, err)
                 self._object_ready(oid)
+            for c in rec.spec.contained_refs:
+                self._decref_local(c)
         ar.in_flight.clear()
 
     def _on_worker_crash(self, wid: str) -> None:
@@ -786,6 +873,8 @@ class Runtime:
             for oid in spec.return_ids():
                 self.store.put_error(oid, TaskCancelledError(spec.name))
                 self._object_ready(oid)
+            for c in spec.contained_refs:
+                self._decref_local(c)
             return
         if spec.attempt < spec.max_retries:
             spec.attempt += 1
@@ -825,6 +914,8 @@ class Runtime:
                 for oid in rec.spec.return_ids():
                     self.store.put_error(oid, err)
                     self._object_ready(oid)
+                for c in rec.spec.contained_refs:
+                    self._decref_local(c)
         ar.in_flight.clear()
         can_restart = (
             not ar.no_restart
@@ -947,6 +1038,21 @@ class Runtime:
                 if info and info.state != DEAD:
                     self.state.set_actor_state(actor_id, DEAD, death_cause="killed")
                     self._fail_actor_queue(ar, ActorDiedError(actor_id))
+                # Cancel the still-pending creation task, else its eventual
+                # dispatch would resurrect the actor to ALIVE.
+                for tid, rec in list(self.tasks.items()):
+                    if (
+                        rec.spec.is_actor_creation
+                        and rec.spec.actor_id == actor_id
+                        and rec.state in ("PENDING", "READY")
+                    ):
+                        rec.cancelled = True
+                        self.tasks.pop(tid, None)
+                        for oid in rec.spec.return_ids():
+                            self.store.put_error(oid, ActorDiedError(actor_id))
+                            self._object_ready(oid)
+                        for c in rec.spec.contained_refs:
+                            self._decref_local(c)
 
     # -- placement groups ----------------------------------------------------
 

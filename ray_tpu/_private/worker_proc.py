@@ -235,7 +235,14 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
     global _runtime
     from multiprocessing.connection import Client
 
+    # Watchdog: if the connect/auth handshake wedges (e.g. the driver
+    # vanished between spawn and connect), die instead of lingering — the
+    # driver's reaper then reschedules anything leased to this worker.
+    watchdog = threading.Timer(60.0, lambda: os._exit(17))
+    watchdog.daemon = True
+    watchdog.start()
     conn = Client(address, authkey=authkey)
+    watchdog.cancel()
     conn_lock = threading.Lock()
     rt = WorkerRuntime(conn, conn_lock, session_name, worker_id)
     _runtime = rt
@@ -283,7 +290,14 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
 
     def _run_and_reply(msg):
         spec, blob = msg[1], msg[2]
-        done = _execute(rt, spec, blob)
+        try:
+            done = _execute(rt, spec, blob)
+        except SystemExit:
+            # exit_actor() from a concurrent (thread-pool) actor method:
+            # in a pool thread SystemExit would be swallowed by the Future,
+            # leaving the caller hanging — exit the process here (the
+            # actor_exit oneway was already sent by exit_actor()).
+            os._exit(0)
         with conn_lock:
             conn.send(done)
 
@@ -297,3 +311,26 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
             break
         _run_and_reply(msg)
     sys.exit(0)
+
+
+def _subprocess_entry() -> None:
+    """Entry for `python -m ray_tpu._private.worker_proc` (exec'ed by the
+    driver's worker pool — see runtime._spawn_worker)."""
+    import json
+
+    host = os.environ["RAY_TPU_DRIVER_HOST"]
+    port = int(os.environ["RAY_TPU_DRIVER_PORT"])
+    authkey = bytes.fromhex(os.environ["RAY_TPU_AUTHKEY"])
+    wid = os.environ["RAY_TPU_WORKER_ID"]
+    session = os.environ["RAY_TPU_SESSION"]
+    env_vars = json.loads(os.environ.get("RAY_TPU_ENV_VARS", "{}"))
+    # Under `python -m` this file runs as __main__; call through the
+    # canonical module so worker_main's globals (the _runtime singleton)
+    # land where `import ray_tpu._private.worker_proc` reads them.
+    from ray_tpu._private import worker_proc as canonical
+
+    canonical.worker_main((host, port), authkey, wid, session, env_vars)
+
+
+if __name__ == "__main__":
+    _subprocess_entry()

@@ -1,0 +1,238 @@
+"""Flagship model: Llama-family decoder-only transformer, TPU-first.
+
+The reference has no model code of its own (it trains user-supplied torch
+models through wrappers — python/ray/train/torch/train_loop_utils.py:92-98);
+a TPU framework needs first-party models whose sharding the Train layer can
+drive.  Design:
+
+- Pure-functional: params are a plain pytree; `forward` is a jit-able
+  function.  No module framework in the hot path.
+- Every parameter leaf has a *logical axes* annotation (`param_axes`), mapped
+  to mesh axes by ray_tpu.parallel.sharding rules — one model, every
+  parallelism strategy (DP/FSDP/TP/SP via rules, not rewrites).
+- Layers are stacked on a leading `layers` axis and run under `lax.scan`
+  (one compiled layer body, O(1) compile time in depth) with optional
+  `jax.checkpoint` rematerialization for HBM.
+- Attention dispatches to the pallas flash kernel on TPU, blockwise scan
+  otherwise (ray_tpu.ops.attention), or ring attention when the mesh has a
+  nontrivial `seq` axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import dot_product_attention
+from ray_tpu.ops.rotary import apply_rope
+from ray_tpu.parallel.sharding import Rules, with_logical_constraint
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    d_ff: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    tie_embeddings: bool = False
+    remat: bool = True
+    attention_impl: Optional[str] = None  # None=auto, see ops.attention
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    # -- presets ---------------------------------------------------------
+    @staticmethod
+    def tiny(**kw) -> "TransformerConfig":
+        """Test-scale model for CPU-mesh tests."""
+        base = dict(
+            vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            d_ff=128, max_seq_len=128, dtype=jnp.float32, remat=False,
+        )
+        base.update(kw)
+        return TransformerConfig(**base)
+
+    @staticmethod
+    def llama_1b(**kw) -> "TransformerConfig":
+        base = dict(
+            vocab_size=32000, d_model=2048, n_layers=16, n_heads=16,
+            n_kv_heads=16, d_ff=5504, max_seq_len=2048,
+        )
+        base.update(kw)
+        return TransformerConfig(**base)
+
+    @staticmethod
+    def llama_7b(**kw) -> "TransformerConfig":
+        """The north-star 7B config (BASELINE.json)."""
+        base = dict(
+            vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
+            n_kv_heads=32, d_ff=11008, max_seq_len=4096,
+        )
+        base.update(kw)
+        return TransformerConfig(**base)
+
+    def num_params(self) -> int:
+        e = self.vocab_size * self.d_model
+        attn = self.d_model * self.head_dim * (2 * self.n_heads + 2 * self.n_kv_heads)
+        mlp = 3 * self.d_model * self.d_ff
+        norms = 2 * self.d_model
+        out = 0 if self.tie_embeddings else self.vocab_size * self.d_model
+        return e + self.n_layers * (attn + mlp + norms) + self.d_model + out
+
+
+def param_axes(config: TransformerConfig) -> Dict:
+    """Pytree of logical-axes tuples, congruent with init_params output."""
+    L = ("layers",)
+    axes = {
+        "embed": {"tokens": ("vocab", "embed")},
+        "layers": {
+            "attn": {
+                "wq": L + ("embed", "heads", "head_dim"),
+                "wk": L + ("embed", "kv_heads", "head_dim"),
+                "wv": L + ("embed", "kv_heads", "head_dim"),
+                "wo": L + ("heads", "head_dim", "embed"),
+            },
+            "mlp": {
+                "w_gate": L + ("embed", "mlp"),
+                "w_up": L + ("embed", "mlp"),
+                "w_down": L + ("mlp", "embed"),
+            },
+            "ln1": L + (None,),
+            "ln2": L + (None,),
+        },
+        "final_norm": (None,),
+    }
+    if not config.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+def init_params(config: TransformerConfig, key: jax.Array) -> Dict:
+    """Initialize the parameter pytree (truncated-normal / scaled init)."""
+    c = config
+    k = iter(jax.random.split(key, 16))
+    pd = c.param_dtype
+
+    def norm_init(kk, shape, scale):
+        return (jax.random.normal(kk, shape, jnp.float32) * scale).astype(pd)
+
+    hd = c.head_dim
+    L = c.n_layers
+    emb_scale = c.d_model ** -0.5
+    proj_scale = c.d_model ** -0.5
+    out_scale = (2 * c.n_layers * c.d_model) ** -0.5  # GPT-2-style depth scaling
+
+    params = {
+        "embed": {"tokens": norm_init(next(k), (c.vocab_size, c.d_model), emb_scale)},
+        "layers": {
+            "attn": {
+                "wq": norm_init(next(k), (L, c.d_model, c.n_heads, hd), proj_scale),
+                "wk": norm_init(next(k), (L, c.d_model, c.n_kv_heads, hd), proj_scale),
+                "wv": norm_init(next(k), (L, c.d_model, c.n_kv_heads, hd), proj_scale),
+                "wo": norm_init(next(k), (L, c.n_heads, hd, c.d_model), out_scale),
+            },
+            "mlp": {
+                "w_gate": norm_init(next(k), (L, c.d_model, c.d_ff), proj_scale),
+                "w_up": norm_init(next(k), (L, c.d_model, c.d_ff), proj_scale),
+                "w_down": norm_init(next(k), (L, c.d_ff, c.d_model), out_scale),
+            },
+            "ln1": jnp.ones((L, c.d_model), pd),
+            "ln2": jnp.ones((L, c.d_model), pd),
+        },
+        "final_norm": jnp.ones((c.d_model,), pd),
+    }
+    if not c.tie_embeddings:
+        params["lm_head"] = norm_init(next(k), (c.d_model, c.vocab_size), emb_scale)
+    return params
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight.astype(x.dtype)
+
+
+def _layer(
+    x: jax.Array,
+    layer_params: Dict,
+    positions: jax.Array,
+    config: TransformerConfig,
+    rules: Optional[Rules],
+    mesh=None,
+):
+    c = config
+
+    def constrain(h, axes):
+        if rules is None:
+            return h
+        return with_logical_constraint(h, axes, rules, mesh)
+
+    dt = c.dtype
+    h = rms_norm(x, layer_params["ln1"], c.norm_eps)
+    q = jnp.einsum("bse,ehd->bshd", h, layer_params["attn"]["wq"].astype(dt))
+    kk = jnp.einsum("bse,ehd->bshd", h, layer_params["attn"]["wk"].astype(dt))
+    vv = jnp.einsum("bse,ehd->bshd", h, layer_params["attn"]["wv"].astype(dt))
+    q = constrain(q, ("act_batch", "act_seq", "act_heads", "act_head_dim"))
+    kk = constrain(kk, ("act_batch", "act_seq", "act_kv_heads", "act_head_dim"))
+    q = apply_rope(q, positions, theta=c.rope_theta)
+    kk = apply_rope(kk, positions, theta=c.rope_theta)
+    attn = dot_product_attention(q, kk, vv, causal=True, impl=c.attention_impl)
+    attn_out = jnp.einsum("bshd,hde->bse", attn, layer_params["attn"]["wo"].astype(dt))
+    x = x + constrain(attn_out, ("act_batch", "act_seq", "act_embed"))
+
+    h = rms_norm(x, layer_params["ln2"], c.norm_eps)
+    gate = jnp.einsum("bse,ef->bsf", h, layer_params["mlp"]["w_gate"].astype(dt))
+    up = jnp.einsum("bse,ef->bsf", h, layer_params["mlp"]["w_up"].astype(dt))
+    ff = constrain(jax.nn.silu(gate) * up, ("act_batch", "act_seq", "act_mlp"))
+    down = jnp.einsum("bsf,fe->bse", ff, layer_params["mlp"]["w_down"].astype(dt))
+    x = x + constrain(down, ("act_batch", "act_seq", "act_embed"))
+    return x
+
+
+def forward(
+    params: Dict,
+    tokens: jax.Array,
+    config: TransformerConfig,
+    *,
+    rules: Optional[Rules] = None,
+    mesh=None,
+) -> jax.Array:
+    """Token ids [B, S] -> logits [B, S, vocab] (f32)."""
+    c = config
+    x = params["embed"]["tokens"].astype(c.dtype)[tokens]
+    if rules is not None:
+        x = with_logical_constraint(x, ("act_batch", "act_seq", "act_embed"), rules, mesh)
+    positions = jnp.arange(tokens.shape[1])
+
+    layer_fn = functools.partial(
+        _layer, positions=positions, config=c, rules=rules, mesh=mesh
+    )
+    if c.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+
+    def scan_body(carry, layer_params):
+        return layer_fn(carry, layer_params), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], c.norm_eps)
+    head = (
+        params["embed"]["tokens"].T if c.tie_embeddings else params["lm_head"]
+    ).astype(c.dtype)
+    logits = jnp.einsum("bse,ev->bsv", x, head).astype(jnp.float32)
+    if rules is not None:
+        logits = with_logical_constraint(
+            logits, ("act_batch", "act_seq", "act_vocab"), rules, mesh
+        )
+    return logits

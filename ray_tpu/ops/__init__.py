@@ -1,0 +1,26 @@
+"""ray_tpu.ops: TPU compute kernels (no counterpart in the reference, which
+delegates all math to torch — SURVEY.md §5.7)."""
+
+from ray_tpu.ops.attention import (
+    blockwise_attention,
+    dot_product_attention,
+    reference_attention,
+)
+from ray_tpu.ops.ring_attention import ring_attention, ring_attention_sharded
+
+__all__ = [
+    "blockwise_attention",
+    "dot_product_attention",
+    "reference_attention",
+    "ring_attention",
+    "ring_attention_sharded",
+    "flash_attention",
+]
+
+
+def __getattr__(name):
+    if name == "flash_attention":
+        from ray_tpu.ops.pallas.flash_attention import flash_attention
+
+        return flash_attention
+    raise AttributeError(name)

@@ -1,0 +1,157 @@
+"""Attention ops: reference jnp, blockwise (flash-semantics) scan, dispatcher.
+
+No counterpart exists in the reference — it delegates all math to torch
+(SURVEY.md §5.7: no attention/sequence-parallel code anywhere in python/ray).
+Built TPU-first: the blockwise form keeps the working set in VMEM-sized tiles
+and is what the pallas kernel (ops/pallas/flash_attention.py) and ring
+attention (ops/ring_attention.py) are built from.
+
+Shapes follow [batch, seq, heads, head_dim] throughout.  GQA is expressed by
+n_kv_heads < n_heads; kv heads are repeated on the fly.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _repeat_kv(k: jax.Array, n_heads: int) -> jax.Array:
+    """[B, S, Hkv, D] -> [B, S, H, D] by repeating groups (GQA)."""
+    n_kv = k.shape[2]
+    if n_kv == n_heads:
+        return k
+    assert n_heads % n_kv == 0
+    return jnp.repeat(k, n_heads // n_kv, axis=2)
+
+
+def reference_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    q_offset: int = 0,
+) -> jax.Array:
+    """O(S^2) materialized-scores attention. Ground truth for tests.
+
+    q_offset: absolute position of q[0] relative to k[0] (decode/ring steps).
+    """
+    b, sq, h, d = q.shape
+    k = _repeat_kv(k, h)
+    v = _repeat_kv(v, h)
+    scale = scale if scale is not None else d ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k)
+    if causal:
+        sk = k.shape[1]
+        qpos = jnp.arange(sq)[:, None] + q_offset
+        kpos = jnp.arange(sk)[None, :]
+        logits = jnp.where(qpos >= kpos, logits, NEG_INF)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+
+
+def blockwise_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_size: int = 512,
+    q_offset: int = 0,
+) -> jax.Array:
+    """Flash-attention semantics in pure JAX: scan over KV blocks with an
+    online softmax, never materializing the [S, S] score matrix.  XLA keeps
+    the per-block compute on the MXU; memory is O(S * block).
+
+    Also the inner step of ring attention, where successive KV blocks arrive
+    over ICI (ops/ring_attention.py).
+    """
+    b, sq, h, d = q.shape
+    k = _repeat_kv(k, h)
+    v = _repeat_kv(v, h)
+    sk = k.shape[1]
+    scale = scale if scale is not None else d ** -0.5
+    if sk % block_size != 0:
+        block_size = sk  # fall back to one block rather than pad
+    n_blocks = sk // block_size
+
+    qf = (q * scale).astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    k_blocks = kf.reshape(b, n_blocks, block_size, h, d).transpose(1, 0, 2, 3, 4)
+    v_blocks = vf.reshape(b, n_blocks, block_size, h, d).transpose(1, 0, 2, 3, 4)
+
+    qpos = jnp.arange(sq) + q_offset
+
+    def step(carry, blk):
+        acc, m, l = carry
+        kb, vb, kpos = blk
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kb)
+        if causal:
+            mask = qpos[:, None] >= kpos[None, :]
+            logits = jnp.where(mask[None, None], logits, NEG_INF)
+        m_blk = jnp.max(logits, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        # Guard: a fully-masked row has logits == m_new == NEG_INF; exp(0)=1
+        # would poison l. Force those probabilities to 0.
+        p = jnp.where(
+            logits <= NEG_INF / 2, 0.0, jnp.exp(logits - m_new[..., None])
+        )
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, vb)
+        return (acc_new, m_new, l_new), None
+
+    kpos_blocks = (jnp.arange(sk).reshape(n_blocks, block_size))
+    acc0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    m0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    (acc, m, l), _ = jax.lax.scan(step, (acc0, m0, l0), (k_blocks, v_blocks, kpos_blocks))
+    out = acc / jnp.maximum(l[..., None], 1e-37)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_size: int = 512,
+    impl: Optional[str] = None,
+) -> jax.Array:
+    """Dispatching attention entry point used by models/.
+
+    impl: None (auto) | "reference" | "blockwise" | "pallas".
+    Auto picks the pallas flash kernel on TPU when shapes are tile-aligned,
+    else the blockwise scan.
+    """
+    if impl is None:
+        if _on_tpu() and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0 and q.shape[-1] % 128 == 0:
+            impl = "pallas"
+        elif q.shape[1] > block_size:
+            impl = "blockwise"
+        else:
+            impl = "reference"
+    if impl == "reference":
+        return reference_attention(q, k, v, causal=causal, scale=scale)
+    if impl == "blockwise":
+        return blockwise_attention(q, k, v, causal=causal, scale=scale, block_size=block_size)
+    if impl == "pallas":
+        from ray_tpu.ops.pallas.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    raise ValueError(f"unknown attention impl {impl!r}")

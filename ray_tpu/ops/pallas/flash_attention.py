@@ -1,0 +1,150 @@
+"""Pallas TPU flash-attention forward kernel.
+
+Forward runs as a pallas kernel (online softmax over KV tiles held in VMEM,
+MXU matmuls in f32 accumulation); backward recomputes through the blockwise
+JAX implementation (ops/attention.py) under jax.custom_vjp — flash-style
+recompute-in-backward, O(S) memory.
+
+On non-TPU backends the kernel runs in interpret mode, so tests on the
+virtual CPU mesh exercise the same code path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool, block_k: int):
+    # Block shapes: q_ref/o_ref [1, 1, bq, D]; k_ref/v_ref [1, 1, Sk, D].
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # [bq, D]
+    bq = q.shape[0]
+    sk = k_ref.shape[2]
+    nk = sk // block_k
+
+    q_start = qi * bq
+
+    def body(i, carry):
+        acc, m, l = carry
+        k = k_ref[0, 0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, block_k]
+        if causal:
+            qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            kpos = i * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            logits = jnp.where(qpos >= kpos, logits, NEG_INF)
+        m_blk = jnp.max(logits, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        p = jnp.where(logits <= NEG_INF / 2, 0.0, jnp.exp(logits - m_new[:, None]))
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return acc_new, m_new, l_new
+
+    acc0 = jnp.zeros((bq, q_ref.shape[3]), jnp.float32)
+    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    if causal:
+        # Only blocks with kpos <= last qpos contribute.
+        n_iter = jnp.minimum(nk, (q_start + bq + block_k - 1) // block_k)
+    else:
+        n_iter = nk
+    acc, m, l = jax.lax.fori_loop(0, n_iter, body, (acc0, m0, l0))
+    o_ref[0, 0] = (acc / jnp.maximum(l[:, None], 1e-37)).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, *, causal, scale, block_q, block_k, interpret):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    # Kernel works in [B, H, S, D].
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    grid = (b, h, sq // block_q)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_k=block_k
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, sk, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, sk, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, scale, block_q, block_k):
+    interpret = jax.default_backend() != "tpu"
+    return _flash_fwd(
+        q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+
+
+def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k):
+    return _flash(q, k, v, causal, scale, block_q, block_k), (q, k, v)
+
+
+def _flash_vjp_bwd(causal, scale, block_q, block_k, res, g):
+    from ray_tpu.ops.attention import blockwise_attention
+
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: blockwise_attention(
+            q_, k_, v_, causal=causal, scale=scale, block_size=block_k
+        ),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> jax.Array:
+    """Flash attention, [B, S, H, D] layout, GQA via repeated kv heads."""
+    h = q.shape[2]
+    if k.shape[2] != h:
+        from ray_tpu.ops.attention import _repeat_kv
+
+        k = _repeat_kv(k, h)
+        v = _repeat_kv(v, h)
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    block_q = min(block_q, q.shape[1])
+    block_k = min(block_k, k.shape[1])
+    if q.shape[1] % block_q or k.shape[1] % block_k:
+        # Tail blocks would be silently dropped by the grid/loop floor
+        # division; use the blockwise scan (same math) for ragged lengths.
+        from ray_tpu.ops.attention import blockwise_attention
+
+        return blockwise_attention(q, k, v, causal=causal, scale=scale)
+    return _flash(q, k, v, causal, scale, block_q, block_k)

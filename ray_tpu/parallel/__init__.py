@@ -1,0 +1,66 @@
+"""ray_tpu.parallel: meshes, sharding rules, and collectives.
+
+TPU-native replacement for the reference's NCCL/GLOO collective layer
+(python/ray/util/collective/) and torch process-group plumbing
+(python/ray/train/torch/config.py) — see SURVEY.md §5.8.
+"""
+
+from ray_tpu.parallel.bootstrap import MeshBootstrap, pick_coordinator_address, setup_mesh
+from ray_tpu.parallel.collectives import (
+    CollectiveGroup,
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    destroy_collective_group,
+    device_allreduce,
+    get_group,
+    init_collective_group,
+    recv,
+    reducescatter,
+    send,
+)
+from ray_tpu.parallel.mesh import (
+    AXIS_ORDER,
+    MeshSpec,
+    build_mesh,
+    mesh_axis_sizes,
+    single_device_mesh,
+)
+from ray_tpu.parallel.sharding import (
+    PRESETS,
+    Rules,
+    logical_to_spec,
+    resolve_rules,
+    tree_shardings,
+    with_logical_constraint,
+)
+
+__all__ = [
+    "AXIS_ORDER",
+    "MeshSpec",
+    "MeshBootstrap",
+    "CollectiveGroup",
+    "PRESETS",
+    "Rules",
+    "allgather",
+    "allreduce",
+    "barrier",
+    "broadcast",
+    "build_mesh",
+    "destroy_collective_group",
+    "device_allreduce",
+    "get_group",
+    "init_collective_group",
+    "logical_to_spec",
+    "mesh_axis_sizes",
+    "pick_coordinator_address",
+    "recv",
+    "reducescatter",
+    "resolve_rules",
+    "send",
+    "setup_mesh",
+    "single_device_mesh",
+    "tree_shardings",
+    "with_logical_constraint",
+]

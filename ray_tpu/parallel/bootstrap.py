@@ -1,0 +1,66 @@
+"""Mesh bootstrap: get N host actors into one multi-host XLA computation.
+
+The reference's analogue is torch process-group setup driven by Ray Train
+(_setup_torch_process_group, python/ray/train/torch/config.py:69 — rank-0
+address broadcast over actor RPC, then dist.init_process_group :113).  The
+TPU-native version: rank 0 of a worker group publishes a coordinator address;
+every host calls jax.distributed.initialize(coordinator, num_processes,
+process_id); after that, jax.devices() spans the whole slice and a single
+pjit'ed program runs SPMD across hosts with ICI collectives compiled in.
+
+On a single host (or CPU-virtual-device testing) initialize() is skipped and
+the local devices already form the full mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import socket
+from typing import Optional
+
+from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+
+
+@dataclasses.dataclass
+class MeshBootstrap:
+    """Per-process description of how to join the global mesh."""
+
+    num_processes: int = 1
+    process_id: int = 0
+    coordinator_address: Optional[str] = None  # "host:port", required if >1 proc
+    local_device_ids: Optional[list] = None
+
+    def initialize(self):
+        """Join the multi-host XLA runtime. Idempotent; no-op single-process."""
+        if self.num_processes <= 1:
+            return
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=self.coordinator_address,
+            num_processes=self.num_processes,
+            process_id=self.process_id,
+            local_device_ids=self.local_device_ids,
+        )
+
+
+def pick_coordinator_address(port: int = 0) -> str:
+    """Choose a reachable coordinator address on this host (rank-0 side)."""
+    host = os.environ.get("RAY_TPU_HOST_IP") or socket.gethostbyname(socket.gethostname())
+    if port == 0:
+        with socket.socket() as s:
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+    return f"{host}:{port}"
+
+
+def setup_mesh(
+    spec: Optional[MeshSpec] = None,
+    bootstrap: Optional[MeshBootstrap] = None,
+):
+    """Initialize (maybe multi-host) XLA and build the mesh. The worker-group
+    entry point used by train/backend_jax.py."""
+    if bootstrap is not None:
+        bootstrap.initialize()
+    return build_mesh(spec)

@@ -8,9 +8,23 @@ ray_start_regular / ray_start_cluster (ray: python/ray/tests/conftest.py:305,386
 
 import os
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force-override: the outer environment pins JAX_PLATFORMS to the real TPU
+# tunnel; unit tests always run on the virtual 8-device CPU mesh.  The env var
+# alone is not honored once the TPU PJRT plugin is registered, so also flip
+# the config knob post-import (before any backend is initialized).
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# Spawned worker processes inherit this env.  Dropping the axon-TPU hook
+# keeps CPU-only test workers from paying its ~2s sitecustomize jax import
+# on every boot (tests never touch the real chip).
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

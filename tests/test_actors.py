@@ -160,9 +160,10 @@ def test_threaded_actor(ray_start_regular):
             return 1
 
     s = Sleeper.remote()
+    ray_tpu.get(s.nap.remote(), timeout=30)  # warm up: actor worker boot
     t0 = time.monotonic()
     assert sum(ray_tpu.get([s.nap.remote() for _ in range(4)], timeout=20)) == 4
-    assert time.monotonic() - t0 < 1.1
+    assert time.monotonic() - t0 < 1.1  # 4 overlapped naps ≪ 1.2s serial
 
 
 def test_actor_pending_calls_queued_before_alive(ray_start_regular):

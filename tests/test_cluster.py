@@ -33,7 +33,7 @@ def test_spread_across_nodes(ray_start_cluster):
         time.sleep(0.1)
         return 1
 
-    assert sum(ray_tpu.get([f.remote() for _ in range(6)], timeout=30)) == 6
+    assert sum(ray_tpu.get([f.remote() for _ in range(6)], timeout=90)) == 6
 
 
 def test_custom_resource_on_remote_node(ray_start_cluster):

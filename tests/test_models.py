@@ -1,0 +1,79 @@
+"""Model + sharded train-step tests on the virtual 8-device CPU mesh.
+
+The multi-strategy matrix (dp/fsdp/tp/fsdp_tp) is the TPU analogue of the
+reference's DDP-vs-FSDP wrapper tests (ray: python/ray/train/tests/
+test_torch_fsdp.py) — same model, different sharding rules, loss must agree.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import LMTrainContext, TransformerConfig, forward, init_params
+from ray_tpu.parallel import MeshSpec, build_mesh
+
+
+CFG = TransformerConfig.tiny()
+
+
+def _batch(key, b=8, s=32, vocab=CFG.vocab_size):
+    toks = jax.random.randint(key, (b, s + 1), 0, vocab)
+    return {"tokens": toks[:, :-1], "targets": toks[:, 1:]}
+
+
+def test_forward_shapes():
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    logits = forward(params, jnp.zeros((2, 16), jnp.int32), CFG)
+    assert logits.shape == (2, 16, CFG.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_param_count_matches_config():
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    n = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    assert n == CFG.num_params()
+
+
+@pytest.mark.parametrize(
+    "strategy,spec",
+    [
+        ("dp", MeshSpec(data=8)),
+        ("fsdp", MeshSpec(data=2, fsdp=4)),
+        ("tp", MeshSpec(data=2, tensor=4)),
+        ("fsdp_tp", MeshSpec(data=2, fsdp=2, tensor=2)),
+    ],
+)
+def test_train_step_strategies_agree(strategy, spec):
+    """Same seed + batch under every strategy → same loss trajectory."""
+    mesh = build_mesh(spec)
+    ctx = LMTrainContext(CFG, mesh=mesh, strategy=strategy)
+    state = ctx.init_state(seed=0)
+    batch = _batch(jax.random.PRNGKey(42))
+    losses = []
+    for _ in range(2):
+        state, metrics = ctx.train_step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[1] < losses[0]  # one step of adam on repeated batch improves
+    # Ground truth from single-device run.
+    mesh1 = build_mesh(MeshSpec(data=1), devices=jax.devices()[:1])
+    ctx1 = LMTrainContext(CFG, mesh=mesh1, strategy="dp")
+    state1 = ctx1.init_state(seed=0)
+    _, m1 = ctx1.train_step(state1, batch)
+    np.testing.assert_allclose(losses[0], float(m1["loss"]), rtol=1e-4)
+
+
+def test_sequence_parallel_forward():
+    """seq-sharded forward w/ ring attention matches unsharded forward."""
+    cfg = TransformerConfig.tiny(attention_impl="reference")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, cfg.vocab_size)
+    ref = forward(params, toks, cfg)
+
+    from ray_tpu.parallel import resolve_rules
+
+    mesh = build_mesh(MeshSpec(data=2, seq=4))
+    rules = resolve_rules("sp")
+    with mesh:
+        out = jax.jit(lambda p, t: forward(p, t, cfg, rules=rules))(params, toks)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=1e-4, rtol=1e-4)

@@ -1,0 +1,90 @@
+"""Regression tests for review findings (round 1).
+
+Mirrors the reference's targeted failure tests (ray: python/ray/tests/
+test_actor_failures.py, test_reference_counting*.py).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_kill_pending_actor_not_resurrected(ray_start_regular):
+    """kill() on a not-yet-scheduled actor must cancel its creation task
+    (previously the queued creation resurrected the actor to ALIVE)."""
+
+    @ray_tpu.remote(num_cpus=4)
+    class Hog:
+        def ping(self):
+            return "pong"
+
+    @ray_tpu.remote(num_cpus=4)
+    class Pending:
+        def ping(self):
+            return "pong"
+
+    hog = Hog.remote()
+    ray_tpu.get(hog.ping.remote(), timeout=30)  # occupies all 4 CPUs
+    pending = Pending.remote()  # cannot schedule while hog lives
+    ray_tpu.kill(pending)
+    ray_tpu.kill(hog)
+    time.sleep(0.5)  # let resources free + dispatch run
+    with pytest.raises(ray_tpu.exceptions.ActorDiedError):
+        ray_tpu.get(pending.ping.remote(), timeout=10)
+
+
+def test_exit_actor_from_concurrent_actor(ray_start_regular):
+    """exit_actor() inside a max_concurrency>1 actor must terminate the
+    process (previously SystemExit was swallowed by the thread pool)."""
+
+    @ray_tpu.remote(max_concurrency=4)
+    class C:
+        def stop(self):
+            ray_tpu.exit_actor()
+
+        def ping(self):
+            return "pong"
+
+    c = C.remote()
+    assert ray_tpu.get(c.ping.remote(), timeout=30) == "pong"
+    c.stop.remote()
+    time.sleep(1.0)
+    with pytest.raises(ray_tpu.exceptions.ActorDiedError):
+        ray_tpu.get(c.ping.remote(), timeout=10)
+
+
+def test_flash_attention_ragged_lengths():
+    """Non-block-divisible sequence lengths must not silently drop tails."""
+    import jax
+
+    from ray_tpu.ops.attention import reference_attention
+    from ray_tpu.ops.pallas.flash_attention import flash_attention
+
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (1, 192, 2, 32))
+    k = jax.random.normal(kk, (1, 192, 2, 32))
+    v = jax.random.normal(kv, (1, 192, 2, 32))
+    ref = reference_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, block_q=128, block_k=128)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-5, rtol=2e-5)
+
+
+def test_train_step_with_mask():
+    """Batches may carry an optional loss mask."""
+    import jax
+
+    from ray_tpu.models import LMTrainContext, TransformerConfig
+    from ray_tpu.parallel import MeshSpec, build_mesh
+
+    cfg = TransformerConfig.tiny()
+    ctx = LMTrainContext(cfg, mesh=build_mesh(MeshSpec(data=8)), strategy="dp")
+    state = ctx.init_state(seed=0)
+    toks = jax.random.randint(jax.random.PRNGKey(0), (8, 17), 0, cfg.vocab_size)
+    mask = (jax.numpy.arange(16)[None, :] < 10).astype(np.float32).repeat(8, axis=0)
+    batch = {"tokens": toks[:, :-1], "targets": toks[:, 1:], "mask": mask}
+    state, metrics = ctx.train_step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
