@@ -214,7 +214,7 @@ class Runtime:
         # interpreter start, so overlap that cost with driver setup.
         with self.lock:
             for _ in range(min(int(self.state.nodes[self.head_node_id].resources.get("CPU", 0)), 8)):
-                self._spawn_worker(self.head_node_id, None, None)
+                self._spawn_worker(self.head_node_id, None, None, prestart=True)
 
     # ------------------------------------------------------------------
     # refcounting (owner side)
@@ -245,7 +245,7 @@ class Runtime:
     # ------------------------------------------------------------------
     # worker pool (ray: src/ray/raylet/worker_pool.h:156)
 
-    def _spawn_worker(self, node_id: str, env_key, env_vars) -> WorkerHandle:
+    def _spawn_worker(self, node_id: str, env_key, env_vars, prestart: bool = False) -> WorkerHandle:
         # Workers are exec'ed as fresh interpreters (`python -m ..worker_proc`)
         # rather than multiprocessing children: mp's spawn/forkserver children
         # re-import the driver's __main__ module during bootstrap, which
@@ -270,9 +270,18 @@ class Runtime:
                 "RAY_TPU_ENV_VARS": json.dumps(env_vars or {}),
             }
         )
-        # Make ray_tpu importable in the child regardless of driver cwd.
+        # runtime_env vars must exist at interpreter start (sitecustomize may
+        # import jax before worker_main applies them).
+        env.update({k: str(v) for k, v in (env_vars or {}).items()})
+        # Workers inherit the driver's module search path (so driver-side
+        # modules — e.g. pytest-inserted test dirs — resolve on import;
+        # the reference equivalently execs workers with the driver's
+        # PYTHONPATH), plus the ray_tpu package root regardless of cwd.
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        paths = [pkg_root] + [p for p in sys.path if p] + (
+            env.get("PYTHONPATH", "").split(os.pathsep) if env.get("PYTHONPATH") else []
+        )
+        env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(paths))
         popen = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_proc"],
             env=env,
@@ -281,7 +290,10 @@ class Runtime:
         proc = _PopenHandle(popen)
         handle = WorkerHandle(wid, node_id, env_key, env_vars, proc)
         self.workers[wid] = handle
-        self.starting_pool.setdefault((node_id, env_key), []).append(wid)
+        if prestart:
+            # Only unleased spawns are advertised as leasable; a demand spawn
+            # is handed straight to its task.
+            self.starting_pool.setdefault((node_id, env_key), []).append(wid)
         return handle
 
     def _lease_worker(self, node_id: str, spec: TaskSpec) -> WorkerHandle:

@@ -1,0 +1,150 @@
+"""Checkpoint: morphable dict/directory checkpoint container.
+
+ray: python/ray/air/checkpoint.py:63 — the reference's Checkpoint
+interconverts dict/dir/URI/object-ref forms.  TPU-native additions: jax
+pytrees are first-class (saved via orbax when materialized to a directory),
+and sharded arrays are gathered/resharded through the mesh on load, so a
+checkpoint written under one parallelism strategy restores under another.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import tempfile
+import uuid
+from contextlib import contextmanager
+from typing import Any, Dict, Optional
+
+
+class Checkpoint:
+    """A checkpoint is either an in-memory dict or an on-disk directory."""
+
+    _DICT_FILE = "checkpoint.pkl"
+
+    def __init__(
+        self,
+        data: Optional[Dict[str, Any]] = None,
+        directory: Optional[str] = None,
+    ):
+        if (data is None) == (directory is None):
+            raise ValueError("provide exactly one of data= or directory=")
+        self._data = data
+        self._dir = directory
+        self.id = uuid.uuid4().hex[:8]
+
+    # -- constructors -----------------------------------------------------
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
+        return cls(data=dict(data))
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(directory=str(path))
+
+    @classmethod
+    def from_jax_state(cls, state, **extra) -> "Checkpoint":
+        """Checkpoint a jax pytree (host-fetched, strategy-agnostic)."""
+        import jax
+
+        host_state = jax.tree_util.tree_map(
+            lambda x: _to_host(x), state
+        )
+        return cls.from_dict({"jax_state": host_state, **extra})
+
+    # -- accessors --------------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        if self._data is not None:
+            return self._data
+        fp = os.path.join(self._dir, self._DICT_FILE)
+        if os.path.exists(fp):
+            with open(fp, "rb") as f:
+                return pickle.load(f)
+        # orbax-format directory
+        state = _orbax_restore(self._dir)
+        return {"jax_state": state}
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        path = path or tempfile.mkdtemp(prefix="raytpu-ckpt-")
+        os.makedirs(path, exist_ok=True)
+        if self._dir is not None:
+            if os.path.abspath(self._dir) != os.path.abspath(path):
+                shutil.copytree(self._dir, path, dirs_exist_ok=True)
+            return path
+        data = dict(self._data)
+        state = data.pop("jax_state", None)
+        if state is not None:
+            _orbax_save(os.path.join(path, "state"), state)
+        with open(os.path.join(path, self._DICT_FILE), "wb") as f:
+            pickle.dump(data, f)
+        return path
+
+    @contextmanager
+    def as_directory(self):
+        owned = self._dir is None
+        path = self.to_directory()
+        try:
+            yield path
+        finally:
+            if owned:
+                shutil.rmtree(path, ignore_errors=True)
+
+    def get_jax_state(self, target=None, shardings=None):
+        """Restore the saved pytree; with shardings, device_put each leaf to
+        the requested layout (cross-strategy restore)."""
+        d = self.to_dict()
+        state = d.get("jax_state")
+        if state is None and self._dir is not None:
+            state = _orbax_restore(os.path.join(self._dir, "state"))
+        if state is None:
+            raise ValueError("checkpoint holds no jax state")
+        if shardings is not None:
+            import jax
+
+            state = jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(x, s), state, shardings
+            )
+        return state
+
+    def __repr__(self):
+        kind = "dict" if self._data is not None else f"dir={self._dir!r}"
+        return f"Checkpoint({kind}, id={self.id})"
+
+
+def _to_host(x):
+    import jax
+    import numpy as np
+
+    if isinstance(x, jax.Array):
+        if hasattr(x, "is_fully_addressable") and not x.is_fully_addressable:
+            # Multi-host sharded array: gather the full value to every host.
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        return np.asarray(x)
+    return x
+
+
+def _orbax_save(path: str, state) -> None:
+    try:
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(os.path.abspath(path), state, force=True)
+    except Exception:
+        # orbax unavailable/incompatible: fall back to pickle
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "state.pkl"), "wb") as f:
+            pickle.dump(state, f)
+
+
+def _orbax_restore(path: str):
+    pkl = os.path.join(path, "state.pkl")
+    if os.path.exists(pkl):
+        with open(pkl, "rb") as f:
+            return pickle.load(f)
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.PyTreeCheckpointer()
+    return ckptr.restore(os.path.abspath(path))

@@ -1,0 +1,62 @@
+"""AIR-style configs (ray: python/ray/air/config.py).
+
+ScalingConfig gains TPU-native fields: instead of "num GPUs per worker" the
+unit is chips per host-worker plus an optional mesh hint that the Train
+backend turns into the global jax mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many train-worker actors, with what per-worker resources.
+
+    ray: python/ray/air/config.py ScalingConfig (num_workers,
+    use_gpu/resources_per_worker); TPU-native: chips_per_worker reserves the
+    "TPU" resource, mesh_shape optionally fixes the global MeshSpec.
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    chips_per_worker: int = 0
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    mesh_shape: Optional[Dict[str, int]] = None  # MeshSpec kwargs
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {"CPU": 1.0})
+        if self.use_tpu and self.chips_per_worker:
+            res["TPU"] = float(self.chips_per_worker)
+        return res
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """ray: python/ray/air/config.py FailureConfig."""
+
+    max_failures: int = 0  # group restarts before giving up; -1 = unlimited
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    """ray: python/ray/air/config.py CheckpointConfig."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+
+@dataclasses.dataclass
+class RunConfig:
+    """ray: python/ray/air/config.py RunConfig."""
+
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: Optional[FailureConfig] = None
+    checkpoint_config: Optional[CheckpointConfig] = None
+    verbose: int = 1
+    callbacks: Optional[list] = None

@@ -133,13 +133,24 @@ class LMTrainContext:
         with self.mesh:
             return self._init(jax.random.PRNGKey(seed))
 
+    def make_batch(self, batch) -> Dict[str, jax.Array]:
+        """Shard a host batch (pytree of [B, S] numpy arrays, every process
+        holding the same global batch) onto the mesh.  make_array_from_callback
+        hands each device its shard, which also works when the mesh spans
+        processes (multi-host SPMD)."""
+        import numpy as np
+
+        def put(x):
+            x = np.asarray(x)
+            return jax.make_array_from_callback(
+                x.shape, self.batch_sharding, lambda idx: x[idx]
+            )
+
+        return jax.tree_util.tree_map(put, batch)
+
     def train_step(self, state, batch) -> Tuple[Dict, Dict]:
-        # Shard the batch host-side (any pytree of [B, S] arrays, e.g. with
-        # an optional "mask" key) instead of pinning its structure in
-        # in_shardings.
-        batch = jax.tree_util.tree_map(
-            lambda x: jax.device_put(x, self.batch_sharding), batch
-        )
+        if not all(isinstance(x, jax.Array) for x in jax.tree_util.tree_leaves(batch)):
+            batch = self.make_batch(batch)
         with self.mesh:
             state, metrics = self._train_step(state, batch)
         return state, metrics

@@ -30,7 +30,7 @@ _REDUCE_OPS = {
 }
 
 
-@ray_tpu.remote
+@ray_tpu.remote(num_cpus=0)
 class _GroupCoordinator:
     """Named rendezvous actor holding per-collective state.
 
@@ -144,7 +144,7 @@ class CollectiveGroup:
             time.sleep(0.001)
 
 
-_local = threading.local()
+_registry: Dict[str, "CollectiveGroup"] = {}
 _groups_lock = threading.Lock()
 
 
@@ -181,9 +181,7 @@ def init_collective_group(
 
 
 def _groups() -> Dict[str, CollectiveGroup]:
-    if not hasattr(_local, "groups"):
-        _local.groups = {}
-    return _local.groups
+    return _registry
 
 
 def get_group(group_name: str = "default") -> CollectiveGroup:

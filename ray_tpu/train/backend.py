@@ -1,0 +1,113 @@
+"""Train backends: per-framework worker-group setup.
+
+ray: python/ray/train/backend.py (Backend/BackendConfig) and
+train/torch/config.py:69 (_setup_torch_process_group — rank-0 address
+broadcast, then dist.init_process_group :113).  TPU-native: the process
+group IS the XLA runtime — JaxConfig's on_start picks a coordinator on rank
+0 and every worker calls jax.distributed.initialize, after which one pjit
+program spans all workers' chips over ICI/DCN.  No NCCL library, no wrapper:
+collectives are compiled (SURVEY.md §5.8).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ray_tpu.train.worker_group import WorkerGroup
+
+
+@dataclasses.dataclass
+class BackendConfig:
+    """Base backend config (ray: python/ray/train/backend.py)."""
+
+    def backend_cls(self):
+        return Backend
+
+
+class Backend:
+    """Framework setup/teardown hooks around a WorkerGroup."""
+
+    def on_start(self, worker_group: WorkerGroup, backend_config: "BackendConfig"):
+        pass
+
+    def on_shutdown(self, worker_group: WorkerGroup, backend_config: "BackendConfig"):
+        pass
+
+
+@dataclasses.dataclass
+class JaxConfig(BackendConfig):
+    """SPMD mesh bootstrap over the worker group.
+
+    coordinator_port 0 = pick a free port on rank 0's host.
+    platform: force a jax platform in workers (tests use "cpu").
+    """
+
+    coordinator_port: int = 0
+    platform: Optional[str] = None
+
+    def backend_cls(self):
+        return _JaxBackend
+
+
+def _pick_coordinator(port: int) -> str:
+    from ray_tpu.parallel.bootstrap import pick_coordinator_address
+
+    return pick_coordinator_address(port)
+
+
+def _init_jax_distributed(coordinator: str, world_size: int, rank: int, platform):
+    import os
+
+    if platform:
+        os.environ["JAX_PLATFORMS"] = platform
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    if world_size > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=world_size,
+            process_id=rank,
+        )
+    return {
+        "rank": rank,
+        "global_devices": len(jax.devices()),
+        "local_devices": jax.local_device_count(),
+    }
+
+
+class _JaxBackend(Backend):
+    def on_start(self, worker_group: WorkerGroup, backend_config: JaxConfig):
+        coordinator = worker_group.execute_single(
+            0, _pick_coordinator, backend_config.coordinator_port, timeout=60
+        )
+        # All workers join the XLA coordination service (the analogue of the
+        # reference broadcasting rank-0's addr then init_process_group).
+        return self._start_all(worker_group, coordinator, backend_config)
+
+    @staticmethod
+    def _start_all(worker_group: WorkerGroup, coordinator: str, cfg: JaxConfig):
+        import ray_tpu
+
+        n = worker_group.num_workers
+        refs = [
+            w.run_fn.remote(_init_jax_distributed, coordinator, n, i, cfg.platform)
+            for i, w in enumerate(worker_group.workers)
+        ]
+        return ray_tpu.get(refs, timeout=300)
+
+    def on_shutdown(self, worker_group: WorkerGroup, backend_config: JaxConfig):
+        def _shut():
+            import jax
+
+            try:
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+
+        try:
+            worker_group.execute(_shut, timeout=30)
+        except Exception:
+            pass

@@ -1,0 +1,122 @@
+"""BackendExecutor: owns the worker group + backend lifecycle and the
+training poll loop.
+
+ray: python/ray/train/_internal/backend_executor.py:43 (start :94,
+start_training :315).  Differences by design: reports are pulled via actor
+polling (the worker actors run the blocking train fn in one concurrency slot
+and answer poll() in the other), and failure handling restarts the WHOLE
+group — an SPMD mesh program cannot lose a single rank (SURVEY.md §7
+"SPMD meets actors").
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import ScalingConfig
+from ray_tpu.train.backend import BackendConfig
+from ray_tpu.train.worker_group import WorkerGroup
+
+
+class TrainingFailedError(RuntimeError):
+    pass
+
+
+class BackendExecutor:
+    def __init__(
+        self,
+        backend_config: BackendConfig,
+        scaling_config: Optional[ScalingConfig] = None,
+    ):
+        self.backend_config = backend_config
+        self.backend = backend_config.backend_cls()()
+        self.scaling = scaling_config or ScalingConfig()
+        self.worker_group: Optional[WorkerGroup] = None
+        self._pg = None
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self):
+        sc = self.scaling
+        if sc.num_workers > 1:
+            # Gang-reserve the workers' resources (ray: Train reserves a PG
+            # per trial via Tune — base_trainer.py:52 path).
+            from ray_tpu.util.placement_group import placement_group
+
+            bundles = [sc.worker_resources() for _ in range(sc.num_workers)]
+            self._pg = placement_group(bundles, strategy=sc.placement_strategy)
+            self._pg.wait(timeout_seconds=60)
+        self.worker_group = WorkerGroup(
+            sc.num_workers, sc.worker_resources(), placement_group=self._pg
+        )
+        self.backend.on_start(self.worker_group, self.backend_config)
+
+    def shutdown(self):
+        if self.worker_group is not None:
+            try:
+                self.backend.on_shutdown(self.worker_group, self.backend_config)
+            except Exception:
+                pass
+            self.worker_group.shutdown()
+            self.worker_group = None
+        if self._pg is not None:
+            from ray_tpu.util.placement_group import remove_placement_group
+
+            try:
+                remove_placement_group(self._pg)
+            except Exception:
+                pass
+            self._pg = None
+
+    # -- training ---------------------------------------------------------
+    def run_training(
+        self,
+        train_fn: Callable,
+        config: Optional[Dict[str, Any]] = None,
+        resume_checkpoint: Optional[Checkpoint] = None,
+        on_report: Optional[Callable[[int, Dict], None]] = None,
+        poll_interval: float = 0.05,
+    ) -> List[Dict[str, Any]]:
+        """Run train_fn on all workers; stream reports; return each rank's
+        report list.  Raises TrainingFailedError on any rank failure."""
+        wg = self.worker_group
+        assert wg is not None, "call start() first"
+        done_refs = [
+            w.run_train_fn.remote(train_fn, config, resume_checkpoint)
+            for w in wg.workers
+        ]
+        all_reports: List[List[Dict]] = [[] for _ in wg.workers]
+        finished = [False] * len(wg.workers)
+        error: Optional[BaseException] = None
+        while not all(finished) and error is None:
+            time.sleep(poll_interval)
+            polls = ray_tpu.get(
+                [w.poll.remote() for w in wg.workers], timeout=60
+            )
+            for i, p in enumerate(polls):
+                for rep in p["reports"]:
+                    all_reports[i].append(rep)
+                    if on_report is not None:
+                        on_report(i, rep)
+            # completion/errors via the run refs (non-blocking check)
+            ready, _ = ray_tpu.wait(done_refs, num_returns=len(done_refs), timeout=0)
+            for i, r in enumerate(done_refs):
+                if r in ready and not finished[i]:
+                    try:
+                        ray_tpu.get(r, timeout=1)
+                        finished[i] = True
+                    except Exception as e:
+                        error = e
+                        break
+        if error is not None:
+            raise TrainingFailedError(str(error)) from error
+        # final drain
+        polls = ray_tpu.get([w.poll.remote() for w in wg.workers], timeout=60)
+        for i, p in enumerate(polls):
+            for rep in p["reports"]:
+                all_reports[i].append(rep)
+                if on_report is not None:
+                    on_report(i, rep)
+        return all_reports

@@ -1,0 +1,105 @@
+"""DataParallelTrainer: N SPMD worker actors run one train function.
+
+ray: python/ray/train/data_parallel_trainer.py:56 (DataParallelTrainer,
+training_loop :385) + base_trainer.py:52/:538 (fit).  Simplifications by
+design: fit() drives the BackendExecutor directly (the reference wraps every
+trainer in a Tune Tuner even for a single run); Tune integration comes via
+ray_tpu.tune wrapping the trainer instead — one direction, not a cycle.
+
+Failure model (SURVEY.md §7 hard parts): a rank failure kills the SPMD
+program, so FailureConfig.max_failures restarts the WHOLE worker group from
+the latest checkpoint — elastic re-mesh, not per-worker restart.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+from ray_tpu.air.result import Result
+from ray_tpu.train.backend import BackendConfig, JaxConfig
+from ray_tpu.train.backend_executor import BackendExecutor, TrainingFailedError
+
+
+class DataParallelTrainer:
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        backend_config: Optional[BackendConfig] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config
+        self.backend_config = backend_config or JaxConfig()
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    def fit(self) -> Result:
+        import ray_tpu
+
+        ray_tpu._auto_init()
+        failure = self.run_config.failure_config or FailureConfig()
+        ckpt_cfg = self.run_config.checkpoint_config or CheckpointConfig()
+        attempts_left = failure.max_failures
+        latest_ckpt = self.resume_from_checkpoint
+        history: list = []
+        last_error: Optional[Exception] = None
+
+        while True:
+            executor = BackendExecutor(self.backend_config, self.scaling_config)
+            try:
+                executor.start()
+
+                def on_report(rank: int, rep: Dict):
+                    nonlocal latest_ckpt
+                    if rank == 0:
+                        history.append(rep["metrics"])
+                    if rep.get("checkpoint") is not None:
+                        latest_ckpt = rep["checkpoint"]
+
+                reports = executor.run_training(
+                    self.train_loop_per_worker,
+                    config=self.train_loop_config,
+                    resume_checkpoint=latest_ckpt,
+                    on_report=on_report,
+                )
+                metrics = history[-1] if history else {}
+                return Result(
+                    metrics=metrics,
+                    checkpoint=latest_ckpt,
+                    metrics_history=history,
+                )
+            except TrainingFailedError as e:
+                last_error = e
+                if attempts_left == 0:
+                    return Result(
+                        metrics=history[-1] if history else None,
+                        checkpoint=latest_ckpt,
+                        error=e,
+                        metrics_history=history,
+                    )
+                if attempts_left > 0:
+                    attempts_left -= 1
+                # group restart from latest checkpoint (elastic re-mesh)
+            finally:
+                executor.shutdown()
+
+
+class JaxTrainer(DataParallelTrainer):
+    """Sugar: DataParallelTrainer with the SPMD mesh backend preconfigured.
+
+    The TPU-native answer to the reference's TorchTrainer
+    (ray: python/ray/train/torch/torch_trainer.py): instead of wrapping the
+    model in DDP, the train loop builds a global mesh (jax.devices() spans
+    every worker after backend setup) and pjits its step.
+    """
+
+    def __init__(self, train_loop_per_worker: Callable, **kwargs):
+        kwargs.setdefault("backend_config", JaxConfig())
+        super().__init__(train_loop_per_worker, **kwargs)

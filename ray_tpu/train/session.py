@@ -1,0 +1,92 @@
+"""Per-worker train session: report queue + rank info.
+
+ray: python/ray/train/_internal/session.py:63 (_TrainSession, report queue
+:120/:171) and python/ray/air/session.py (the user-facing facade).  The user
+train loop calls session.report(metrics, checkpoint=...) — reports buffer in
+the worker actor and are drained by the driver's BackendExecutor poll loop.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+
+_session: Optional["TrainSession"] = None
+
+
+class TrainSession:
+    def __init__(
+        self,
+        rank: int,
+        world_size: int,
+        local_rank: int = 0,
+        resume_checkpoint: Optional[Checkpoint] = None,
+        experiment_name: str = "train",
+    ):
+        self.rank = rank
+        self.world_size = world_size
+        self.local_rank = local_rank
+        self.resume_checkpoint = resume_checkpoint
+        self.experiment_name = experiment_name
+        self._lock = threading.Lock()
+        self._reports: List[Dict[str, Any]] = []
+        self.done = False
+        self.error: Optional[BaseException] = None
+
+    def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
+        with self._lock:
+            self._reports.append({"metrics": dict(metrics), "checkpoint": checkpoint})
+
+    def drain(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            out = self._reports
+            self._reports = []
+            return out
+
+
+def init_session(**kwargs) -> TrainSession:
+    global _session
+    _session = TrainSession(**kwargs)
+    return _session
+
+
+def get_session() -> TrainSession:
+    if _session is None:
+        raise RuntimeError(
+            "No train session active — this API must run inside a train worker"
+        )
+    return _session
+
+
+def shutdown_session():
+    global _session
+    _session = None
+
+
+# -- user-facing facade (ray: python/ray/air/session.py) -------------------
+
+
+def report(metrics: Dict[str, Any], *, checkpoint: Optional[Checkpoint] = None) -> None:
+    get_session().report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return get_session().resume_checkpoint
+
+
+def get_world_rank() -> int:
+    return get_session().rank
+
+
+def get_world_size() -> int:
+    return get_session().world_size
+
+
+def get_local_rank() -> int:
+    return get_session().local_rank
+
+
+def get_experiment_name() -> str:
+    return get_session().experiment_name
