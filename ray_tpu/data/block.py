@@ -1,0 +1,99 @@
+"""Blocks: the unit of distributed data (ray: python/ray/data/block.py).
+
+A block is a list of rows (any Python objects; commonly dicts for tabular
+data) stored as one object in the object store.  BlockAccessor converts
+between row and batch ("numpy" dict-of-arrays / "pandas" / "pyarrow")
+formats at the edges; internally everything moves as row lists, which keeps
+the execution engine format-agnostic.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional
+
+Block = List[Any]
+
+
+class BlockAccessor:
+    def __init__(self, block: Block):
+        self.block = block
+
+    def num_rows(self) -> int:
+        return len(self.block)
+
+    def to_rows(self) -> List[Any]:
+        return self.block
+
+    def to_batch(self, batch_format: str = "numpy"):
+        rows = self.block
+        if batch_format in ("numpy", "dict"):
+            return rows_to_numpy_batch(rows)
+        if batch_format == "pandas":
+            import pandas as pd
+
+            if rows and isinstance(rows[0], dict):
+                return pd.DataFrame(rows)
+            return pd.DataFrame({"value": rows})
+        if batch_format == "pyarrow":
+            import pyarrow as pa
+
+            if rows and isinstance(rows[0], dict):
+                return pa.Table.from_pylist(rows)
+            return pa.table({"value": rows})
+        raise ValueError(f"unknown batch_format {batch_format!r}")
+
+    def schema(self):
+        if not self.block:
+            return None
+        row = self.block[0]
+        if isinstance(row, dict):
+            return {k: type(v).__name__ for k, v in row.items()}
+        return type(row).__name__
+
+
+def rows_to_numpy_batch(rows: List[Any]) -> Dict[str, Any]:
+    import numpy as np
+
+    if rows and isinstance(rows[0], dict):
+        keys = rows[0].keys()
+        return {k: np.asarray([r[k] for r in rows]) for k in keys}
+    return {"value": np.asarray(rows)}
+
+
+def batch_to_rows(batch: Any) -> List[Any]:
+    """Invert to_batch for any supported batch format."""
+    import numpy as np
+
+    if isinstance(batch, dict):
+        keys = list(batch.keys())
+        if not keys:
+            return []
+        n = len(batch[keys[0]])
+        if keys == ["value"]:
+            return [batch["value"][i] for i in range(n)]
+        return [{k: _unwrap(batch[k][i]) for k in keys} for i in range(n)]
+    if isinstance(batch, list):
+        return batch
+    try:
+        import pandas as pd
+
+        if isinstance(batch, pd.DataFrame):
+            return batch.to_dict("records")
+    except ImportError:
+        pass
+    try:
+        import pyarrow as pa
+
+        if isinstance(batch, pa.Table):
+            return batch.to_pylist()
+    except ImportError:
+        pass
+    raise TypeError(f"unsupported batch type {type(batch)}")
+
+
+def _unwrap(x):
+    import numpy as np
+
+    if isinstance(x, np.generic):
+        return x.item()
+    return x

@@ -1,0 +1,278 @@
+"""Dataset: lazy, distributed, block-based data pipelines.
+
+ray: python/ray/data/dataset.py:163 (Dataset; map_batches :373, repartition
+:969, random_shuffle :1008, split :1144, iter_batches :2875) with the plan/
+executor split of _internal/plan.py + streaming_executor.py:34, collapsed to
+one pull-based engine: one-to-one stages run as one task per block
+(pipelined, submitted all at once — the object store is the inter-stage
+buffer); all-to-all stages (repartition/shuffle/sort/groupby) are barrier
+points implemented as two-phase task graphs (partition map + reduce).
+
+TPU-relevant: iter_batches yields numpy-dict batches sized for the training
+step, and split() hands each SPMD host-worker an equal set of blocks
+(ray: Dataset.split's locality-aware analogue).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.data.block import (
+    Block,
+    BlockAccessor,
+    batch_to_rows,
+    rows_to_numpy_batch,
+)
+
+
+# -- stage tasks (plain remote functions) -----------------------------------
+
+
+@ray_tpu.remote
+def _map_block(block: Block, fn_kind: str, fn: Callable, batch_format: str, batch_size):
+    if fn_kind == "rows":
+        return [fn(r) for r in block]
+    if fn_kind == "flat":
+        out = []
+        for r in block:
+            out.extend(fn(r))
+        return out
+    if fn_kind == "filter":
+        return [r for r in block if fn(r)]
+    if fn_kind == "batches":
+        out: Block = []
+        bs = batch_size or len(block) or 1
+        for i in range(0, len(block), bs):
+            acc = BlockAccessor(block[i : i + bs])
+            res = fn(acc.to_batch(batch_format))
+            out.extend(batch_to_rows(res))
+        return out
+    if fn_kind == "block":
+        return fn(block)
+    raise ValueError(fn_kind)
+
+
+@ray_tpu.remote
+def _partition_block(block: Block, n: int, key_fn, seed) -> List[Block]:
+    """Map phase of all-to-all ops: split one block into n shards."""
+    shards: List[Block] = [[] for _ in range(n)]
+    if key_fn is None:
+        rng = random.Random(seed)
+        for r in block:
+            shards[rng.randrange(n)].append(r)
+    else:
+        for r in block:
+            shards[hash(key_fn(r)) % n].append(r)
+    return shards
+
+
+@ray_tpu.remote
+def _merge_shards(*shards: Block) -> Block:
+    out: Block = []
+    for s in shards:
+        out.extend(s)
+    return out
+
+
+@ray_tpu.remote
+def _merge_shuffle(seed, *shards: Block) -> Block:
+    out: Block = []
+    for s in shards:
+        out.extend(s)
+    random.Random(seed).shuffle(out)
+    return out
+
+
+@ray_tpu.remote
+def _sort_block(block: Block, key, descending: bool) -> Block:
+    return sorted(block, key=key, reverse=descending)
+
+
+@ray_tpu.remote
+def _merge_sorted(key, descending: bool, *blocks: Block) -> Block:
+    import heapq
+
+    if key is None:
+        key = lambda x: x
+    merged = heapq.merge(*blocks, key=key, reverse=descending)
+    return list(merged)
+
+
+class Dataset:
+    """A list of block object-refs + lazily applied stages."""
+
+    def __init__(self, block_refs: List[Any]):
+        self._block_refs = list(block_refs)
+
+    # -- constructors (see read_api.py) -----------------------------------
+
+    # -- transforms (one-to-one, lazy-ish: submitted immediately, results
+    #    are refs so nothing blocks until consumed) ------------------------
+    def _map_stage(self, fn_kind: str, fn: Callable, batch_format="numpy", batch_size=None) -> "Dataset":
+        refs = [
+            _map_block.remote(b, fn_kind, fn, batch_format, batch_size)
+            for b in self._block_refs
+        ]
+        return Dataset(refs)
+
+    def map(self, fn: Callable) -> "Dataset":
+        return self._map_stage("rows", fn)
+
+    def flat_map(self, fn: Callable) -> "Dataset":
+        return self._map_stage("flat", fn)
+
+    def filter(self, fn: Callable) -> "Dataset":
+        return self._map_stage("filter", fn)
+
+    def map_batches(
+        self,
+        fn: Callable,
+        *,
+        batch_size: Optional[int] = None,
+        batch_format: str = "numpy",
+    ) -> "Dataset":
+        return self._map_stage("batches", fn, batch_format, batch_size)
+
+    # -- all-to-all --------------------------------------------------------
+    def repartition(self, num_blocks: int) -> "Dataset":
+        """ray: dataset.py:969."""
+        parts = [
+            _partition_block.options(num_returns=num_blocks).remote(
+                b, num_blocks, None, i
+            )
+            for i, b in enumerate(self._block_refs)
+        ]
+        # parts[i] is a list of num_blocks refs (num_returns splits them)
+        new_refs = [
+            _merge_shards.remote(*[parts[j][i] for j in range(len(parts))])
+            for i in range(num_blocks)
+        ]
+        return Dataset(new_refs)
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        """ray: dataset.py:1008; two-phase push-based shuffle
+        (ray: _internal/push_based_shuffle.py)."""
+        n = max(len(self._block_refs), 1)
+        base = seed if seed is not None else random.randrange(2**31)
+        parts = [
+            _partition_block.options(num_returns=n).remote(b, n, None, base + i)
+            for i, b in enumerate(self._block_refs)
+        ]
+        new_refs = [
+            _merge_shuffle.remote(base + 7919 + i, *[parts[j][i] for j in range(len(parts))])
+            for i in range(n)
+        ]
+        return Dataset(new_refs)
+
+    def sort(self, key: Optional[Callable] = None, descending: bool = False) -> "Dataset":
+        sorted_refs = [_sort_block.remote(b, key, descending) for b in self._block_refs]
+        return Dataset([_merge_sorted.remote(key, descending, *sorted_refs)])
+
+    def groupby_aggregate(
+        self, key_fn: Callable, agg_fn: Callable[[Any, List[Any]], Any], num_partitions: int = 8
+    ) -> "Dataset":
+        """Hash-partition by key, then aggregate per partition (simplified
+        GroupedData — ray: python/ray/data/grouped_data.py)."""
+        n = num_partitions
+        parts = [
+            _partition_block.options(num_returns=n).remote(b, n, key_fn, None)
+            for b in self._block_refs
+        ]
+        merged = [
+            _merge_shards.remote(*[parts[j][i] for j in range(len(parts))])
+            for i in range(n)
+        ]
+
+        def agg(block: Block) -> Block:
+            groups: Dict[Any, List[Any]] = {}
+            for r in block:
+                groups.setdefault(key_fn(r), []).append(r)
+            return [agg_fn(k, v) for k, v in groups.items()]
+
+        return Dataset(merged)._map_stage("block", agg)
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        refs = list(self._block_refs)
+        for o in others:
+            refs.extend(o._block_refs)
+        return Dataset(refs)
+
+    # -- consumption -------------------------------------------------------
+    def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
+        """ray: dataset.py:1144 — per-train-worker shards."""
+        refs = self._block_refs
+        if equal and len(refs) % n != 0:
+            # rebalance to a multiple of n blocks first
+            return self.repartition(n).split(n)
+        out = [refs[i::n] for i in range(n)]
+        return [Dataset(r) for r in out]
+
+    def take(self, limit: int = 20) -> List[Any]:
+        out: List[Any] = []
+        for b in self._block_refs:
+            rows = ray_tpu.get(b)
+            out.extend(rows[: limit - len(out)])
+            if len(out) >= limit:
+                break
+        return out
+
+    def take_all(self) -> List[Any]:
+        out: List[Any] = []
+        for b in self._block_refs:
+            out.extend(ray_tpu.get(b))
+        return out
+
+    def count(self) -> int:
+        @ray_tpu.remote
+        def _len(b):
+            return len(b)
+
+        return sum(ray_tpu.get([_len.remote(b) for b in self._block_refs]))
+
+    def schema(self):
+        for b in self._block_refs:
+            rows = ray_tpu.get(b)
+            if rows:
+                return BlockAccessor(rows).schema()
+        return None
+
+    def num_blocks(self) -> int:
+        return len(self._block_refs)
+
+    def materialize(self) -> "Dataset":
+        ray_tpu.wait(self._block_refs, num_returns=len(self._block_refs), timeout=None)
+        return self
+
+    def iter_rows(self) -> Iterator[Any]:
+        for b in self._block_refs:
+            yield from ray_tpu.get(b)
+
+    def iter_batches(
+        self,
+        *,
+        batch_size: int = 256,
+        batch_format: str = "numpy",
+        drop_last: bool = False,
+    ) -> Iterator[Any]:
+        """Streaming consumption: blocks are fetched as needed, carry-over
+        rows stitch batch boundaries across blocks
+        (ray: dataset.py:2875 / streaming_executor.py:34)."""
+        carry: Block = []
+        for b in self._block_refs:
+            carry.extend(ray_tpu.get(b))
+            while len(carry) >= batch_size:
+                chunk, carry = carry[:batch_size], carry[batch_size:]
+                yield BlockAccessor(chunk).to_batch(batch_format)
+        if carry and not drop_last:
+            yield BlockAccessor(carry).to_batch(batch_format)
+
+    def to_pandas(self):
+        return BlockAccessor(self.take_all()).to_batch("pandas")
+
+    def stats(self) -> str:
+        return f"Dataset(num_blocks={self.num_blocks()})"
+
+    def __repr__(self):
+        return f"Dataset(num_blocks={len(self._block_refs)})"

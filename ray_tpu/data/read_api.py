@@ -1,0 +1,104 @@
+"""Dataset constructors (ray: python/ray/data/read_api.py).
+
+Readers create one read task per file/partition; blocks land in the object
+store owned by the driver.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.data.block import batch_to_rows
+from ray_tpu.data.dataset import Dataset
+
+
+def _to_blocks(items: List[Any], parallelism: int) -> List[Any]:
+    n = max(1, min(parallelism, len(items) or 1))
+    size = (len(items) + n - 1) // n if items else 0
+    blocks = [items[i * size : (i + 1) * size] for i in range(n)] if items else [[]]
+    return [ray_tpu.put(b) for b in blocks if b or len(blocks) == 1]
+
+
+def from_items(items: List[Any], *, parallelism: int = 8) -> Dataset:
+    return Dataset(_to_blocks(list(items), parallelism))
+
+
+def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001 — API parity
+    return from_items(list(__builtins__["range"](n) if isinstance(__builtins__, dict) else __import__("builtins").range(n)), parallelism=parallelism)
+
+
+def from_numpy(arr, *, parallelism: int = 8) -> Dataset:
+    return from_items([{"value": x} for x in arr], parallelism=parallelism)
+
+
+def from_pandas(df, *, parallelism: int = 8) -> Dataset:
+    return from_items(df.to_dict("records"), parallelism=parallelism)
+
+
+def from_arrow(table, *, parallelism: int = 8) -> Dataset:
+    return from_items(table.to_pylist(), parallelism=parallelism)
+
+
+@ray_tpu.remote
+def _read_parquet_file(path: str, columns) -> List[Dict]:
+    import pyarrow.parquet as pq
+
+    return pq.read_table(path, columns=columns).to_pylist()
+
+
+@ray_tpu.remote
+def _read_csv_file(path: str) -> List[Dict]:
+    import pyarrow.csv as pacsv
+
+    return pacsv.read_csv(path).to_pylist()
+
+
+@ray_tpu.remote
+def _read_json_file(path: str) -> List[Dict]:
+    import json
+
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    return rows
+
+
+def _expand(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(_glob.glob(os.path.join(p, "*"))))
+        elif any(c in p for c in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    return out
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
+    return Dataset([_read_parquet_file.remote(p, columns) for p in _expand(paths)])
+
+
+def read_csv(paths) -> Dataset:
+    return Dataset([_read_csv_file.remote(p) for p in _expand(paths)])
+
+
+def read_json(paths) -> Dataset:
+    return Dataset([_read_json_file.remote(p) for p in _expand(paths)])
+
+
+def read_text(paths) -> Dataset:
+    @ray_tpu.remote
+    def _read(path: str) -> List[str]:
+        with open(path) as f:
+            return [ln.rstrip("\n") for ln in f]
+
+    return Dataset([_read.remote(p) for p in _expand(paths)])
