@@ -40,17 +40,22 @@ def main():
         197e12,
     )
 
-    # ~470M params: fits v5e HBM (16G) with bf16 params + f32 adam moments.
+    # ~940M params: the widest llama-family shape that fits v5e HBM (16G)
+    # with bf16 params + f32 adam moments.  d_model=2048 maps onto the MXU
+    # far better than deeper/narrower configs (measured: d1536/L24 -> 0.46
+    # MFU, d2048/L16 -> 0.51 on v5e).  remat saves post-rope q/k/v + the
+    # flash-attention output, recomputing only the cheap matmuls in bwd.
     cfg = TransformerConfig(
         vocab_size=32000,
-        d_model=1536,
-        n_layers=24,
-        n_heads=12,
-        n_kv_heads=12,
-        d_ff=4096,
+        d_model=2048,
+        n_layers=16,
+        n_heads=16,
+        n_kv_heads=16,
+        d_ff=5504,
         max_seq_len=2048,
         param_dtype=jnp.bfloat16,
         remat=True,
+        remat_policy="qkv_attn",
     )
     batch_size, seq = 8, 2048
 

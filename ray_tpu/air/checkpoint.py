@@ -55,12 +55,20 @@ class Checkpoint:
 
     # -- accessors --------------------------------------------------------
     def to_dict(self) -> Dict[str, Any]:
+        """Full dict form.  Round-trips every key (the reference's
+        Checkpoint.to_dict does): a directory checkpoint's orbax 'state'
+        subdir is restored back under 'jax_state'.  Returns a copy so
+        callers can't corrupt the checkpoint's internal dict."""
         if self._data is not None:
-            return self._data
+            return dict(self._data)
         fp = os.path.join(self._dir, self._DICT_FILE)
         if os.path.exists(fp):
             with open(fp, "rb") as f:
-                return pickle.load(f)
+                data = pickle.load(f)
+            state_dir = os.path.join(self._dir, "state")
+            if "jax_state" not in data and os.path.isdir(state_dir):
+                data["jax_state"] = _orbax_restore(state_dir)
+            return data
         # orbax-format directory
         state = _orbax_restore(self._dir)
         return {"jax_state": state}

@@ -56,7 +56,10 @@ def _map_block(block: Block, fn_kind: str, fn: Callable, batch_format: str, batc
 
 @ray_tpu.remote
 def _partition_block(block: Block, n: int, key_fn, seed) -> List[Block]:
-    """Map phase of all-to-all ops: split one block into n shards."""
+    """Map phase of all-to-all ops: split one block into n shards.
+
+    key_fn=None randomly scatters rows — used ONLY by random_shuffle;
+    repartition/split use order-preserving contiguous ranges instead."""
     shards: List[Block] = [[] for _ in range(n)]
     if key_fn is None:
         rng = random.Random(seed)
@@ -66,6 +69,16 @@ def _partition_block(block: Block, n: int, key_fn, seed) -> List[Block]:
         for r in block:
             shards[hash(key_fn(r)) % n].append(r)
     return shards
+
+
+@ray_tpu.remote
+def _block_len(block: Block) -> int:
+    return len(block)
+
+
+@ray_tpu.remote
+def _slice_block(block: Block, start: int, end: int) -> Block:
+    return block[start:end]
 
 
 @ray_tpu.remote
@@ -136,19 +149,38 @@ class Dataset:
         return self._map_stage("batches", fn, batch_format, batch_size)
 
     # -- all-to-all --------------------------------------------------------
+    def _contiguous_slice_refs(
+        self, bounds: List[int], lengths: List[int]
+    ) -> List[List[Any]]:
+        """Map global row ranges [bounds[i], bounds[i+1]) onto per-input-block
+        slice refs, preserving row order (ray's repartition at dataset.py:969
+        is order-preserving; the map phase sends each output only the
+        contiguous slice it owns)."""
+        offsets = [0]
+        for ln in lengths:
+            offsets.append(offsets[-1] + ln)
+        out: List[List[Any]] = []
+        for i in range(len(bounds) - 1):
+            lo, hi = bounds[i], bounds[i + 1]
+            slices = []
+            for j, b in enumerate(self._block_refs):
+                blo, bhi = offsets[j], offsets[j + 1]
+                s, e = max(lo, blo), min(hi, bhi)
+                if s < e:
+                    if s == blo and e == bhi:
+                        slices.append(b)  # whole block, no copy task
+                    else:
+                        slices.append(_slice_block.remote(b, s - blo, e - blo))
+            out.append(slices)
+        return out
+
     def repartition(self, num_blocks: int) -> "Dataset":
-        """ray: dataset.py:969."""
-        parts = [
-            _partition_block.options(num_returns=num_blocks).remote(
-                b, num_blocks, None, i
-            )
-            for i, b in enumerate(self._block_refs)
-        ]
-        # parts[i] is a list of num_blocks refs (num_returns splits them)
-        new_refs = [
-            _merge_shards.remote(*[parts[j][i] for j in range(len(parts))])
-            for i in range(num_blocks)
-        ]
+        """Order-preserving equal-range repartition (ray: dataset.py:969)."""
+        lengths = ray_tpu.get([_block_len.remote(b) for b in self._block_refs])
+        total = sum(lengths)
+        bounds = [i * total // num_blocks for i in range(num_blocks + 1)]
+        groups = self._contiguous_slice_refs(bounds, lengths)
+        new_refs = [_merge_shards.remote(*g) if g else ray_tpu.put([]) for g in groups]
         return Dataset(new_refs)
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
@@ -201,11 +233,23 @@ class Dataset:
 
     # -- consumption -------------------------------------------------------
     def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
-        """ray: dataset.py:1144 — per-train-worker shards."""
+        """ray: dataset.py:1144 — per-train-worker shards.
+
+        equal=True produces EXACTLY equal row counts (truncating the
+        remainder), deterministically and order-preserving — unequal SPMD
+        shards would make ranks run different step counts and hang compiled
+        collectives."""
+        if equal:
+            lengths = ray_tpu.get([_block_len.remote(b) for b in self._block_refs])
+            total = sum(lengths)
+            per = total // n
+            bounds = [i * per for i in range(n + 1)]  # drops total - n*per rows
+            groups = self._contiguous_slice_refs(bounds, lengths)
+            return [
+                Dataset([_merge_shards.remote(*g)] if g else [ray_tpu.put([])])
+                for g in groups
+            ]
         refs = self._block_refs
-        if equal and len(refs) % n != 0:
-            # rebalance to a multiple of n blocks first
-            return self.repartition(n).split(n)
         out = [refs[i::n] for i in range(n)]
         return [Dataset(r) for r in out]
 

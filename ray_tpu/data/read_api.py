@@ -6,6 +6,7 @@ store owned by the driver.
 
 from __future__ import annotations
 
+import builtins
 import glob as _glob
 import os
 from typing import Any, Dict, List, Optional
@@ -16,9 +17,12 @@ from ray_tpu.data.dataset import Dataset
 
 
 def _to_blocks(items: List[Any], parallelism: int) -> List[Any]:
+    # NB: module-level `range()` below shadows the builtin in this module.
     n = max(1, min(parallelism, len(items) or 1))
     size = (len(items) + n - 1) // n if items else 0
-    blocks = [items[i * size : (i + 1) * size] for i in range(n)] if items else [[]]
+    blocks = (
+        [items[i * size : (i + 1) * size] for i in builtins.range(n)] if items else [[]]
+    )
     return [ray_tpu.put(b) for b in blocks if b or len(blocks) == 1]
 
 
@@ -27,7 +31,7 @@ def from_items(items: List[Any], *, parallelism: int = 8) -> Dataset:
 
 
 def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001 — API parity
-    return from_items(list(__builtins__["range"](n) if isinstance(__builtins__, dict) else __import__("builtins").range(n)), parallelism=parallelism)
+    return from_items(list(builtins.range(n)), parallelism=parallelism)
 
 
 def from_numpy(arr, *, parallelism: int = 8) -> Dataset:

@@ -47,6 +47,11 @@ class TransformerConfig:
     param_dtype: Any = jnp.float32
     tie_embeddings: bool = False
     remat: bool = True
+    # Remat granularity: None = full per-layer recompute (min memory);
+    # "attn" = save the attention kernel output (skips re-running the flash
+    # kernel in backward); "qkv_attn" = additionally save post-rope q/k/v
+    # (skips qkv matmul + rope recompute).  More saved = more HBM.
+    remat_policy: Optional[str] = None
     attention_impl: Optional[str] = None  # None=auto, see ops.attention
 
     @property
@@ -188,7 +193,13 @@ def _layer(
     kk = constrain(kk, ("act_batch", "act_seq", "act_kv_heads", "act_head_dim"))
     q = apply_rope(q, positions, theta=c.rope_theta)
     kk = apply_rope(kk, positions, theta=c.rope_theta)
+    from jax.ad_checkpoint import checkpoint_name
+
+    q = checkpoint_name(q, "q")
+    kk = checkpoint_name(kk, "k")
+    vv = checkpoint_name(vv, "v")
     attn = dot_product_attention(q, kk, vv, causal=True, impl=c.attention_impl)
+    attn = checkpoint_name(attn, "attn")
     attn_out = jnp.einsum("bshd,hde->bse", attn, layer_params["attn"]["wo"].astype(dt))
     x = x + constrain(attn_out, ("act_batch", "act_seq", "act_embed"))
 
@@ -220,7 +231,20 @@ def forward(
         _layer, positions=positions, config=c, rules=rules, mesh=mesh
     )
     if c.remat:
-        layer_fn = jax.checkpoint(layer_fn)
+        if c.remat_policy == "attn":
+            policy = jax.checkpoint_policies.save_only_these_names("attn")
+        elif c.remat_policy == "qkv_attn":
+            policy = jax.checkpoint_policies.save_only_these_names(
+                "q", "k", "v", "attn"
+            )
+        elif c.remat_policy is None:
+            policy = None
+        else:
+            raise ValueError(
+                f"unknown remat_policy {c.remat_policy!r}; "
+                "expected None, 'attn', or 'qkv_attn'"
+            )
+        layer_fn = jax.checkpoint(layer_fn, policy=policy)
 
     def scan_body(carry, layer_params):
         return layer_fn(carry, layer_params), None

@@ -1,12 +1,26 @@
-"""Pallas TPU flash-attention forward kernel.
+"""Pallas TPU flash attention: forward + backward kernels.
 
-Forward runs as a pallas kernel (online softmax over KV tiles held in VMEM,
-MXU matmuls in f32 accumulation); backward recomputes through the blockwise
-JAX implementation (ops/attention.py) under jax.custom_vjp — flash-style
-recompute-in-backward, O(S) memory.
+Forward: online softmax, one (block_q, block_k) tile pair per grid step on a
+4-D grid (batch, head, q_tile, kv_tile); accumulator/max/denominator live in
+VMEM scratch carried across the innermost kv dimension, so VMEM holds only
+the current tiles (full-K/V-resident designs blow the ~16MB/core budget and
+a 128-tile grid design starves the MXU at ~3 TFLOP/s on v5e).  The per-row
+logsumexp is saved for the backward.
 
-On non-TPU backends the kernel runs in interpret mode, so tests on the
+Backward: two pallas kernels with flash-style in-kernel recompute (no [S,S]
+materialization, O(S) memory):
+  - dq kernel, grid (b, h, q_tile, kv_tile): recompute P from (q, k, lse),
+    accumulate dq = scale * sum_kv P*(dP - delta) @ K in scratch.
+  - dkv kernel, grid (b, h, kv_tile, q_tile): accumulate dv = P^T @ dO and
+    dk = (P*(dP - delta))^T @ q_scaled in scratch.
+
+Causal masking skips fully-masked tile pairs via pl.when predication.
+
+On non-TPU backends the kernels run in interpret mode, so tests on the
 virtual CPU mesh exercise the same code path.
+
+Reference parity note: the reference (Ray) has no attention kernels at all
+(SURVEY.md §5.7) — this is TPU-native new work.
 """
 
 from __future__ import annotations
@@ -18,104 +32,324 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+try:  # TPU-only helpers; absent on some CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
 NEG_INF = -1e30
+_LANES = 128  # VPU lane count: row-scalar scratch is kept lane-broadcast
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool, block_k: int):
-    # Block shapes: q_ref/o_ref [1, 1, bq, D]; k_ref/v_ref [1, 1, Sk, D].
+def _scratch(shape, dtype):
+    if pltpu is not None:
+        return pltpu.VMEM(shape, dtype)
+    # Generic scratch allocation: works in interpret mode (scratch is
+    # allocated there too, so this must be a real scratch spec).
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+# -- forward ---------------------------------------------------------------
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+    *, scale: float, causal: bool,
+):
+    # Blocks: q/o [1, 1, bq, D]; k/v [1, 1, bk, D]; lse [1, 1, bq, 1].
+    # Scratch (carried across the kv grid dim): acc [bq, D] f32,
+    # m/l [bq, LANES] f32 (lane-broadcast row scalars).
     qi = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32) * scale  # [bq, D]
-    bq = q.shape[0]
-    sk = k_ref.shape[2]
-    nk = sk // block_k
-
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+    bq = q_ref.shape[2]
+    bk = k_ref.shape[2]
     q_start = qi * bq
+    k_start = ki * bk
 
-    def body(i, carry):
-        acc, m, l = carry
-        k = k_ref[0, 0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    run = (k_start <= q_start + bq - 1) if causal else True
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32) * scale  # [bq, D]
+        k = k_ref[0, 0].astype(jnp.float32)  # [bk, D]
+        v = v_ref[0, 0].astype(jnp.float32)
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [bq, block_k]
+        )  # [bq, bk]
         if causal:
-            qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-            kpos = i * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             logits = jnp.where(qpos >= kpos, logits, NEG_INF)
-        m_blk = jnp.max(logits, axis=-1)
-        m_new = jnp.maximum(m, m_blk)
-        p = jnp.where(logits <= NEG_INF / 2, 0.0, jnp.exp(logits - m_new[:, None]))
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=-1)
-        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+        m_prev = m_ref[:, :1]  # [bq, 1]
+        l_prev = l_ref[:, :1]
+        m_blk = jnp.max(logits, axis=-1, keepdims=True)  # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_blk)
+        p = jnp.where(logits <= NEG_INF / 2, 0.0, jnp.exp(logits - m_new))
+        corr = jnp.exp(m_prev - m_new)  # [bq, 1]
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        return acc_new, m_new, l_new
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    acc0 = jnp.zeros((bq, q_ref.shape[3]), jnp.float32)
-    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq,), jnp.float32)
-    if causal:
-        # Only blocks with kpos <= last qpos contribute.
-        n_iter = jnp.minimum(nk, (q_start + bq + block_k - 1) // block_k)
-    else:
-        n_iter = nk
-    acc, m, l = jax.lax.fori_loop(0, n_iter, body, (acc0, m0, l0))
-    o_ref[0, 0] = (acc / jnp.maximum(l[:, None], 1e-37)).astype(o_ref.dtype)
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        l_safe = jnp.maximum(l, 1e-37)
+        o_ref[0, 0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_ref[:, :1] + jnp.log(l_safe)
 
 
 def _flash_fwd(q, k, v, *, causal, scale, block_q, block_k, interpret):
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    # Kernel works in [B, H, S, D].
+    # Kernels work in [B, H, S, D].
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
-    grid = (b, h, sq // block_q)
-    kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, block_k=block_k
-    )
-    out = pl.pallas_call(
+    grid = (b, h, sq // block_q, sk // block_k)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal)
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, sk, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, sk, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            _scratch((block_q, d), jnp.float32),
+            _scratch((block_q, _LANES), jnp.float32),
+            _scratch((block_q, _LANES), jnp.float32),
+        ],
         interpret=interpret,
     )(qt, kt, vt)
-    return out.transpose(0, 2, 1, 3)
+    return out.transpose(0, 2, 1, 3), lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, scale, block_q, block_k):
-    interpret = jax.default_backend() != "tpu"
-    return _flash_fwd(
-        q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k,
-        interpret=interpret,
-    )
+# -- backward --------------------------------------------------------------
 
 
-def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k):
-    return _flash(q, k, v, causal, scale, block_q, block_k), (q, k, v)
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref,
+    *, scale: float, causal: bool,
+):
+    # q/do/dq [1, 1, bq, D]; k/v [1, 1, bk, D]; lse/delta [1, 1, bq, 1].
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+    bq = q_ref.shape[2]
+    bk = k_ref.shape[2]
+    q_start = qi * bq
+    k_start = ki * bk
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    run = (k_start <= q_start + bq - 1) if causal else True
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32) * scale  # pre-scaled
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]  # [bq, 1]
+        delta = delta_ref[0, 0]  # [bq, 1]
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bk]
+        if causal:
+            qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            logits = jnp.where(qpos >= kpos, logits, NEG_INF)
+        p = jnp.exp(logits - lse)  # masked -> exp(-inf) = 0
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bk]
+        ds = p * (dp - delta)
+        acc_ref[...] = acc_ref[...] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0, 0] = (acc_ref[...] * scale).astype(dq_ref.dtype)
 
 
-def _flash_vjp_bwd(causal, scale, block_q, block_k, res, g):
-    from ray_tpu.ops.attention import blockwise_attention
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc_ref, dv_acc_ref,
+    *, scale: float, causal: bool,
+):
+    # Grid (b, h, kv_tile, q_tile) — q innermost so k/v blocks stay resident.
+    # k/v/dk/dv [1, 1, bk, D]; q/do [1, 1, bq, D]; lse/delta [1, 1, bq, 1].
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+    nq = pl.num_programs(3)
+    bk = k_ref.shape[2]
+    bq = q_ref.shape[2]
+    k_start = ki * bk
+    q_start = qi * bq
 
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: blockwise_attention(
-            q_, k_, v_, causal=causal, scale=scale, block_size=block_k
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    run = (q_start + bq - 1 >= k_start) if causal else True
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32) * scale
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]  # [bq, 1]
+        delta = delta_ref[0, 0]
+        k = k_ref[0, 0].astype(jnp.float32)  # [bk, D]
+        v = v_ref[0, 0].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bk]
+        if causal:
+            qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            logits = jnp.where(qpos >= kpos, logits, NEG_INF)
+        p = jnp.exp(logits - lse)
+        dv_acc_ref[...] = dv_acc_ref[...] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bk, D]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        # q is pre-scaled, so this accumulates the true dk.
+        dk_acc_ref[...] = dk_acc_ref[...] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_acc_ref[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc_ref[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, *, causal, scale, block_q, block_k, interpret):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    dot = do.transpose(0, 2, 1, 3)
+    # delta_i = rowsum(dO_i * O_i) — cheap elementwise, computed outside.
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    ).transpose(0, 2, 1)[..., None]  # [B, H, Sq, 1]
+
+    dq_kernel = functools.partial(_bwd_dq_kernel, scale=scale, causal=causal)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b, h, sq // block_q, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
         ),
-        q, k, v,
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        scratch_shapes=[_scratch((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    dkv_kernel = functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b, h, sk // block_k, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            _scratch((block_k, d), jnp.float32),
+            _scratch((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+    return (
+        dq.transpose(0, 2, 1, 3),
+        dk.transpose(0, 2, 1, 3),
+        dv.transpose(0, 2, 1, 3),
     )
-    return vjp(g)
+
+
+# -- custom_vjp wiring -----------------------------------------------------
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, scale, block_q, block_k, bwd_block_q, bwd_block_k):
+    out, _ = _flash_fwd(
+        q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+        interpret=_interpret(),
+    )
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, bwd_block_q, bwd_block_k):
+    out, lse = _flash_fwd(
+        q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+        interpret=_interpret(),
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(causal, scale, block_q, block_k, bwd_block_q, bwd_block_k, res, g):
+    q, k, v, out, lse = res
+    return _flash_bwd(
+        q, k, v, out, lse, g,
+        causal=causal, scale=scale, block_q=bwd_block_q, block_k=bwd_block_k,
+        interpret=_interpret(),
+    )
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -128,10 +362,16 @@ def flash_attention(
     *,
     causal: bool = True,
     scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 1024,
+    block_k: int = 1024,
+    bwd_block_q: int = 1024,
+    bwd_block_k: int = 512,
 ) -> jax.Array:
-    """Flash attention, [B, S, H, D] layout, GQA via repeated kv heads."""
+    """Flash attention, [B, S, H, D] layout, GQA via repeated kv heads.
+
+    Forward tiles default larger than backward: the bwd kernels hold four
+    [bq, bk] f32 intermediates (logits/p/dp/ds) at once, so 1024x1024 there
+    would exceed the ~16MB VMEM scoped budget."""
     h = q.shape[2]
     if k.shape[2] != h:
         from ray_tpu.ops.attention import _repeat_kv
@@ -139,12 +379,27 @@ def flash_attention(
         k = _repeat_kv(k, h)
         v = _repeat_kv(v, h)
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    block_q = min(block_q, q.shape[1])
-    block_k = min(block_k, k.shape[1])
-    if q.shape[1] % block_q or k.shape[1] % block_k:
-        # Tail blocks would be silently dropped by the grid/loop floor
-        # division; use the blockwise scan (same math) for ragged lengths.
+    # Shrink each tile to the largest 128-multiple divisor of its sequence
+    # length (tail tiles would be silently dropped by the grid floor
+    # division); only truly ragged lengths fall back to the blockwise scan.
+    block_q = _fit_block(q.shape[1], block_q)
+    block_k = _fit_block(k.shape[1], block_k)
+    bwd_block_q = _fit_block(q.shape[1], bwd_block_q)
+    bwd_block_k = _fit_block(k.shape[1], bwd_block_k)
+    if None in (block_q, block_k, bwd_block_q, bwd_block_k):
         from ray_tpu.ops.attention import blockwise_attention
 
         return blockwise_attention(q, k, v, causal=causal, scale=scale)
-    return _flash(q, k, v, causal, scale, block_q, block_k)
+    return _flash(q, k, v, causal, scale, block_q, block_k, bwd_block_q, bwd_block_k)
+
+
+def _fit_block(s: int, requested: int) -> Optional[int]:
+    """Tile size that divides s: the request itself if it divides, else the
+    largest 128-multiple <= requested that does; None if neither exists."""
+    requested = min(requested, s)
+    if s % requested == 0:
+        return requested
+    for b in range((requested // 128) * 128, 127, -128):
+        if s % b == 0:
+            return b
+    return None

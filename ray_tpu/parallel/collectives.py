@@ -80,28 +80,50 @@ class _GroupCoordinator:
 
 
 class CollectiveGroup:
-    """One rank's view of a host collective group."""
+    """One rank's view of a host collective group.
 
-    def __init__(self, name: str, world_size: int, rank: int):
+    timeout_s bounds every collective: if a peer rank dies before
+    contributing, the others raise instead of spinning forever (the
+    reference's collective ops error out on dead peers).  Polls back off
+    exponentially to 50ms so a long wait doesn't hot-load the coordinator.
+    """
+
+    def __init__(self, name: str, world_size: int, rank: int, timeout_s: float = 120.0):
         self.name = name
         self.world_size = world_size
         self.rank = rank
+        self.timeout_s = timeout_s
         self._seq = 0
         self._p2p_seq: Dict[tuple, int] = {}  # (src, dst) -> next seq
         self._coord = _get_or_create_coordinator(name, world_size)
 
-    # -- collectives ------------------------------------------------------
-    def _exchange(self, tag: str, value) -> Dict[int, Any]:
+    def _poll(self, fetch, what: str):
         import time
 
+        deadline = time.monotonic() + self.timeout_s
+        interval = 0.001
+        while True:
+            out = fetch()
+            if out is not None:
+                return out
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"collective {what} timed out after {self.timeout_s}s in group "
+                    f"{self.name!r} (rank {self.rank}/{self.world_size}) — a peer "
+                    "rank likely died before contributing"
+                )
+            time.sleep(interval)
+            interval = min(interval * 2, 0.05)
+
+    # -- collectives ------------------------------------------------------
+    def _exchange(self, tag: str, value) -> Dict[int, Any]:
         self._seq += 1
         key = f"{tag}:{self._seq}"
         ray_tpu.get(self._coord.contribute.remote(key, self.rank, value))
-        while True:
-            out = ray_tpu.get(self._coord.collect.remote(key, self.rank))
-            if out is not None:
-                return out
-            time.sleep(0.001)
+        return self._poll(
+            lambda: ray_tpu.get(self._coord.collect.remote(key, self.rank)),
+            what=key,
+        )
 
     def allreduce(self, arr, op: str = "sum"):
         parts = self._exchange("ar", np.asarray(arr))
@@ -134,14 +156,11 @@ class CollectiveGroup:
         ray_tpu.get(self._coord.p2p_put.remote(key, np.asarray(arr)))
 
     def recv(self, src_rank: int):
-        import time
-
         key = self._p2p_key(src_rank, self.rank)
-        while True:
-            out = ray_tpu.get(self._coord.p2p_take.remote(key))
-            if out is not None:
-                return out
-            time.sleep(0.001)
+        return self._poll(
+            lambda: ray_tpu.get(self._coord.p2p_take.remote(key)),
+            what=key,
+        )
 
 
 _registry: Dict[str, "CollectiveGroup"] = {}

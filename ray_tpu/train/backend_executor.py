@@ -92,9 +92,17 @@ class BackendExecutor:
         error: Optional[BaseException] = None
         while not all(finished) and error is None:
             time.sleep(poll_interval)
-            polls = ray_tpu.get(
-                [w.poll.remote() for w in wg.workers], timeout=60
-            )
+            try:
+                polls = ray_tpu.get(
+                    [w.poll.remote() for w in wg.workers], timeout=60
+                )
+            except Exception as e:
+                # A dead worker actor (crash/OOM/preemption) must surface as
+                # TrainingFailedError so FailureConfig group-restart applies,
+                # not as a raw ActorDiedError escaping fit().
+                raise TrainingFailedError(
+                    f"train worker died during poll: {e}"
+                ) from e
             for i, p in enumerate(polls):
                 for rep in p["reports"]:
                     all_reports[i].append(rep)
@@ -113,7 +121,12 @@ class BackendExecutor:
         if error is not None:
             raise TrainingFailedError(str(error)) from error
         # final drain
-        polls = ray_tpu.get([w.poll.remote() for w in wg.workers], timeout=60)
+        try:
+            polls = ray_tpu.get([w.poll.remote() for w in wg.workers], timeout=60)
+        except Exception as e:
+            raise TrainingFailedError(
+                f"train worker died during final report drain: {e}"
+            ) from e
         for i, p in enumerate(polls):
             for rep in p["reports"]:
                 all_reports[i].append(rep)

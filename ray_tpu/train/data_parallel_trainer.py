@@ -49,6 +49,11 @@ class DataParallelTrainer:
         attempts_left = failure.max_failures
         latest_ckpt = self.resume_from_checkpoint
         history: list = []
+        # History length at the moment of the last checkpoint: on group
+        # restart the resumed run re-reports steps after that checkpoint, so
+        # anything past this mark belongs to the failed attempt and must be
+        # dropped to keep metrics_history free of duplicate steps.
+        ckpt_history_len = 0
         last_error: Optional[Exception] = None
 
         while True:
@@ -57,11 +62,12 @@ class DataParallelTrainer:
                 executor.start()
 
                 def on_report(rank: int, rep: Dict):
-                    nonlocal latest_ckpt
+                    nonlocal latest_ckpt, ckpt_history_len
                     if rank == 0:
                         history.append(rep["metrics"])
                     if rep.get("checkpoint") is not None:
                         latest_ckpt = rep["checkpoint"]
+                        ckpt_history_len = len(history)
 
                 reports = executor.run_training(
                     self.train_loop_per_worker,
@@ -86,7 +92,9 @@ class DataParallelTrainer:
                     )
                 if attempts_left > 0:
                     attempts_left -= 1
-                # group restart from latest checkpoint (elastic re-mesh)
+                # group restart from latest checkpoint (elastic re-mesh);
+                # drop the failed attempt's post-checkpoint metrics
+                del history[ckpt_history_len:]
             finally:
                 executor.shutdown()
 

@@ -68,6 +68,47 @@ def test_pallas_flash_grad():
 
 
 @pytest.mark.parametrize("causal", [True, False])
+def test_pallas_flash_grad_noncausal_and_mixed_blocks(causal):
+    """Backward kernels with bwd tile sizes differing from fwd tiles."""
+    from ray_tpu.ops.pallas.flash_attention import flash_attention
+
+    q, k, v = _qkv(jax.random.PRNGKey(5), b=1, s=256, h=2, d=32)
+    g = jax.random.normal(jax.random.PRNGKey(6), q.shape, jnp.float32)
+
+    def loss_flash(q, k, v):
+        out = flash_attention(
+            q, k, v, causal=causal,
+            block_q=128, block_k=128, bwd_block_q=64, bwd_block_k=128,
+        )
+        return (out * g).sum()
+
+    def loss_ref(q, k, v):
+        return (reference_attention(q, k, v, causal=causal) * g).sum()
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
+
+
+def test_pallas_flash_grad_gqa():
+    from ray_tpu.ops.pallas.flash_attention import flash_attention
+
+    q, k, v = _qkv(jax.random.PRNGKey(7), b=1, s=128, h=4, kv=2, d=32)
+
+    def loss_flash(q, k, v):
+        return flash_attention(q, k, v, causal=True, block_q=64, block_k=64).sum()
+
+    def loss_ref(q, k, v):
+        return reference_attention(q, k, v, causal=True).sum()
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
 def test_ring_attention_matches_reference(causal):
     from ray_tpu.ops.ring_attention import ring_attention_sharded
     from ray_tpu.parallel import MeshSpec, build_mesh
