@@ -60,3 +60,7 @@ class RunConfig:
     checkpoint_config: Optional[CheckpointConfig] = None
     verbose: int = 1
     callbacks: Optional[list] = None
+    # Stop criteria dict (ray: air.RunConfig(stop=...)): {result_key: bound}.
+    # A trial stops when result[key] >= bound (<= for the tune metric when
+    # mode="min").
+    stop: Optional[Dict[str, float]] = None

@@ -65,6 +65,16 @@ class DataParallelTrainer:
                     nonlocal latest_ckpt, ckpt_history_len
                     if rank == 0:
                         history.append(rep["metrics"])
+                        # Inside a tune trial actor: stream rank-0 reports up
+                        # to the trial session so ASHA/PBT see intermediate
+                        # results (ray: base_trainer.py:538 wraps trainers in
+                        # trainables for the same effect).
+                        from ray_tpu.train import session as _sess
+
+                        if _sess._session is not None:
+                            _sess._session.report(
+                                rep["metrics"], checkpoint=rep.get("checkpoint")
+                            )
                     if rep.get("checkpoint") is not None:
                         latest_ckpt = rep["checkpoint"]
                         ckpt_history_len = len(history)

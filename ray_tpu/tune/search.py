@@ -1,0 +1,191 @@
+"""Search spaces + searchers.
+
+ray: python/ray/tune/search/ — sample.py (Domain/grid_search/choice/uniform/
+loguniform/randint), basic_variant.py (BasicVariantGenerator: grid
+cross-product x num_samples random draws).  Optuna/hyperopt adapters are out
+of scope (external deps); the Searcher ABC gives the same plug-in seam.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import random
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+
+# -- domains ----------------------------------------------------------------
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Categorical(Domain):
+    def __init__(self, categories):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class Uniform(Domain):
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class LogUniform(Domain):
+    def __init__(self, low, high):
+        assert low > 0 and high > low
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return math.exp(rng.uniform(math.log(self.low), math.log(self.high)))
+
+
+class Randint(Domain):
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+class SampleFrom(Domain):
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def sample(self, rng):
+        return self.fn(None)
+
+
+class GridSearch:
+    def __init__(self, values):
+        self.values = list(values)
+
+
+def grid_search(values) -> GridSearch:
+    return GridSearch(values)
+
+
+def choice(categories) -> Categorical:
+    return Categorical(categories)
+
+
+def uniform(low, high) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low, high) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low, high) -> Randint:
+    return Randint(low, high)
+
+
+def sample_from(fn) -> SampleFrom:
+    return SampleFrom(fn)
+
+
+# -- variant generation -----------------------------------------------------
+
+
+def _split_spec(spec: Dict) -> tuple:
+    """Walk a (possibly nested) param space; return (grid_paths, sample_paths)."""
+    grids: List[tuple] = []
+    samples: List[tuple] = []
+
+    def walk(node, path):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, path + (k,))
+        elif isinstance(node, GridSearch):
+            grids.append((path, node))
+        elif isinstance(node, Domain):
+            samples.append((path, node))
+
+    walk(spec, ())
+    return grids, samples
+
+
+def _set_path(d: Dict, path: tuple, value):
+    for k in path[:-1]:
+        d = d.setdefault(k, {})
+    d[path[-1]] = value
+
+
+def _copy_spec(node):
+    if isinstance(node, dict):
+        return {k: _copy_spec(v) for k, v in node.items()}
+    return node
+
+
+class Searcher:
+    """ray: python/ray/tune/search/searcher.py — the plug-in seam."""
+
+    def set_search_properties(self, metric: Optional[str], mode: Optional[str]):
+        self.metric, self.mode = metric, mode
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: Dict):
+        pass
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict], error: bool):
+        pass
+
+    def save_state(self) -> Dict:
+        return {}
+
+    def restore_state(self, state: Dict):
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid cross-product x num_samples random draws
+    (ray: tune/search/basic_variant.py)."""
+
+    def __init__(self, param_space: Dict, num_samples: int = 1, seed: Optional[int] = None):
+        self.param_space = param_space
+        self.num_samples = num_samples
+        self.rng = random.Random(seed)
+        self._variants = list(self._generate())
+        self._next = 0
+
+    def _generate(self) -> Iterator[Dict]:
+        grids, samples = _split_spec(self.param_space)
+        grid_axes = [
+            [(path, v) for v in gs.values] for path, gs in grids
+        ] or [[]]
+        for _ in range(self.num_samples):
+            for combo in itertools.product(*grid_axes) if grids else [()]:
+                cfg = _copy_spec(self.param_space)
+                for path, value in combo:
+                    _set_path(cfg, path, value)
+                for path, dom in samples:
+                    _set_path(cfg, path, dom.sample(self.rng))
+                # strip any leftover Domain objects (fixed values pass through)
+                yield cfg
+
+    def total(self) -> int:
+        return len(self._variants)
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        if self._next >= len(self._variants):
+            return None
+        cfg = self._variants[self._next]
+        self._next += 1
+        return cfg
+
+    def save_state(self) -> Dict:
+        return {"next": self._next, "rng": self.rng.getstate()}
+
+    def restore_state(self, state: Dict):
+        self._next = state["next"]
+        self.rng.setstate(state["rng"])
