@@ -1,0 +1,200 @@
+"""Public Serve API.
+
+ray: python/ray/serve/api.py (serve.run :458, @serve.deployment :254,
+serve.start, serve.shutdown, serve.get_deployment_handle).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional, Union
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.serve.config import (
+    SERVE_CONTROLLER_NAME,
+    SERVE_NAMESPACE,
+    AutoscalingConfig,
+    DeploymentConfig,
+    HTTPOptions,
+)
+from ray_tpu.serve.controller import ServeController
+from ray_tpu.serve.http_proxy import HTTPProxy
+from ray_tpu.serve.router import DeploymentHandle, Router
+
+_lock = threading.Lock()
+_controller = None  # ActorHandle
+_proxy = None  # ActorHandle
+_router: Optional[Router] = None
+
+
+def start(
+    http_options: Optional[Union[HTTPOptions, dict]] = None,
+    detached: bool = True,
+) -> None:
+    """Start (or connect to) the Serve controller; optionally an HTTP proxy.
+
+    ray: serve.start — one controller per cluster, found by name."""
+    global _controller, _proxy, _router
+    ray_tpu.init(ignore_reinit_error=True)
+    with _lock:
+        if _controller is None:
+            _controller = (
+                ray_tpu.remote(ServeController)
+                .options(
+                    name=SERVE_CONTROLLER_NAME,
+                    namespace=SERVE_NAMESPACE,
+                    get_if_exists=True,
+                    max_concurrency=16,
+                )
+                .remote()
+            )
+            ray_tpu.get(_controller.ping.remote(), timeout=30)
+            _router = Router(_controller)
+        if http_options is not None and _proxy is None:
+            if isinstance(http_options, dict):
+                http_options = HTTPOptions(**http_options)
+            _proxy = (
+                ray_tpu.remote(HTTPProxy)
+                .options(max_concurrency=32)
+                .remote(_controller, http_options.host, http_options.port)
+            )
+            ray_tpu.get(_proxy.ping.remote(), timeout=30)
+
+
+def _ensure_started():
+    if _controller is None:
+        start()
+
+
+class Application:
+    """A deployment bound to its init args (ray: serve 2.x Application —
+    the object `serve.run` accepts)."""
+
+    def __init__(self, deployment: "Deployment", args: tuple, kwargs: dict):
+        self.deployment = deployment
+        self.init_args = args
+        self.init_kwargs = kwargs
+
+
+class Deployment:
+    """Result of @serve.deployment (ray: serve/deployment.py Deployment)."""
+
+    def __init__(self, target: Union[type, Callable], name: str, config: DeploymentConfig):
+        self._target = target
+        self.name = name
+        self.config = config
+
+    def options(self, **opts) -> "Deployment":
+        cfg = self.config.to_dict()
+        name = opts.pop("name", self.name)
+        for k, v in opts.items():
+            if k not in cfg:
+                raise TypeError(f"unknown deployment option {k!r}")
+            cfg[k] = v
+        return Deployment(self._target, name, DeploymentConfig.from_dict(cfg))
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+    def deploy(self, *init_args, **init_kwargs) -> DeploymentHandle:
+        _ensure_started()
+        blob = cloudpickle.dumps(self._target)
+        ray_tpu.get(
+            _controller.deploy.remote(
+                self.name, blob, init_args, init_kwargs, self.config.to_dict()
+            ),
+            timeout=60,
+        )
+        ray_tpu.get(
+            _controller.wait_for_ready.remote(self.name, 60.0), timeout=70
+        )
+        return DeploymentHandle(self.name, _router)
+
+    def __call__(self, *a, **kw):
+        raise TypeError(
+            "deployments are not directly callable; use .deploy() + handle.remote()"
+        )
+
+
+def deployment(
+    _target: Optional[Union[type, Callable]] = None,
+    *,
+    name: Optional[str] = None,
+    num_replicas: int = 1,
+    max_concurrent_queries: int = 8,
+    user_config: Any = None,
+    autoscaling_config: Optional[Union[AutoscalingConfig, dict]] = None,
+    health_check_period_s: float = 0.25,
+    health_check_timeout_s: float = 10.0,
+    graceful_shutdown_timeout_s: float = 5.0,
+    ray_actor_options: Optional[Dict[str, Any]] = None,
+):
+    """@serve.deployment decorator (ray: serve/api.py:254)."""
+
+    def deco(target):
+        cfg = DeploymentConfig(
+            num_replicas=num_replicas,
+            max_concurrent_queries=max_concurrent_queries,
+            user_config=user_config,
+            autoscaling_config=autoscaling_config,
+            health_check_period_s=health_check_period_s,
+            health_check_timeout_s=health_check_timeout_s,
+            graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
+            ray_actor_options=ray_actor_options or {},
+        )
+        return Deployment(target, name or target.__name__, cfg)
+
+    if _target is not None:
+        return deco(_target)
+    return deco
+
+
+def run(app: Union[Application, Deployment], **kwargs) -> DeploymentHandle:
+    """Deploy an application and return its handle (ray: serve.run :458)."""
+    if isinstance(app, Deployment):
+        app = app.bind()
+    return app.deployment.deploy(*app.init_args, **app.init_kwargs)
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    _ensure_started()
+    return DeploymentHandle(name, _router)
+
+
+def get_http_address() -> Optional[str]:
+    if _proxy is None:
+        return None
+    return ray_tpu.get(_proxy.address.remote(), timeout=10)
+
+
+def status() -> Dict[str, Any]:
+    _ensure_started()
+    return ray_tpu.get(_controller.list_deployments.remote(), timeout=10)
+
+
+def delete(name: str) -> None:
+    _ensure_started()
+    ray_tpu.get(_controller.delete_deployment.remote(name), timeout=30)
+
+
+def shutdown() -> None:
+    """Tear down all deployments + the controller/proxy."""
+    global _controller, _proxy, _router
+    with _lock:
+        if _controller is not None:
+            try:
+                ray_tpu.get(_controller.shutdown.remote(), timeout=30)
+                ray_tpu.kill(_controller)
+            except Exception:
+                pass
+        if _proxy is not None:
+            try:
+                ray_tpu.get(_proxy.shutdown.remote(), timeout=10)
+                ray_tpu.kill(_proxy)
+            except Exception:
+                pass
+        _controller = None
+        _proxy = None
+        _router = None

@@ -1,0 +1,98 @@
+"""HTTP proxy: stdlib threaded HTTP server inside an actor.
+
+ray: python/ray/serve/_private/http_proxy.py:234,415 (HTTPProxy/
+HTTPProxyActor, uvicorn-based).  This build uses ThreadingHTTPServer — no
+external deps, good enough for the controller-plane QPS the tests measure;
+the heavy lifting (batched JAX inference) happens in replicas, and each
+proxy request thread blocks only on its own ray_tpu.get.
+
+Routing: POST/GET /<deployment-name> with a JSON body (or query string) →
+Router.assign_request → JSON response.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+from urllib.parse import parse_qs, urlparse
+
+import ray_tpu
+from ray_tpu.serve.router import Router
+
+
+class HTTPProxy:
+    """Actor payload: owns the server thread + a Router."""
+
+    def __init__(self, controller_handle, host: str = "127.0.0.1", port: int = 0):
+        self._router = Router(controller_handle)
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _dispatch(self, body: Any):
+                path = urlparse(self.path)
+                deployment = path.path.strip("/").split("/")[0]
+                if not deployment:
+                    self._reply(404, {"error": "no deployment in path"})
+                    return
+                if body is None and path.query:
+                    q = {k: v[0] for k, v in parse_qs(path.query).items()}
+                    body = q or None
+                try:
+                    args = (body,) if body is not None else ()
+                    ref = proxy._router.assign_request(
+                        deployment, "__call__", args, {}
+                    )
+                    out = ray_tpu.get(ref, timeout=60)
+                    self._reply(200, {"result": out})
+                except Exception as e:  # noqa: BLE001 — HTTP boundary
+                    self._reply(500, {"error": str(e)})
+
+            def _reply(self, code: int, payload):
+                try:
+                    data = json.dumps(payload).encode()
+                except TypeError:
+                    data = json.dumps({"result": repr(payload)}).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._dispatch(None)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n) if n else b""
+                body = None
+                if raw:
+                    try:
+                        body = json.loads(raw)
+                    except Exception:
+                        body = raw.decode(errors="replace")
+                self._dispatch(body)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._port = self._server.server_address[1]
+        self._host = host
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="serve-http"
+        )
+        self._thread.start()
+
+    def address(self) -> str:
+        return f"http://{self._host}:{self._port}"
+
+    def port(self) -> int:
+        return self._port
+
+    def ping(self) -> str:
+        return "pong"
+
+    def shutdown(self) -> None:
+        self._server.shutdown()

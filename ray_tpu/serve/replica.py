@@ -1,0 +1,102 @@
+"""Replica: the actor that hosts one copy of a deployment's user callable.
+
+ray: python/ray/serve/_private/replica.py:57 (RayServeReplica;
+handle_request :507).  The replica actor runs with
+max_concurrency = max_concurrent_queries + control slots, so health checks
+and metrics answer even while every query slot is busy — the same reason the
+reference separates its control-plane concurrency group.
+"""
+
+from __future__ import annotations
+
+import inspect
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+
+class Replica:
+    """Actor payload.  Instantiated by the controller via
+    `ray_tpu.remote(Replica).options(...).remote(...)`."""
+
+    def __init__(
+        self,
+        deployment_name: str,
+        replica_id: str,
+        callable_blob: bytes,
+        init_args: tuple,
+        init_kwargs: dict,
+        user_config: Any = None,
+    ):
+        self._deployment_name = deployment_name
+        self._replica_id = replica_id
+        target = cloudpickle.loads(callable_blob)
+        if inspect.isclass(target):
+            self._callable = target(*init_args, **(init_kwargs or {}))
+            self._is_function = False
+        else:
+            self._callable = target
+            self._is_function = True
+        self._lock = threading.Lock()
+        self._ongoing = 0
+        self._processed = 0
+        self._start_time = time.time()
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    # -- data plane -------------------------------------------------------
+    def handle_request(self, method_name: str, args: tuple, kwargs: dict):
+        """Execute one request.  Called concurrently from the actor's
+        thread pool (one slot per in-flight query)."""
+        with self._lock:
+            self._ongoing += 1
+        try:
+            if self._is_function:
+                fn = self._callable
+            else:
+                fn = getattr(self._callable, method_name or "__call__")
+            out = fn(*args, **(kwargs or {}))
+            if inspect.iscoroutine(out):
+                import asyncio
+
+                out = asyncio.run(out)
+            return out
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+                self._processed += 1
+
+    # -- control plane ----------------------------------------------------
+    def reconfigure(self, user_config: Any) -> None:
+        """ray: replica.py reconfigure — forwarded to the user callable's
+        `reconfigure` method when it defines one."""
+        if not self._is_function and hasattr(self._callable, "reconfigure"):
+            self._callable.reconfigure(user_config)
+
+    def check_health(self) -> Dict[str, Any]:
+        """Liveness + the queue metric the autoscaler consumes
+        (ray: _private/autoscaling_metrics.py pushes; we pull on the same
+        health-check RPC to halve control traffic)."""
+        if not self._is_function and hasattr(self._callable, "check_health"):
+            # User-defined health check: raising marks the replica unhealthy.
+            self._callable.check_health()
+        with self._lock:
+            return {
+                "replica_id": self._replica_id,
+                "ongoing": self._ongoing,
+                "processed": self._processed,
+                "uptime_s": time.time() - self._start_time,
+            }
+
+    def prepare_for_shutdown(self, timeout_s: float = 5.0) -> bool:
+        """Drain: wait for in-flight queries to finish before the controller
+        kills the actor (ray: replica.py graceful shutdown)."""
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            with self._lock:
+                if self._ongoing == 0:
+                    return True
+            time.sleep(0.02)
+        return False

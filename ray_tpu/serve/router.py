@@ -1,0 +1,144 @@
+"""Router + DeploymentHandle: the request data plane.
+
+ray: python/ray/serve/_private/router.py:221 (ReplicaSet.assign_replica —
+power-of-two-choices with max-in-flight) and handle.py (DeploymentHandle).
+The router lives in the CALLER's process (driver or HTTP proxy actor) and
+talks straight to replica actors — the controller is only consulted to
+refresh membership (version-gated pull, see controller.get_routing_table),
+never per-request.  That keeps the request path one actor hop, the property
+the reference's direct actor transport exists for (SURVEY §3.6).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import ray_tpu
+
+
+class _ReplicaSet:
+    """Replica membership + local in-flight accounting for one deployment."""
+
+    def __init__(self, max_concurrent_queries: int):
+        self.max_concurrent = max_concurrent_queries
+        self.replicas: List[Tuple[str, Any]] = []  # (replica_id, handle)
+        self.inflight: Dict[str, List[Any]] = {}  # replica_id -> outstanding refs
+
+    def update(self, replicas: List[Tuple[str, Any]], max_concurrent: int):
+        self.replicas = list(replicas)
+        self.max_concurrent = max_concurrent
+        live = {rid for rid, _ in replicas}
+        self.inflight = {rid: refs for rid, refs in self.inflight.items() if rid in live}
+
+    def _purge(self, rid: str):
+        refs = self.inflight.get(rid)
+        if not refs:
+            return
+        done, pending = ray_tpu.wait(refs, num_returns=len(refs), timeout=0)
+        self.inflight[rid] = pending
+
+    def assign(self) -> Tuple[str, Any]:
+        """Pick a replica: power-of-two-choices on local in-flight count
+        (ray: router.py:221).  Blocks (with purging) while every replica is
+        at max_concurrent — that's the handle-side backpressure."""
+        if not self.replicas:
+            raise RuntimeError("no live replicas")
+        deadline = time.time() + 60.0
+        while True:
+            if len(self.replicas) == 1:
+                cands = [self.replicas[0]]
+            else:
+                cands = random.sample(self.replicas, 2)
+            for rid, _h in cands:
+                self._purge(rid)
+            rid, h = min(cands, key=lambda rh: len(self.inflight.get(rh[0], ())))
+            if len(self.inflight.get(rid, ())) < self.max_concurrent:
+                return rid, h
+            if time.time() > deadline:
+                raise TimeoutError(
+                    "all replicas at max_concurrent_queries for 60s"
+                )
+            time.sleep(0.001)
+
+
+class Router:
+    """Per-process router over all deployments (ray: router.py Router)."""
+
+    def __init__(self, controller_handle, refresh_interval_s: float = 0.25):
+        self._controller = controller_handle
+        self._interval = refresh_interval_s
+        self._lock = threading.Lock()
+        self._version = -1
+        self._last_refresh = 0.0
+        self._sets: Dict[str, _ReplicaSet] = {}
+
+    def _refresh(self, force: bool = False):
+        now = time.time()
+        if not force and now - self._last_refresh < self._interval:
+            return
+        self._last_refresh = now
+        out = ray_tpu.get(
+            self._controller.get_routing_table.remote(self._version), timeout=10
+        )
+        if out is None:
+            return
+        self._version = out["version"]
+        live = set(out["table"].keys())
+        for name, info in out["table"].items():
+            rs = self._sets.get(name)
+            if rs is None:
+                rs = self._sets[name] = _ReplicaSet(info["max_concurrent_queries"])
+            rs.update(info["replicas"], info["max_concurrent_queries"])
+        for name in list(self._sets.keys()):
+            if name not in live:
+                del self._sets[name]
+
+    def assign_request(
+        self, deployment: str, method_name: str, args: tuple, kwargs: dict
+    ):
+        """Pick a replica and submit; returns the result ObjectRef."""
+        with self._lock:
+            self._refresh()
+            rs = self._sets.get(deployment)
+            if rs is None or not rs.replicas:
+                # Maybe stale: force one refresh before failing.
+                self._refresh(force=True)
+                rs = self._sets.get(deployment)
+                if rs is None or not rs.replicas:
+                    raise RuntimeError(f"deployment {deployment!r} has no replicas")
+            rid, handle = rs.assign()
+            ref = handle.handle_request.remote(method_name, args, kwargs)
+            rs.inflight.setdefault(rid, []).append(ref)
+            return ref
+
+
+class DeploymentHandle:
+    """User-facing handle (ray: serve/handle.py DeploymentHandle).
+
+    `h.remote(*a)` calls the deployment's __call__; `h.method.remote(*a)`
+    calls a named method.  Results are ObjectRefs: ray_tpu.get() them."""
+
+    def __init__(self, deployment_name: str, router: Router, method_name: Optional[str] = None):
+        self._name = deployment_name
+        self._router = router
+        self._method = method_name
+
+    def options(self, *, method_name: Optional[str] = None) -> "DeploymentHandle":
+        return DeploymentHandle(self._name, self._router, method_name)
+
+    def remote(self, *args, **kwargs):
+        return self._router.assign_request(
+            self._name, self._method or "__call__", args, kwargs
+        )
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return DeploymentHandle(self._name, self._router, name)
+
+    def __repr__(self):
+        m = f".{self._method}" if self._method else ""
+        return f"DeploymentHandle({self._name}{m})"

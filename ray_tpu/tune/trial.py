@@ -38,6 +38,11 @@ class Trial:
     training_iteration: int = 0
     # scheduler bookkeeping survives checkpoint/restore via __dict__ pickling
     stopped_early: bool = False
+    # history/iteration high-water marks at the last checkpointed report —
+    # a failure retry truncates back to these so resumed runs don't
+    # duplicate steps in metrics_history
+    ckpt_history_len: int = 0
+    ckpt_training_iteration: int = 0
 
     def metric_value(self, metric: str) -> Optional[float]:
         if self.last_result is None:

@@ -21,8 +21,6 @@ from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu.air.checkpoint import Checkpoint
-from ray_tpu.air.result import Result
-from ray_tpu.tune import trial as trial_mod
 from ray_tpu.tune.schedulers import CONTINUE, RESTART, STOP, FIFOScheduler, TrialScheduler
 from ray_tpu.tune.search import Searcher
 from ray_tpu.tune.trial import ERROR, PAUSED, PENDING, RUNNING, TERMINATED, Trial
@@ -96,7 +94,11 @@ class TrialRunner:
         self.trials: List[Trial] = trials or []
         self._actors: Dict[str, Any] = {}  # trial_id -> actor handle
         self._run_refs: Dict[str, Any] = {}  # trial_id -> run() ref
-        self._intentional_kills: set = set()
+        # Refs of intentionally killed runs (STOP/RESTART).  Keyed by REF,
+        # not trial id: a RESTART relaunches the same trial id immediately,
+        # and a trial-id key would leak onto the new run and swallow its
+        # real failures (hanging the whole experiment).
+        self._killed_refs: List[Any] = []
         self.searcher.set_search_properties(metric, mode)
         self.scheduler.set_search_properties(metric, mode)
         os.makedirs(experiment_dir, exist_ok=True)
@@ -147,18 +149,24 @@ class TrialRunner:
         self.checkpoint_experiment()
 
     def _process_running(self):
+        self._drain_killed_refs()
         running = [t for t in self.trials if t.status == RUNNING]
         if not running:
             return
-        # drain reports (poll every live actor in one round)
-        polls = {}
+        # Drain reports: fire every poll first so the RPCs run concurrently,
+        # then gather — one slow/dying actor must not serialize the round.
+        poll_refs = {}
         for t in running:
             try:
-                polls[t.trial_id] = ray_tpu.get(
-                    self._actors[t.trial_id].poll.remote(), timeout=30
-                )
+                poll_refs[t.trial_id] = self._actors[t.trial_id].poll.remote()
             except Exception:
-                polls[t.trial_id] = None  # actor died; completion check below
+                poll_refs[t.trial_id] = None
+        polls = {}
+        for tid, ref in poll_refs.items():
+            try:
+                polls[tid] = ray_tpu.get(ref, timeout=30) if ref is not None else None
+            except Exception:
+                polls[tid] = None  # actor died; completion check below
         for t in running:
             p = polls.get(t.trial_id)
             if p:
@@ -182,18 +190,40 @@ class TrialRunner:
                 self.searcher.on_trial_complete(tid, t.last_result, error=False)
                 self.scheduler.on_trial_complete(t, t.last_result)
             except Exception as e:
-                if tid in self._intentional_kills:
-                    self._intentional_kills.discard(tid)
-                    continue  # STOP/RESTART path already set the status
                 t.num_failures += 1
                 if self.max_failures < 0 or t.num_failures <= self.max_failures:
                     self._cleanup_actor(tid)
+                    # Drop the failed attempt's post-checkpoint reports so
+                    # the resumed run doesn't duplicate steps in
+                    # metrics_history (same contract as
+                    # DataParallelTrainer's history truncation).
+                    del t.metrics_history[t.ckpt_history_len :]
+                    t.training_iteration = t.ckpt_training_iteration
+                    t.last_result = (
+                        t.metrics_history[-1] if t.metrics_history else None
+                    )
                     t.status = PENDING  # retry from last checkpoint
                 else:
                     t.error = repr(e)
                     self._finish(t, ERROR)
                     self.searcher.on_trial_complete(tid, t.last_result, error=True)
             self.checkpoint_experiment()
+
+    def _drain_killed_refs(self):
+        """Consume run refs of intentionally killed actors (their
+        ActorDiedError is expected and must not be classified as a trial
+        failure)."""
+        still = []
+        for ref in self._killed_refs:
+            ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=0)
+            if not ready:
+                still.append(ref)
+                continue
+            try:
+                ray_tpu.get(ref, timeout=1)
+            except Exception:
+                pass
+        self._killed_refs = still
 
     def _final_drain(self, t: Trial):
         """A trainable may return between polls: drain reports buffered after
@@ -218,17 +248,26 @@ class TrialRunner:
         t.metrics_history.append(result)
         if rep.get("checkpoint") is not None:
             t.checkpoint = rep["checkpoint"]
+            t.ckpt_history_len = len(t.metrics_history)
+            t.ckpt_training_iteration = t.training_iteration
         self.searcher.on_trial_result(t.trial_id, result)
-        decision = self.scheduler.on_trial_result(t, result)
         if final:
-            # trainable already returned; record only, no lifecycle action
+            # Trainable already returned: record only.  The scheduler is NOT
+            # consulted — a PBT RESTART decision here would mutate
+            # config/checkpoint (exploit from a donor) and then be discarded,
+            # leaving the finished trial reporting a donor's checkpoint.
             return CONTINUE
+        decision = self.scheduler.on_trial_result(t, result)
         if decision == CONTINUE and self._should_stop(result):
             decision = STOP
         if decision == STOP:
             self._kill(t.trial_id)
             t.stopped_early = True
             self._finish(t, TERMINATED)
+            # Early-stopped trials complete too: searchers that learn from
+            # outcomes (search.py plug-in seam) must see every completion.
+            self.searcher.on_trial_complete(t.trial_id, t.last_result, error=False)
+            self.scheduler.on_trial_complete(t, t.last_result)
         elif decision == RESTART:
             # PBT exploit: scheduler already mutated t.config/t.checkpoint
             self._kill(t.trial_id)
@@ -252,7 +291,12 @@ class TrialRunner:
         return next(t for t in self.trials if t.trial_id == tid)
 
     def _kill(self, tid: str):
-        self._intentional_kills.add(tid)
+        # Move the run ref out of the completion sweep's view: its eventual
+        # ActorDiedError is expected, and a RESTART will reuse the trial id
+        # for a fresh run ref immediately.
+        ref = self._run_refs.pop(tid, None)
+        if ref is not None:
+            self._killed_refs.append(ref)
         self._cleanup_actor(tid)
 
     def _cleanup_actor(self, tid: str):
@@ -262,7 +306,7 @@ class TrialRunner:
                 ray_tpu.kill(actor)
             except Exception:
                 pass
-        # leave _run_refs entry: the completion sweep consumes + classifies it
+        # leave any _run_refs entry: the completion sweep consumes + classifies it
 
     def _finish(self, t: Trial, status: str):
         t.status = status

@@ -132,6 +132,7 @@ class Tuner:
         run_config: Optional[RunConfig] = None,
         _trials: Optional[List[Trial]] = None,
     ):
+        self._orig_trainable = trainable
         self._trainable = _as_trainable(trainable)
         self._param_space = param_space or {}
         self._tune_config = tune_config or TuneConfig()
@@ -163,6 +164,16 @@ class Tuner:
             except Exception:
                 cpus = 4.0
             per = (tc.resources_per_trial or {"CPU": 1.0}).get("CPU", 1.0) or 1.0
+            # A trainer trainable spawns nested worker actors from inside
+            # the trial; their CPUs must count against per-trial demand or
+            # the trial actors alone saturate the cluster and the nested
+            # workers deadlock in the scheduler queue.
+            from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+
+            if isinstance(self._orig_trainable, DataParallelTrainer):
+                sc = self._orig_trainable.scaling_config
+                if sc is not None:
+                    per += sc.num_workers * sc.worker_resources().get("CPU", 1.0)
             max_concurrent = max(1, int(cpus // per))
         failure_cfg = self._run_config.failure_config
         runner = TrialRunner(
