@@ -1,0 +1,41 @@
+"""ray_tpu.rllib — reinforcement learning on the actor runtime.
+
+ray: rllib/ — Algorithm over rollout-worker actors
+(algorithms/algorithm.py:145, evaluation/rollout_worker.py:885) with the
+new Learner stack (core/learner/learner.py:89).  TPU-first redesign:
+
+- envs are vectorized from the start (one jitted policy call per step for
+  the whole env batch, not per-env Python loops);
+- the learner's epoch×minibatch SGD is ONE jitted lax.scan program;
+- weights broadcast to runners as a single object-store put per iteration.
+
+PPO is the flagship algorithm (CartPole learning smoke test in
+tests/test_rllib.py mirrors the reference's --as-test reward-threshold
+pattern).
+"""
+
+from ray_tpu.rllib.env import (
+    CartPoleVectorEnv,
+    VectorEnv,
+    make_vector_env,
+    register_env,
+)
+from ray_tpu.rllib.env_runner import EnvRunner
+from ray_tpu.rllib.policy import JaxPolicy, apply_policy, init_policy_params
+from ray_tpu.rllib.ppo import PPO, PPOConfig
+from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae
+
+__all__ = [
+    "CartPoleVectorEnv",
+    "EnvRunner",
+    "JaxPolicy",
+    "PPO",
+    "PPOConfig",
+    "SampleBatch",
+    "VectorEnv",
+    "apply_policy",
+    "compute_gae",
+    "init_policy_params",
+    "make_vector_env",
+    "register_env",
+]

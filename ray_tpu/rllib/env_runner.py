@@ -1,0 +1,113 @@
+"""EnvRunner: the rollout actor.
+
+ray: rllib/evaluation/rollout_worker.py:165,885 (RolloutWorker.sample) —
+TPU-first redesign: the runner steps a VECTORIZED env and calls the policy
+once per step on the whole env batch (one jitted dispatch), instead of the
+reference's per-env Python sampling loop.  GAE post-processing happens
+runner-side (matching the reference's postprocess_trajectory placement) so
+the learner receives ready-to-train columns.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.env import make_vector_env
+from ray_tpu.rllib.policy import JaxPolicy
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS,
+    ADVANTAGES,
+    LOGPS,
+    OBS,
+    RETURNS,
+    SampleBatch,
+    compute_gae,
+)
+
+
+class EnvRunner:
+    """Actor payload: owns a VectorEnv + a JaxPolicy copy."""
+
+    def __init__(
+        self,
+        env: str | Callable,
+        num_envs: int,
+        rollout_length: int,
+        *,
+        gamma: float = 0.99,
+        lam: float = 0.95,
+        seed: int = 0,
+        hidden=(64, 64),
+    ):
+        self.env = make_vector_env(env, num_envs, seed=seed)
+        self.rollout_length = rollout_length
+        self.gamma = gamma
+        self.lam = lam
+        self.policy = JaxPolicy(
+            self.env.observation_size, self.env.num_actions, seed=seed, hidden=hidden
+        )
+        self._obs = self.env.reset(seed=seed)
+
+    def set_weights(self, weights) -> None:
+        self.policy.set_weights(weights)
+
+    def sample(self, weights: Optional[Any] = None) -> Dict[str, Any]:
+        """Collect rollout_length × num_envs steps; returns a flat
+        SampleBatch (dict of [T*N] arrays) + episode stats."""
+        if weights is not None:
+            self.policy.set_weights(weights)
+        T, N = self.rollout_length, self.env.num_envs
+        obs_buf = np.zeros((T, N, self.env.observation_size), dtype=np.float32)
+        act_buf = np.zeros((T, N), dtype=np.int64)
+        logp_buf = np.zeros((T, N), dtype=np.float32)
+        val_buf = np.zeros((T, N), dtype=np.float32)
+        rew_buf = np.zeros((T, N), dtype=np.float32)
+        done_buf = np.zeros((T, N), dtype=bool)
+
+        obs = self._obs
+        for t in range(T):
+            actions, logps, values = self.policy.compute_actions(obs)
+            obs_buf[t] = obs
+            act_buf[t] = actions
+            logp_buf[t] = logps
+            val_buf[t] = values
+            final_obs, rewards, terminated, truncated = self.env.step(actions)
+            if truncated.any():
+                # Time-limit cutoffs are NOT terminations: bootstrap the
+                # truncated state's value into the reward so GAE doesn't
+                # learn conflicting V-targets for late-episode states.
+                idx = np.nonzero(truncated)[0]
+                _, _, v_final = self.policy.compute_actions(final_obs[idx])
+                rewards = rewards.copy()
+                rewards[idx] += self.gamma * v_final
+            rew_buf[t] = rewards
+            done_buf[t] = terminated | truncated  # both cut the GAE trace
+            obs = self.env.current_obs()
+        self._obs = obs
+
+        # Bootstrap the value of the final observation for unfinished envs.
+        _, _, last_values = self.policy.compute_actions(obs)
+        adv, rets = compute_gae(
+            rew_buf, val_buf, done_buf, last_values, self.gamma, self.lam
+        )
+        # Only the columns the learner consumes are shipped (REWARDS/DONES/
+        # VALUES already did their job in the GAE computation above).
+        batch = SampleBatch(
+            {
+                OBS: obs_buf.reshape(T * N, -1),
+                ACTIONS: act_buf.reshape(-1),
+                LOGPS: logp_buf.reshape(-1),
+                ADVANTAGES: adv.reshape(-1),
+                RETURNS: rets.reshape(-1),
+            }
+        )
+        return {
+            "batch": dict(batch),
+            "episode_returns": self.env.drain_episode_returns(),
+            "steps": T * N,
+        }
+
+    def ping(self) -> str:
+        return "pong"

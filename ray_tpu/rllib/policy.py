@@ -1,0 +1,86 @@
+"""JaxPolicy: actor-critic network + jitted inference/loss.
+
+ray: rllib/policy/torch_policy_v2.py + core/rl_module/rl_module.py —
+re-designed as pure-functional JAX: params are a pytree, inference is one
+jitted batch call (`compute_actions`), and the PPO loss is a pure function
+the learner differentiates.  No framework wrapper classes: functional
+transforms ARE the abstraction.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def init_policy_params(
+    key: jax.Array, obs_size: int, num_actions: int, hidden: Tuple[int, ...] = (64, 64)
+) -> Dict[str, Any]:
+    """MLP torso + separate policy/value heads (orthogonal init — the PPO
+    baseline choice)."""
+
+    def ortho(key, shape, scale):
+        return jax.nn.initializers.orthogonal(scale)(key, shape)
+
+    keys = jax.random.split(key, len(hidden) + 2)
+    params = {"torso": [], "pi": None, "vf": None}
+    sizes = (obs_size,) + hidden
+    for i in range(len(hidden)):
+        params["torso"].append(
+            {
+                "w": ortho(keys[i], (sizes[i], sizes[i + 1]), jnp.sqrt(2.0)),
+                "b": jnp.zeros(sizes[i + 1]),
+            }
+        )
+    params["pi"] = {
+        "w": ortho(keys[-2], (sizes[-1], num_actions), 0.01),
+        "b": jnp.zeros(num_actions),
+    }
+    params["vf"] = {"w": ortho(keys[-1], (sizes[-1], 1), 1.0), "b": jnp.zeros(1)}
+    return params
+
+
+def apply_policy(params: Dict[str, Any], obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """obs [B, obs_size] → (logits [B, A], value [B])."""
+    h = obs
+    for layer in params["torso"]:
+        h = jnp.tanh(h @ layer["w"] + layer["b"])
+    logits = h @ params["pi"]["w"] + params["pi"]["b"]
+    value = (h @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+    return logits, value
+
+
+@functools.partial(jax.jit, static_argnums=())
+def _sample_actions(params, obs, key):
+    logits, value = apply_policy(params, obs)
+    action = jax.random.categorical(key, logits)
+    logp = jax.nn.log_softmax(logits)
+    logp_a = jnp.take_along_axis(logp, action[:, None], axis=1)[:, 0]
+    return action, logp_a, value
+
+
+class JaxPolicy:
+    """Stateful convenience wrapper used by env runners: params + rng."""
+
+    def __init__(self, obs_size: int, num_actions: int, seed: int = 0, hidden=(64, 64)):
+        self.obs_size = obs_size
+        self.num_actions = num_actions
+        key = jax.random.PRNGKey(seed)
+        self._key, init_key = jax.random.split(key)
+        self.params = init_policy_params(init_key, obs_size, num_actions, hidden)
+
+    def set_weights(self, params) -> None:
+        self.params = jax.tree_util.tree_map(jnp.asarray, params)
+
+    def get_weights(self):
+        return jax.tree_util.tree_map(np.asarray, self.params)
+
+    def compute_actions(self, obs: np.ndarray):
+        """Batch inference: [N, obs] → (actions [N], logp [N], values [N])."""
+        self._key, sub = jax.random.split(self._key)
+        a, lp, v = _sample_actions(self.params, jnp.asarray(obs), sub)
+        return np.asarray(a), np.asarray(lp), np.asarray(v)

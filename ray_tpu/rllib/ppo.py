@@ -1,0 +1,252 @@
+"""PPO: config, jitted learner, and the Algorithm driving rollout actors.
+
+ray: rllib/algorithms/ppo/ppo.py:335,376 (PPO.training_step),
+core/learner/learner.py:89 (loss/update split), learner_group.py:43.
+TPU-first: the learner's epoch×minibatch SGD loop is ONE jitted
+lax.scan program — minibatching, loss, grads, and optimizer updates all
+fuse into a single XLA computation per train iteration (the reference runs
+a Python loop of torch forward/backcward per minibatch).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import make_vector_env
+from ray_tpu.rllib.env_runner import EnvRunner
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS,
+    ADVANTAGES,
+    LOGPS,
+    OBS,
+    RETURNS,
+    SampleBatch,
+)
+
+
+class PPOConfig:
+    """Builder-style config (ray: rllib/algorithms/algorithm_config.py)."""
+
+    def __init__(self):
+        self.env: Optional[str | Callable] = None
+        self.num_env_runners = 2
+        self.num_envs_per_runner = 8
+        self.rollout_length = 64
+        self.gamma = 0.99
+        self.lam = 0.95
+        self.lr = 3e-4
+        self.clip_param = 0.2
+        self.entropy_coeff = 0.01
+        self.vf_coeff = 0.5
+        self.num_epochs = 4
+        self.minibatch_size = 256
+        self.hidden = (64, 64)
+        self.seed = 0
+
+    # -- builder sections (mirror the reference's fluent API) -------------
+    def environment(self, env: str | Callable) -> "PPOConfig":
+        self.env = env
+        return self
+
+    def env_runners(
+        self, num_env_runners: int = 2, num_envs_per_runner: int = 8,
+        rollout_length: int = 64,
+    ) -> "PPOConfig":
+        self.num_env_runners = num_env_runners
+        self.num_envs_per_runner = num_envs_per_runner
+        self.rollout_length = rollout_length
+        return self
+
+    def training(self, **kw) -> "PPOConfig":
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise TypeError(f"unknown PPO training option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def debugging(self, seed: int = 0) -> "PPOConfig":
+        self.seed = seed
+        return self
+
+    def build(self) -> "PPO":
+        if self.env is None:
+            raise ValueError("call .environment(env) first")
+        return PPO(self)
+
+
+def _make_learner(config: PPOConfig, obs_size: int, num_actions: int):
+    """Build (init_state, update) — update is one jitted scan over
+    epochs×minibatches."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.rllib.policy import apply_policy, init_policy_params
+
+    opt = optax.adam(config.lr)
+    clip, ent_c, vf_c = config.clip_param, config.entropy_coeff, config.vf_coeff
+
+    def init_state(seed: int):
+        key = jax.random.PRNGKey(seed)
+        params = init_policy_params(key, obs_size, num_actions, config.hidden)
+        return {"params": params, "opt_state": opt.init(params), "key": key}
+
+    def loss_fn(params, mb):
+        logits, values = apply_policy(params, mb[OBS])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(logp_all, mb[ACTIONS][:, None], axis=1)[:, 0]
+        ratio = jnp.exp(logp - mb[LOGPS])
+        adv = mb[ADVANTAGES]
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        pg1 = ratio * adv
+        pg2 = jnp.clip(ratio, 1.0 - clip, 1.0 + clip) * adv
+        pg_loss = -jnp.mean(jnp.minimum(pg1, pg2))
+        vf_loss = jnp.mean((values - mb[RETURNS]) ** 2)
+        entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=1))
+        total = pg_loss + vf_c * vf_loss - ent_c * entropy
+        return total, (pg_loss, vf_loss, entropy)
+
+    def update(state, batch):
+        """batch: dict of [B] device arrays, B divisible into minibatches."""
+        B = batch[ACTIONS].shape[0]
+        mb_size = min(config.minibatch_size, B)
+        n_mb = max(B // mb_size, 1)
+        used = n_mb * mb_size
+
+        def epoch_step(carry, key):
+            params, opt_state = carry
+            perm = jax.random.permutation(key, B)[:used]
+
+            def mb_step(carry, idx):
+                params, opt_state = carry
+                mb = {k: v[idx] for k, v in batch.items()}
+                (total, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                    params, mb
+                )
+                updates, opt_state = opt.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), (total, *aux)
+
+            idxs = perm.reshape(n_mb, mb_size)
+            (params, opt_state), metrics = jax.lax.scan(
+                mb_step, (params, opt_state), idxs
+            )
+            return (params, opt_state), metrics
+
+        key, *epoch_keys = jax.random.split(state["key"], config.num_epochs + 1)
+        (params, opt_state), metrics = jax.lax.scan(
+            epoch_step,
+            (state["params"], state["opt_state"]),
+            jnp.stack(epoch_keys),
+        )
+        out_metrics = {
+            "total_loss": metrics[0].mean(),
+            "policy_loss": metrics[1].mean(),
+            "vf_loss": metrics[2].mean(),
+            "entropy": metrics[3].mean(),
+        }
+        return {"params": params, "opt_state": opt_state, "key": key}, out_metrics
+
+    return init_state, jax.jit(update, donate_argnums=(0,))
+
+
+class PPO:
+    """ray: Algorithm (algorithms/algorithm.py:145) — train() runs one
+    iteration: broadcast weights → parallel sample → learner update."""
+
+    def __init__(self, config: PPOConfig):
+        self.config = config
+        ray_tpu.init(ignore_reinit_error=True)
+        probe = make_vector_env(config.env, 1, seed=0)
+        self._obs_size = probe.observation_size
+        self._num_actions = probe.num_actions
+        init_state, self._update = _make_learner(
+            config, self._obs_size, self._num_actions
+        )
+        self._state = init_state(config.seed)
+        RunnerActor = ray_tpu.remote(EnvRunner)
+        self.runners = [
+            RunnerActor.remote(
+                config.env,
+                config.num_envs_per_runner,
+                config.rollout_length,
+                gamma=config.gamma,
+                lam=config.lam,
+                seed=config.seed + 1000 * (i + 1),
+                hidden=config.hidden,
+            )
+            for i in range(config.num_env_runners)
+        ]
+        ray_tpu.get([r.ping.remote() for r in self.runners], timeout=120)
+        self.iteration = 0
+        self._total_steps = 0
+        self._episode_returns: List[float] = []
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree_util.tree_map(np.asarray, self._state["params"])
+
+    def set_weights(self, weights) -> None:
+        import jax.numpy as jnp
+        import jax
+
+        self._state["params"] = jax.tree_util.tree_map(jnp.asarray, weights)
+
+    def train(self) -> Dict[str, Any]:
+        """One training iteration (ray: Algorithm.step :730)."""
+        t0 = time.time()
+        weights_ref = ray_tpu.put(self.get_weights())
+        results = ray_tpu.get(
+            [r.sample.remote(weights_ref) for r in self.runners], timeout=300
+        )
+        batch = SampleBatch.concat_samples([SampleBatch(r["batch"]) for r in results])
+        for r in results:
+            self._episode_returns.extend(r["episode_returns"])
+            self._total_steps += r["steps"]
+        self._episode_returns = self._episode_returns[-100:]
+
+        import jax.numpy as jnp
+
+        device_batch = {
+            k: jnp.asarray(batch[k]) for k in (OBS, ACTIONS, LOGPS, ADVANTAGES, RETURNS)
+        }
+        self._state, metrics = self._update(self._state, device_batch)
+        self.iteration += 1
+        mean_ret = float(np.mean(self._episode_returns)) if self._episode_returns else 0.0
+        sample_time = time.time() - t0
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": mean_ret,
+            "num_env_steps_sampled": self._total_steps,
+            "env_steps_per_sec": batch.count / max(sample_time, 1e-9),
+            **{k: float(v) for k, v in metrics.items()},
+        }
+
+    # -- checkpointing (ray: Algorithm.save/restore) ----------------------
+    def save(self, path: Optional[str] = None) -> str:
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        ckpt = Checkpoint.from_dict(
+            {"weights": self.get_weights(), "iteration": self.iteration}
+        )
+        return ckpt.to_directory(path)
+
+    def restore(self, path: str) -> None:
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        d = Checkpoint.from_directory(path).to_dict()
+        self.set_weights(d["weights"])
+        self.iteration = d["iteration"]
+
+    def stop(self) -> None:
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        self.runners = []

@@ -1,0 +1,156 @@
+"""RLlib tests: env dynamics, GAE, policy, and the PPO CartPole learning
+smoke test (the reference's `--as-test` reward-threshold pattern,
+rllib/tuned_examples/).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import (
+    CartPoleVectorEnv,
+    PPOConfig,
+    SampleBatch,
+    compute_gae,
+)
+from ray_tpu.rllib.policy import JaxPolicy
+
+
+def test_cartpole_vector_env_dynamics():
+    env = CartPoleVectorEnv(num_envs=4, seed=0)
+    obs = env.reset()
+    assert obs.shape == (4, 4)
+    assert np.all(np.abs(obs) <= 0.05)
+    total_done = 0
+    for _ in range(300):
+        actions = np.random.randint(0, 2, size=4)
+        obs, rew, terminated, truncated = env.step(actions)
+        assert obs.shape == (4, 4) and rew.shape == (4,)
+        assert np.all(rew == 1.0)
+        assert not truncated.any()  # random policy never survives 500 steps
+        total_done += int((terminated | truncated).sum())
+    # Random policy on CartPole terminates in ~20 steps: plenty of episodes.
+    assert total_done > 10
+    rets = env.drain_episode_returns()
+    assert len(rets) == total_done
+    assert 5 <= np.mean(rets) <= 200
+
+
+def test_gae_matches_manual():
+    # T=3, N=1, no terminations: hand-check the recursion.
+    rewards = np.array([[1.0], [1.0], [1.0]], dtype=np.float32)
+    values = np.array([[0.5], [0.6], [0.7]], dtype=np.float32)
+    dones = np.zeros((3, 1), dtype=bool)
+    bootstrap = np.array([0.8], dtype=np.float32)
+    gamma, lam = 0.9, 0.8
+    adv, ret = compute_gae(rewards, values, dones, bootstrap, gamma, lam)
+    d2 = 1.0 + gamma * 0.8 - 0.7
+    d1 = 1.0 + gamma * 0.7 - 0.6
+    d0 = 1.0 + gamma * 0.6 - 0.5
+    a2 = d2
+    a1 = d1 + gamma * lam * a2
+    a0 = d0 + gamma * lam * a1
+    np.testing.assert_allclose(adv[:, 0], [a0, a1, a2], rtol=1e-5)
+    np.testing.assert_allclose(ret, adv + values, rtol=1e-6)
+
+
+def test_gae_resets_at_done():
+    rewards = np.ones((2, 1), dtype=np.float32)
+    values = np.zeros((2, 1), dtype=np.float32)
+    dones = np.array([[True], [False]])
+    bootstrap = np.array([5.0], dtype=np.float32)
+    adv, _ = compute_gae(rewards, values, dones, bootstrap, 0.99, 0.95)
+    # Step 0 terminated: its advantage must NOT bootstrap through step 1.
+    assert adv[0, 0] == pytest.approx(1.0)
+
+
+def test_policy_shapes_and_determinism():
+    pol = JaxPolicy(obs_size=4, num_actions=2, seed=0)
+    obs = np.random.randn(16, 4).astype(np.float32)
+    a, lp, v = pol.compute_actions(obs)
+    assert a.shape == (16,) and lp.shape == (16,) and v.shape == (16,)
+    assert set(np.unique(a)).issubset({0, 1})
+    assert np.all(lp <= 0)
+    w = pol.get_weights()
+    pol2 = JaxPolicy(obs_size=4, num_actions=2, seed=123)
+    pol2.set_weights(w)
+    # Same weights → same value predictions (action sampling differs by rng).
+    _, _, v2 = pol2.compute_actions(obs)
+    np.testing.assert_allclose(v, v2, rtol=1e-5)
+
+
+def test_sample_batch_concat_and_minibatch():
+    b1 = SampleBatch({"x": np.arange(4), "y": np.arange(4) * 2})
+    b2 = SampleBatch({"x": np.arange(4, 6), "y": np.arange(4, 6) * 2})
+    c = SampleBatch.concat_samples([b1, b2])
+    assert c.count == 6
+    mbs = list(c.minibatches(3))
+    assert len(mbs) == 2 and all(mb.count == 3 for mb in mbs)
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_ppo_cartpole_learns(rt):
+    """PPO on CartPole with 2 rollout workers must clearly learn
+    (reference: rllib/tuned_examples/ppo/cartpole-ppo.yaml, --as-test)."""
+    config = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=2, num_envs_per_runner=8, rollout_length=64)
+        .training(lr=1e-3, num_epochs=8, minibatch_size=128, entropy_coeff=0.005)
+        .debugging(seed=7)
+    )
+    algo = config.build()
+    try:
+        first = None
+        best = 0.0
+        for _ in range(100):
+            result = algo.train()
+            if first is None and result["episode_reward_mean"] > 0:
+                first = result["episode_reward_mean"]
+            best = max(best, result["episode_reward_mean"])
+            if best >= 120.0:
+                break
+        assert first is not None, "no episodes completed"
+        assert best >= 120.0, (
+            f"PPO failed to learn: first={first:.1f}, best={best:.1f}"
+        )
+        assert result["num_env_steps_sampled"] > 0
+        assert np.isfinite(result["total_loss"])
+    finally:
+        algo.stop()
+
+
+def test_ppo_checkpoint_roundtrip(rt, tmp_path):
+    config = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=1, num_envs_per_runner=4, rollout_length=16)
+        .debugging(seed=3)
+    )
+    algo = config.build()
+    try:
+        algo.train()
+        path = algo.save(str(tmp_path / "ckpt"))
+        w_before = algo.get_weights()
+        it_before = algo.iteration
+
+        algo2 = config.build()
+        algo2.restore(path)
+        w_after = algo2.get_weights()
+        assert algo2.iteration == it_before
+        import jax
+
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6),
+            w_before,
+            w_after,
+        )
+        algo2.stop()
+    finally:
+        algo.stop()
