@@ -1107,10 +1107,17 @@ class Runtime:
 
     # -- virtual nodes (test fixture: ray: python/ray/cluster_utils.py:99) ---
 
-    def add_node(self, num_cpus: float = 1.0, resources: Optional[Dict] = None) -> str:
+    def add_node(
+        self,
+        num_cpus: float = 1.0,
+        resources: Optional[Dict] = None,
+        labels: Optional[Dict[str, str]] = None,
+    ) -> str:
         res = {"CPU": float(num_cpus), **(resources or {})}
         nid = ids.node_id()
-        self.state.register_node(NodeInfo(nid, dict(res), dict(res)))
+        self.state.register_node(
+            NodeInfo(nid, dict(res), dict(res), labels=dict(labels or {}))
+        )
         with self.lock:
             self._dispatch()
         return nid

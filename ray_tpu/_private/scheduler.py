@@ -228,7 +228,6 @@ class Scheduler:
 
         if strategy in ("STRICT_PACK", "PACK", "MESH"):
             # try one node first
-            planned: Dict[str, Dict[str, float]] = {}
             for node in sorted(nodes, key=_utilization):
                 ok = True
                 extra: Dict[str, float] = {}
@@ -246,9 +245,16 @@ class Scheduler:
                     return {i: node.node_id for i in range(len(bundles))}
             if strategy == "STRICT_PACK":
                 return None
-            # PACK/MESH fall through to best-effort spread; MESH additionally
-            # requires the chosen hosts to be ICI-contiguous (labels carry the
-            # host's mesh coordinate -- single-host clusters trivially satisfy).
+            if strategy == "MESH":
+                # Multi-node MESH: hosts must form a contiguous axis-aligned
+                # box of the ICI torus (node label "mesh_coord"), one bundle
+                # per host, bundles ordered by host coordinate (lexicographic
+                # — box contiguity, not a ring: adjacent ranks may still
+                # cross a row boundary).  No fallback: a gang whose
+                # collectives would cross non-adjacent hosts must FAIL to
+                # place, not silently degrade (SURVEY.md §7 hard parts).
+                return self._plan_mesh_box(bundles, nodes)
+            # PACK falls through to best-effort spread.
         if strategy == "STRICT_SPREAD" and len(bundles) > len(nodes):
             return None
         assignment: Dict[int, str] = {}
@@ -270,6 +276,64 @@ class Scheduler:
             for k, v in bundle.items():
                 e[k] = e.get(k, 0.0) + v
         return assignment
+
+    def _plan_mesh_box(
+        self, bundles: List[Dict[str, float]], nodes: List[NodeInfo]
+    ) -> Optional[Dict[int, str]]:
+        """Find len(bundles) hosts whose mesh_coord labels form a contiguous
+        axis-aligned box, each with room for its bundle.
+
+        The TPU-native analogue of STRICT_PACK: the reference packs for
+        locality on one machine (bundle_scheduling_policy.h); on a pod,
+        locality means ICI adjacency, which is a coordinate-box property.
+        """
+        n = len(bundles)
+        by_coord: Dict[Tuple[int, ...], NodeInfo] = {}
+        for node in nodes:
+            raw = node.labels.get("mesh_coord")
+            if raw is None:
+                continue
+            try:
+                coord = tuple(int(x) for x in raw.split(","))
+            except ValueError:
+                continue
+            by_coord[coord] = node
+        if len(by_coord) < n:
+            return None
+        dims = {len(c) for c in by_coord}
+        if len(dims) != 1:
+            return None  # inconsistent labels
+        d = dims.pop()
+
+        def factorizations(m: int, k: int):
+            if k == 1:
+                yield (m,)
+                return
+            for f in range(1, m + 1):
+                if m % f == 0:
+                    for rest in factorizations(m // f, k - 1):
+                        yield (f,) + rest
+
+        for shape in factorizations(n, d):
+            for anchor in by_coord:
+                box = list(
+                    itertools.product(
+                        *[range(a, a + s) for a, s in zip(anchor, shape)]
+                    )
+                )
+                if any(c not in by_coord for c in box):
+                    continue
+                assignment: Dict[int, str] = {}
+                ok = True
+                for i, coord in enumerate(sorted(box)):
+                    node = by_coord[coord]
+                    if not _available(node, bundles[i]):
+                        ok = False
+                        break
+                    assignment[i] = node.node_id
+                if ok:
+                    return assignment
+        return None
 
     def remove_placement_group(self, pg: PlacementGroupInfo) -> None:
         with self.lock:

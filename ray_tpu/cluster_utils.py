@@ -24,8 +24,15 @@ class Cluster:
         self._rt = get_runtime()
         self.head_node_id = self._rt.head_node_id
 
-    def add_node(self, num_cpus: float = 1.0, resources: Optional[Dict] = None) -> str:
-        nid = self._rt.add_node(num_cpus=num_cpus, resources=resources)
+    def add_node(
+        self,
+        num_cpus: float = 1.0,
+        resources: Optional[Dict] = None,
+        labels: Optional[Dict[str, str]] = None,
+    ) -> str:
+        """labels: node metadata; "mesh_coord" (e.g. "0,1") marks the host's
+        ICI torus coordinate, consumed by the MESH placement strategy."""
+        nid = self._rt.add_node(num_cpus=num_cpus, resources=resources, labels=labels)
         self._nodes.append(nid)
         return nid
 

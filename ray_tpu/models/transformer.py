@@ -169,6 +169,36 @@ def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
     return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight.astype(x.dtype)
 
 
+def _ambient_mesh():
+    """The mesh from an enclosing `with mesh:` scope, if any."""
+    from jax.interpreters import pxla
+
+    m = pxla.thread_resources.env.physical_mesh
+    return None if m.empty else m
+
+
+def _fitting_axis(axis, mesh, dim: int) -> Optional[str]:
+    """Resolve a rules entry to a single mesh axis name that divides dim."""
+    if axis is None or mesh is None:
+        return None
+    if isinstance(axis, tuple):
+        axis = axis[0] if axis else None
+    if axis not in mesh.axis_names:
+        return None
+    return axis if dim % mesh.shape[axis] == 0 and mesh.shape[axis] > 1 else None
+
+
+def _ring_axis(rules: Optional[Rules], mesh, q: jax.Array) -> Optional[str]:
+    """The mesh axis to run ring attention over, or None for local attention.
+
+    Non-None iff the strategy shards act_seq onto a real (>1) mesh axis that
+    divides the sequence length — exactly the case where plain attention
+    would silently all-gather the sequence."""
+    if rules is None:
+        return None
+    return _fitting_axis(rules.get("act_seq"), mesh, q.shape[1])
+
+
 def _layer(
     x: jax.Array,
     layer_params: Dict,
@@ -198,7 +228,28 @@ def _layer(
     q = checkpoint_name(q, "q")
     kk = checkpoint_name(kk, "k")
     vv = checkpoint_name(vv, "v")
-    attn = dot_product_attention(q, kk, vv, causal=True, impl=c.attention_impl)
+    ring_mesh = mesh if mesh is not None else _ambient_mesh()
+    ring_axis = _ring_axis(rules, ring_mesh, q)
+    if ring_axis is not None:
+        # Sequence parallelism: activations are seq-sharded, so full
+        # attention would force XLA to all-gather the sequence.  Ring
+        # attention keeps KV rotating over ICI instead
+        # (ops/ring_attention.py; SURVEY.md §5.7 — novel, no reference
+        # counterpart).
+        from ray_tpu.ops.ring_attention import ring_attention_sharded
+
+        head_ax = _fitting_axis(rules.get("act_heads"), ring_mesh, q.shape[2])
+        if head_ax is not None and kk.shape[2] % ring_mesh.shape[head_ax] != 0:
+            head_ax = None  # GQA kv heads don't divide: replicate heads
+        attn = ring_attention_sharded(
+            q, kk, vv, ring_mesh,
+            seq_axis=ring_axis,
+            batch_axes=rules.get("act_batch"),
+            head_axis=head_ax,
+            causal=True,
+        )
+    else:
+        attn = dot_product_attention(q, kk, vv, causal=True, impl=c.attention_impl)
     attn = checkpoint_name(attn, "attn")
     attn_out = jnp.einsum("bshd,hde->bse", attn, layer_params["attn"]["wo"].astype(dt))
     x = x + constrain(attn_out, ("act_batch", "act_seq", "act_embed"))

@@ -111,16 +111,31 @@ def ring_attention_sharded(
     """Convenience wrapper: shard_map ring_attention over a mesh.
 
     Inputs are global [B, S, H, D] arrays; S is sharded over seq_axis, B over
-    batch_axes, heads over head_axis.
+    batch_axes, heads over head_axis.  The caller must ensure S divides the
+    seq-axis size (the model dispatcher checks); batch/head specs are
+    shape-fitted — a dim that doesn't divide runs replicated, which is
+    correct, just unsharded.
     """
     from jax import shard_map
 
-    spec = P(batch_axes, seq_axis, head_axis, None)
+    from ray_tpu.parallel.sharding import _fit_spec
+
+    def fit(x):
+        spec = P(batch_axes, seq_axis, head_axis, None)
+        fitted = _fit_spec(x.shape, spec, mesh)
+        if fitted[1] != seq_axis:
+            raise ValueError(
+                f"seq length {x.shape[1]} not divisible by mesh axis "
+                f"{seq_axis!r} ({mesh.shape[seq_axis]})"
+            )
+        return fitted
+
+    qspec, kspec = fit(q), fit(k)
     body = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
     return shard_map(
         body,
         mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
+        in_specs=(qspec, kspec, kspec),
+        out_specs=qspec,
         check_vma=False,
     )(q, k, v)

@@ -78,10 +78,13 @@ class EnvRunner:
                 # Time-limit cutoffs are NOT terminations: bootstrap the
                 # truncated state's value into the reward so GAE doesn't
                 # learn conflicting V-targets for late-episode states.
+                # Evaluate on the full fixed-shape [N] batch and index on
+                # host — a final_obs[idx] batch would retrigger XLA
+                # compilation for every distinct truncation count.
                 idx = np.nonzero(truncated)[0]
-                _, _, v_final = self.policy.compute_actions(final_obs[idx])
+                _, _, v_final = self.policy.compute_actions(final_obs)
                 rewards = rewards.copy()
-                rewards[idx] += self.gamma * v_final
+                rewards[idx] += self.gamma * v_final[idx]
             rew_buf[t] = rewards
             done_buf[t] = terminated | truncated  # both cut the GAE trace
             obs = self.env.current_obs()

@@ -9,7 +9,6 @@ transforms ARE the abstraction.
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Dict, Tuple
 
 import jax
@@ -54,7 +53,7 @@ def apply_policy(params: Dict[str, Any], obs: jax.Array) -> Tuple[jax.Array, jax
     return logits, value
 
 
-@functools.partial(jax.jit, static_argnums=())
+@jax.jit
 def _sample_actions(params, obs, key):
     logits, value = apply_policy(params, obs)
     action = jax.random.categorical(key, logits)

@@ -61,10 +61,20 @@ class PPOConfig:
         self.rollout_length = rollout_length
         return self
 
+    _TRAINING_KEYS = frozenset(
+        {
+            "gamma", "lam", "lr", "clip_param", "entropy_coeff", "vf_coeff",
+            "num_epochs", "minibatch_size", "hidden",
+        }
+    )
+
     def training(self, **kw) -> "PPOConfig":
         for k, v in kw.items():
-            if not hasattr(self, k):
-                raise TypeError(f"unknown PPO training option {k!r}")
+            if k not in self._TRAINING_KEYS:
+                raise TypeError(
+                    f"unknown PPO training option {k!r}; valid: "
+                    f"{sorted(self._TRAINING_KEYS)}"
+                )
             setattr(self, k, v)
         return self
 
@@ -229,18 +239,27 @@ class PPO:
 
     # -- checkpointing (ray: Algorithm.save/restore) ----------------------
     def save(self, path: Optional[str] = None) -> str:
+        """Full learner state: params + optimizer moments + RNG key, so a
+        restored run continues training exactly (not a weights-only resume
+        that resets Adam bias correction)."""
+        import jax
+
         from ray_tpu.air.checkpoint import Checkpoint
 
+        host_state = jax.tree_util.tree_map(np.asarray, self._state)
         ckpt = Checkpoint.from_dict(
-            {"weights": self.get_weights(), "iteration": self.iteration}
+            {"learner_state": host_state, "iteration": self.iteration}
         )
         return ckpt.to_directory(path)
 
     def restore(self, path: str) -> None:
+        import jax
+        import jax.numpy as jnp
+
         from ray_tpu.air.checkpoint import Checkpoint
 
         d = Checkpoint.from_directory(path).to_dict()
-        self.set_weights(d["weights"])
+        self._state = jax.tree_util.tree_map(jnp.asarray, d["learner_state"])
         self.iteration = d["iteration"]
 
     def stop(self) -> None:

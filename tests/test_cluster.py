@@ -148,3 +148,63 @@ def test_actor_restart_after_node_death(ray_start_cluster):
             time.sleep(0.2)
     else:
         pytest.fail("actor did not restart on surviving node")
+
+
+# -- MESH placement strategy (TPU-native; no reference counterpart) ----------
+
+
+def _pg_nodes(pg):
+    from ray_tpu._private.runtime import get_runtime
+
+    info = get_runtime().state.placement_groups[pg.id]
+    return info.bundle_nodes
+
+
+def test_mesh_pg_contiguous_box(ray_start_cluster):
+    """4 hosts at a 2x2 ICI box -> MESH gang placed, one bundle per host,
+    bundle order following mesh (coordinate) order."""
+    cluster = ray_start_cluster
+    coords = {}
+    for c in ("0,0", "0,1", "1,0", "1,1"):
+        nid = cluster.add_node(num_cpus=2, labels={"mesh_coord": c})
+        coords[nid] = c
+    pg = placement_group([{"CPU": 1}] * 4, strategy="MESH")
+    assert pg.wait(timeout_seconds=15)
+    assignment = _pg_nodes(pg)
+    assert len(set(assignment.values())) == 4
+    # bundle i -> i-th coordinate in lexicographic order
+    got = [coords[assignment[i]] for i in range(4)]
+    assert got == ["0,0", "0,1", "1,0", "1,1"]
+    remove_placement_group(pg)
+
+
+def test_mesh_pg_rejects_non_contiguous(ray_start_cluster):
+    """Hosts exist with room, but no contiguous box -> MESH must NOT place
+    (no silent fallback to spread)."""
+    cluster = ray_start_cluster
+    for c in ("0,0", "0,1", "5,5", "9,9"):
+        cluster.add_node(num_cpus=2, labels={"mesh_coord": c})
+    pg4 = placement_group([{"CPU": 1}] * 4, strategy="MESH")
+    assert not pg4.wait(timeout_seconds=2), "non-contiguous gang was placed"
+    # A 2-bundle gang fits the contiguous (0,0)-(0,1) pair.
+    pg2 = placement_group([{"CPU": 1}] * 2, strategy="MESH")
+    assert pg2.wait(timeout_seconds=15)
+    remove_placement_group(pg2)
+    remove_placement_group(pg4)
+
+
+def test_mesh_pg_unlabeled_nodes_single_host_ok(ray_start_cluster):
+    """Without mesh_coord labels a multi-host MESH gang cannot place, but a
+    gang that fits one host is trivially contiguous."""
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=4)  # no labels
+    single = placement_group([{"CPU": 1}] * 3, strategy="MESH")
+    assert single.wait(timeout_seconds=15)  # fits the 4-CPU node
+    nodes = set(_pg_nodes(single).values())
+    assert len(nodes) == 1
+    remove_placement_group(single)
+    # 7 bundles fit nowhere singly (head=2 + node=4 CPUs) and labels are
+    # missing -> must stay pending.
+    multi = placement_group([{"CPU": 1}] * 7, strategy="MESH")
+    assert not multi.wait(timeout_seconds=2)
+    remove_placement_group(multi)

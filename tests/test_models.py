@@ -42,6 +42,7 @@ def test_param_count_matches_config():
         ("fsdp", MeshSpec(data=2, fsdp=4)),
         ("tp", MeshSpec(data=2, tensor=4)),
         ("fsdp_tp", MeshSpec(data=2, fsdp=2, tensor=2)),
+        ("sp", MeshSpec(data=2, seq=4)),
     ],
 )
 def test_train_step_strategies_agree(strategy, spec):
@@ -77,3 +78,26 @@ def test_sequence_parallel_forward():
     with mesh:
         out = jax.jit(lambda p, t: forward(p, t, cfg, rules=rules))(params, toks)
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=1e-4, rtol=1e-4)
+
+
+def test_sp_actually_runs_ring_attention():
+    """The sp strategy must compile to collective-permute KV rotation, NOT
+    an all-gather of the sequence (the failure mode VERDICT r1 flagged:
+    seq-sharded activations + full attention = silent gather)."""
+    cfg = TransformerConfig.tiny(attention_impl="reference")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, cfg.vocab_size)
+
+    from ray_tpu.parallel import resolve_rules
+
+    mesh = build_mesh(MeshSpec(data=2, seq=4))
+    rules = resolve_rules("sp")
+    with mesh:
+        compiled = (
+            jax.jit(lambda p, t: forward(p, t, cfg, rules=rules))
+            .lower(params, toks)
+            .compile()
+        )
+    hlo = compiled.as_text()
+    assert "collective-permute" in hlo, "ring attention not dispatched"
+    assert hlo.count("all-gather") == 0, "sequence is being all-gathered"
