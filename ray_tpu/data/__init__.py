@@ -1,0 +1,37 @@
+"""ray_tpu.data — distributed datasets on the actor runtime.
+
+ray: python/ray/data/ (Dataset at dataset.py:163, read_api.py).  Blocks are
+object-store entries (row lists or columnar NumpyBlock); stages run as one
+task per block with the object store as the inter-stage buffer; all-to-all
+ops (repartition/shuffle/sort/groupby) are two-phase task graphs.
+"""
+
+from ray_tpu.data.block import Block, BlockAccessor, NumpyBlock
+from ray_tpu.data.dataset import Dataset
+from ray_tpu.data.read_api import (
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    read_csv,
+    read_json,
+    read_parquet,
+    read_text,
+)
+
+__all__ = [
+    "Block",
+    "BlockAccessor",
+    "Dataset",
+    "NumpyBlock",
+    "from_arrow",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "read_csv",
+    "read_json",
+    "read_parquet",
+    "read_text",
+]

@@ -1,17 +1,102 @@
 """Blocks: the unit of distributed data (ray: python/ray/data/block.py).
 
-A block is a list of rows (any Python objects; commonly dicts for tabular
-data) stored as one object in the object store.  BlockAccessor converts
-between row and batch ("numpy" dict-of-arrays / "pandas" / "pyarrow")
-formats at the edges; internally everything moves as row lists, which keeps
-the execution engine format-agnostic.
+Two physical block forms, one logical interface:
+
+- row blocks: a list of rows (any Python objects; commonly dicts) — the
+  universal fallback for heterogeneous data;
+- NumpyBlock: a dict of equal-length numpy column arrays — the TPU-relevant
+  tabular fast path.  Columnar blocks move through the object store as
+  pickle-5 out-of-band buffers (zero-copy via the shm store), slice without
+  row materialization, and hand `iter_batches` ready dict-of-array batches
+  for `device_put`.  map_batches(batch_format="numpy") keeps data columnar
+  end-to-end; converting to rows happens only when an op needs rows
+  (map/filter/sort/groupby).
+
+BlockAccessor converts between the forms at the edges; the execution engine
+(dataset.py) is form-agnostic through the helpers below.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, Iterable, List, Optional
+from typing import Any, Dict, Iterable, List, Optional, Union
 
-Block = List[Any]
+
+class NumpyBlock:
+    """Columnar block: dict of equal-length numpy arrays."""
+
+    __slots__ = ("columns",)
+
+    def __init__(self, columns: Dict[str, Any]):
+        import numpy as np
+
+        self.columns = {k: np.asarray(v) for k, v in columns.items()}
+        lens = {len(v) for v in self.columns.values()}
+        if len(lens) > 1:
+            raise ValueError(f"ragged columns: { {k: len(v) for k, v in self.columns.items()} }")
+
+    def __len__(self) -> int:
+        for v in self.columns.values():
+            return len(v)
+        return 0
+
+    def slice(self, start: int, end: int) -> "NumpyBlock":
+        return NumpyBlock({k: v[start:end] for k, v in self.columns.items()})
+
+    def __iter__(self):
+        # Row iteration (slow path) — only taken by row-oriented ops.
+        return iter(batch_to_rows(self.columns))
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return NumpyBlock({k: v[idx] for k, v in self.columns.items()})
+        return {k: _unwrap(v[idx]) for k, v in self.columns.items()}
+
+    def __reduce__(self):
+        return (NumpyBlock, (self.columns,))
+
+
+Block = Union[List[Any], NumpyBlock]
+
+
+def block_len(block: Block) -> int:
+    return len(block)
+
+
+def block_slice(block: Block, start: int, end: int) -> Block:
+    if isinstance(block, NumpyBlock):
+        return block.slice(start, end)
+    return block[start:end]
+
+
+def block_rows(block: Block) -> List[Any]:
+    if isinstance(block, NumpyBlock):
+        return batch_to_rows(block.columns)
+    return block
+
+
+def concat_blocks(blocks: List[Block]) -> Block:
+    """Concatenate, staying columnar when every input is columnar with the
+    same schema."""
+    import numpy as np
+
+    blocks = [b for b in blocks if len(b)]
+    if not blocks:
+        return []
+    if len(blocks) == 1:
+        return blocks[0]  # zero-copy: np.concatenate([x]) would copy
+    if all(isinstance(b, NumpyBlock) for b in blocks) and len(
+        {tuple(sorted(b.columns)) for b in blocks}
+    ) == 1:
+        return NumpyBlock(
+            {
+                k: np.concatenate([b.columns[k] for b in blocks])
+                for k in blocks[0].columns
+            }
+        )
+    out: List[Any] = []
+    for b in blocks:
+        out.extend(block_rows(b))
+    return out
 
 
 class BlockAccessor:
@@ -22,9 +107,21 @@ class BlockAccessor:
         return len(self.block)
 
     def to_rows(self) -> List[Any]:
-        return self.block
+        return block_rows(self.block)
 
     def to_batch(self, batch_format: str = "numpy"):
+        if isinstance(self.block, NumpyBlock):
+            if batch_format in ("numpy", "dict"):
+                return dict(self.block.columns)
+            if batch_format == "pandas":
+                import pandas as pd
+
+                return pd.DataFrame(self.block.columns)
+            if batch_format == "pyarrow":
+                import pyarrow as pa
+
+                return pa.table(dict(self.block.columns))
+            raise ValueError(f"unknown batch_format {batch_format!r}")
         rows = self.block
         if batch_format in ("numpy", "dict"):
             return rows_to_numpy_batch(rows)
@@ -43,6 +140,8 @@ class BlockAccessor:
         raise ValueError(f"unknown batch_format {batch_format!r}")
 
     def schema(self):
+        if isinstance(self.block, NumpyBlock):
+            return {k: str(v.dtype) for k, v in self.block.columns.items()}
         if not self.block:
             return None
         row = self.block[0]
@@ -70,7 +169,7 @@ def batch_to_rows(batch: Any) -> List[Any]:
             return []
         n = len(batch[keys[0]])
         if keys == ["value"]:
-            return [batch["value"][i] for i in range(n)]
+            return [_unwrap(batch["value"][i]) for i in range(n)]
         return [{k: _unwrap(batch[k][i]) for k in keys} for i in range(n)]
     if isinstance(batch, list):
         return batch

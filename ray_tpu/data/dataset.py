@@ -22,12 +22,24 @@ import ray_tpu
 from ray_tpu.data.block import (
     Block,
     BlockAccessor,
+    NumpyBlock,
     batch_to_rows,
+    block_len,
+    block_rows,
+    block_slice,
+    concat_blocks,
     rows_to_numpy_batch,
 )
 
 
 # -- stage tasks (plain remote functions) -----------------------------------
+
+
+def _batch_output_to_block(out) -> Block:
+    """A map_batches fn's output → block; dict-of-arrays stays columnar."""
+    if isinstance(out, dict):
+        return NumpyBlock(out)
+    return batch_to_rows(out)
 
 
 @ray_tpu.remote
@@ -42,13 +54,12 @@ def _map_block(block: Block, fn_kind: str, fn: Callable, batch_format: str, batc
     if fn_kind == "filter":
         return [r for r in block if fn(r)]
     if fn_kind == "batches":
-        out: Block = []
-        bs = batch_size or len(block) or 1
-        for i in range(0, len(block), bs):
-            acc = BlockAccessor(block[i : i + bs])
-            res = fn(acc.to_batch(batch_format))
-            out.extend(batch_to_rows(res))
-        return out
+        bs = batch_size or block_len(block) or 1
+        outs = []
+        for i in range(0, block_len(block), bs):
+            acc = BlockAccessor(block_slice(block, i, i + bs))
+            outs.append(_batch_output_to_block(fn(acc.to_batch(batch_format))))
+        return concat_blocks(outs)
     if fn_kind == "block":
         return fn(block)
     raise ValueError(fn_kind)
@@ -73,34 +84,31 @@ def _partition_block(block: Block, n: int, key_fn, seed) -> List[Block]:
 
 @ray_tpu.remote
 def _block_len(block: Block) -> int:
-    return len(block)
+    return block_len(block)
 
 
 @ray_tpu.remote
 def _slice_block(block: Block, start: int, end: int) -> Block:
-    return block[start:end]
+    return block_slice(block, start, end)
 
 
 @ray_tpu.remote
 def _merge_shards(*shards: Block) -> Block:
-    out: Block = []
-    for s in shards:
-        out.extend(s)
-    return out
+    return concat_blocks(list(shards))
 
 
 @ray_tpu.remote
 def _merge_shuffle(seed, *shards: Block) -> Block:
-    out: Block = []
+    out: List[Any] = []
     for s in shards:
-        out.extend(s)
+        out.extend(block_rows(s))
     random.Random(seed).shuffle(out)
     return out
 
 
 @ray_tpu.remote
 def _sort_block(block: Block, key, descending: bool) -> Block:
-    return sorted(block, key=key, reverse=descending)
+    return sorted(block_rows(block), key=key, reverse=descending)
 
 
 @ray_tpu.remote
@@ -256,7 +264,7 @@ class Dataset:
     def take(self, limit: int = 20) -> List[Any]:
         out: List[Any] = []
         for b in self._block_refs:
-            rows = ray_tpu.get(b)
+            rows = block_rows(ray_tpu.get(b))
             out.extend(rows[: limit - len(out)])
             if len(out) >= limit:
                 break
@@ -265,21 +273,17 @@ class Dataset:
     def take_all(self) -> List[Any]:
         out: List[Any] = []
         for b in self._block_refs:
-            out.extend(ray_tpu.get(b))
+            out.extend(block_rows(ray_tpu.get(b)))
         return out
 
     def count(self) -> int:
-        @ray_tpu.remote
-        def _len(b):
-            return len(b)
-
-        return sum(ray_tpu.get([_len.remote(b) for b in self._block_refs]))
+        return sum(ray_tpu.get([_block_len.remote(b) for b in self._block_refs]))
 
     def schema(self):
         for b in self._block_refs:
-            rows = ray_tpu.get(b)
-            if rows:
-                return BlockAccessor(rows).schema()
+            blk = ray_tpu.get(b)
+            if block_len(blk):
+                return BlockAccessor(blk).schema()
         return None
 
     def num_blocks(self) -> int:
@@ -291,7 +295,7 @@ class Dataset:
 
     def iter_rows(self) -> Iterator[Any]:
         for b in self._block_refs:
-            yield from ray_tpu.get(b)
+            yield from block_rows(ray_tpu.get(b))
 
     def iter_batches(
         self,
@@ -301,16 +305,31 @@ class Dataset:
         drop_last: bool = False,
     ) -> Iterator[Any]:
         """Streaming consumption: blocks are fetched as needed, carry-over
-        rows stitch batch boundaries across blocks
-        (ray: dataset.py:2875 / streaming_executor.py:34)."""
-        carry: Block = []
+        stitches batch boundaries across blocks (ray: dataset.py:2875 /
+        streaming_executor.py:34).  Columnar blocks slice without row
+        materialization — the batches handed to device_put are the stored
+        arrays.  Works identically inside train-worker actors: pass a split
+        Dataset to the worker and iterate there (block fetch is a local shm
+        mmap, no driver round-trip)."""
+        carry: List[Block] = []
+        carry_len = 0
         for b in self._block_refs:
-            carry.extend(ray_tpu.get(b))
-            while len(carry) >= batch_size:
-                chunk, carry = carry[:batch_size], carry[batch_size:]
-                yield BlockAccessor(chunk).to_batch(batch_format)
-        if carry and not drop_last:
-            yield BlockAccessor(carry).to_batch(batch_format)
+            blk = ray_tpu.get(b)
+            if block_len(blk) == 0:
+                continue
+            carry.append(blk)
+            carry_len += block_len(blk)
+            if carry_len >= batch_size:
+                merged = concat_blocks(carry)
+                off = 0
+                while carry_len - off >= batch_size:
+                    chunk = block_slice(merged, off, off + batch_size)
+                    off += batch_size
+                    yield BlockAccessor(chunk).to_batch(batch_format)
+                carry = [block_slice(merged, off, carry_len)] if off < carry_len else []
+                carry_len -= off
+        if carry_len and not drop_last:
+            yield BlockAccessor(concat_blocks(carry)).to_batch(batch_format)
 
     def to_pandas(self):
         return BlockAccessor(self.take_all()).to_batch("pandas")

@@ -35,7 +35,21 @@ def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001 — API par
 
 
 def from_numpy(arr, *, parallelism: int = 8) -> Dataset:
-    return from_items([{"value": x} for x in arr], parallelism=parallelism)
+    """Columnar from the start: shards of {"value": arr} NumpyBlocks."""
+    import numpy as np
+
+    from ray_tpu.data.block import NumpyBlock
+
+    arr = np.asarray(arr)
+    n = max(1, min(parallelism, len(arr) or 1))
+    size = (len(arr) + n - 1) // n if len(arr) else 0
+    slices = [
+        arr[i * size : (i + 1) * size] for i in builtins.range(n)
+    ] if size else []
+    blocks = [NumpyBlock({"value": s}) for s in slices if len(s)] or [
+        NumpyBlock({"value": arr})
+    ]
+    return Dataset([ray_tpu.put(b) for b in blocks])
 
 
 def from_pandas(df, *, parallelism: int = 8) -> Dataset:
@@ -47,10 +61,15 @@ def from_arrow(table, *, parallelism: int = 8) -> Dataset:
 
 
 @ray_tpu.remote
-def _read_parquet_file(path: str, columns) -> List[Dict]:
+def _read_parquet_file(path: str, columns):
+    """Parquet → columnar NumpyBlock (stays columnar through map_batches /
+    iter_batches; ray: datasource/parquet_datasource.py reads Arrow blocks)."""
     import pyarrow.parquet as pq
 
-    return pq.read_table(path, columns=columns).to_pylist()
+    from ray_tpu.data.block import NumpyBlock
+
+    table = pq.read_table(path, columns=columns)
+    return NumpyBlock({name: table[name].to_numpy() for name in table.column_names})
 
 
 @ray_tpu.remote

@@ -21,6 +21,7 @@ from ray_tpu.train.backend_executor import BackendExecutor, TrainingFailedError
 from ray_tpu.train.data_parallel_trainer import DataParallelTrainer, JaxTrainer
 from ray_tpu.train.session import (
     get_checkpoint,
+    get_dataset_shard,
     get_world_rank,
     get_world_size,
     report,
@@ -43,6 +44,7 @@ __all__ = [
     "TrainingFailedError",
     "WorkerGroup",
     "get_checkpoint",
+    "get_dataset_shard",
     "get_world_rank",
     "get_world_size",
     "report",

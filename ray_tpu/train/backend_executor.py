@@ -78,14 +78,23 @@ class BackendExecutor:
         resume_checkpoint: Optional[Checkpoint] = None,
         on_report: Optional[Callable[[int, Dict], None]] = None,
         poll_interval: float = 0.05,
+        dataset_shards: Optional[Dict[str, List[Any]]] = None,
     ) -> List[Dict[str, Any]]:
         """Run train_fn on all workers; stream reports; return each rank's
-        report list.  Raises TrainingFailedError on any rank failure."""
+        report list.  Raises TrainingFailedError on any rank failure.
+
+        dataset_shards: {name: [per-rank Dataset shard]} — rank i receives
+        shard i under session.get_dataset_shard(name)."""
         wg = self.worker_group
         assert wg is not None, "call start() first"
         done_refs = [
-            w.run_train_fn.remote(train_fn, config, resume_checkpoint)
-            for w in wg.workers
+            w.run_train_fn.remote(
+                train_fn,
+                config,
+                resume_checkpoint,
+                {name: shards[i] for name, shards in (dataset_shards or {}).items()},
+            )
+            for i, w in enumerate(wg.workers)
         ]
         all_reports: List[List[Dict]] = [[] for _ in wg.workers]
         finished = [False] * len(wg.workers)

@@ -32,6 +32,7 @@ class DataParallelTrainer:
         scaling_config: Optional[ScalingConfig] = None,
         run_config: Optional[RunConfig] = None,
         resume_from_checkpoint: Optional[Checkpoint] = None,
+        datasets: Optional[Dict[str, Any]] = None,
     ):
         self.train_loop_per_worker = train_loop_per_worker
         self.train_loop_config = train_loop_config
@@ -39,6 +40,11 @@ class DataParallelTrainer:
         self.scaling_config = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
         self.resume_from_checkpoint = resume_from_checkpoint
+        # {name: ray_tpu.data.Dataset} — split equally across ranks at fit()
+        # (equal row counts: unequal SPMD shards hang compiled collectives),
+        # exposed in workers via session.get_dataset_shard(name)
+        # (ray: DataParallelTrainer datasets= / session.get_dataset_shard).
+        self.datasets = datasets
 
     def fit(self) -> Result:
         import ray_tpu
@@ -79,11 +85,19 @@ class DataParallelTrainer:
                         latest_ckpt = rep["checkpoint"]
                         ckpt_history_len = len(history)
 
+                shards = None
+                if self.datasets:
+                    n = self.scaling_config.num_workers
+                    shards = {
+                        name: ds.split(n, equal=True)
+                        for name, ds in self.datasets.items()
+                    }
                 reports = executor.run_training(
                     self.train_loop_per_worker,
                     config=self.train_loop_config,
                     resume_checkpoint=latest_ckpt,
                     on_report=on_report,
+                    dataset_shards=shards,
                 )
                 metrics = history[-1] if history else {}
                 return Result(

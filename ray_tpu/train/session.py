@@ -24,12 +24,14 @@ class TrainSession:
         local_rank: int = 0,
         resume_checkpoint: Optional[Checkpoint] = None,
         experiment_name: str = "train",
+        dataset_shards: Optional[Dict[str, Any]] = None,
     ):
         self.rank = rank
         self.world_size = world_size
         self.local_rank = local_rank
         self.resume_checkpoint = resume_checkpoint
         self.experiment_name = experiment_name
+        self.dataset_shards = dataset_shards or {}
         self._lock = threading.Lock()
         self._reports: List[Dict[str, Any]] = []
         self.done = False
@@ -74,6 +76,17 @@ def report(metrics: Dict[str, Any], *, checkpoint: Optional[Checkpoint] = None) 
 
 def get_checkpoint() -> Optional[Checkpoint]:
     return get_session().resume_checkpoint
+
+
+def get_dataset_shard(name: str = "train"):
+    """This rank's Dataset shard (ray: session.get_dataset_shard) — block
+    refs resolve worker-side, so iteration never round-trips the driver."""
+    shards = get_session().dataset_shards
+    if name not in shards:
+        raise KeyError(
+            f"no dataset shard {name!r}; trainer datasets: {sorted(shards)}"
+        )
+    return shards[name]
 
 
 def get_world_rank() -> int:

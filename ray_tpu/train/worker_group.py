@@ -35,11 +35,18 @@ class TrainWorker:
         return {"hostname": socket.gethostname(), "pid": os.getpid(), "rank": self.rank}
 
     # -- training ---------------------------------------------------------
-    def run_train_fn(self, train_fn: Callable, config: Optional[Dict], resume_ckpt):
+    def run_train_fn(
+        self,
+        train_fn: Callable,
+        config: Optional[Dict],
+        resume_ckpt,
+        dataset_shards: Optional[Dict[str, Any]] = None,
+    ):
         self.session = init_session(
             rank=self.rank,
             world_size=self.world_size,
             resume_checkpoint=resume_ckpt,
+            dataset_shards=dataset_shards,
         )
         try:
             import inspect

@@ -1,0 +1,175 @@
+"""Data layer tests (the reference's python/ray/data/tests intents:
+test_dataset.py transforms/consumption, order preservation, equal splits,
+columnar blocks, file readers, worker-side iteration).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+from ray_tpu.data.block import NumpyBlock
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_range_map_filter_count(rt):
+    ds = rd.range(100, parallelism=8)
+    assert ds.count() == 100
+    out = ds.map(lambda x: x * 2).filter(lambda x: x % 10 == 0).take_all()
+    assert sorted(out) == [0, 10, 20, 30, 40, 50, 60, 70, 80, 90, 100, 110, 120, 130, 140, 150, 160, 170, 180, 190]
+
+
+def test_flat_map(rt):
+    ds = rd.from_items([1, 2, 3]).flat_map(lambda x: [x] * x)
+    assert sorted(ds.take_all()) == [1, 2, 2, 3, 3, 3]
+
+
+def test_map_batches_numpy_roundtrip(rt):
+    ds = rd.from_items([{"a": i, "b": float(i)} for i in range(32)], parallelism=4)
+    out = ds.map_batches(
+        lambda b: {"a": b["a"] + 1, "b": b["b"] * 2}, batch_size=8
+    ).take_all()
+    assert len(out) == 32
+    assert {r["a"] for r in out} == set(range(1, 33))
+    assert all(r["b"] == (r["a"] - 1) * 2 for r in out)
+
+
+def test_map_batches_stays_columnar(rt):
+    """dict-of-arrays outputs must stay NumpyBlock end-to-end (no row
+    materialization between stages)."""
+    ds = rd.from_numpy(np.arange(64), parallelism=4)
+    ds2 = ds.map_batches(lambda b: {"value": b["value"] * 3})
+    blk = ray_tpu.get(ds2._block_refs[0])
+    assert isinstance(blk, NumpyBlock)
+    batches = list(ds2.iter_batches(batch_size=16))
+    assert all(isinstance(b["value"], np.ndarray) for b in batches)
+    assert np.concatenate([b["value"] for b in batches]).tolist() == (
+        (np.arange(64) * 3).tolist()
+    )
+
+
+def test_repartition_preserves_order(rt):
+    ds = rd.range(50, parallelism=7).repartition(3)
+    assert ds.num_blocks() == 3
+    assert ds.take_all() == list(range(50))  # order-preserving
+
+
+def test_random_shuffle_is_permutation(rt):
+    ds = rd.range(40, parallelism=4)
+    out = ds.random_shuffle(seed=3).take_all()
+    assert sorted(out) == list(range(40))
+    assert out != list(range(40))  # astronomically unlikely to be identity
+
+
+def test_sort_and_groupby(rt):
+    ds = rd.from_items([5, 3, 8, 1, 9, 2], parallelism=3)
+    assert ds.sort().take_all() == [1, 2, 3, 5, 8, 9]
+    assert ds.sort(descending=True).take_all() == [9, 8, 5, 3, 2, 1]
+
+    grouped = rd.range(20, parallelism=4).groupby_aggregate(
+        key_fn=lambda x: x % 3, agg_fn=lambda k, vals: (k, sum(vals))
+    )
+    out = dict(grouped.take_all())
+    assert out == {0: sum(x for x in range(20) if x % 3 == 0),
+                   1: sum(x for x in range(20) if x % 3 == 1),
+                   2: sum(x for x in range(20) if x % 3 == 2)}
+
+
+def test_split_equal_exact_rows(rt):
+    """equal=True must yield EXACTLY equal shard sizes (unequal shards hang
+    compiled SPMD collectives — ADVICE r1 finding)."""
+    ds = rd.range(103, parallelism=5)
+    shards = ds.split(4, equal=True)
+    counts = [s.count() for s in shards]
+    assert counts == [25, 25, 25, 25]
+    # order-preserving: concatenation is a prefix of the original
+    allrows = [r for s in shards for r in s.take_all()]
+    assert allrows == list(range(100))
+
+
+def test_split_plain_covers_all_blocks(rt):
+    ds = rd.range(60, parallelism=6)
+    shards = ds.split(3)
+    assert sum(s.count() for s in shards) == 60
+    assert sorted(r for s in shards for r in s.take_all()) == list(range(60))
+
+
+def test_iter_batches_sizes_and_drop_last(rt):
+    ds = rd.range(25, parallelism=4)
+    sizes = [len(b["value"]) for b in ds.iter_batches(batch_size=10)]
+    assert sizes == [10, 10, 5]
+    sizes = [len(b["value"]) for b in ds.iter_batches(batch_size=10, drop_last=True)]
+    assert sizes == [10, 10]
+
+
+def test_worker_side_iteration(rt):
+    """A split shard handed to a worker iterates there — the SPMD input
+    pattern (no driver round-trip per batch)."""
+    ds = rd.from_numpy(np.arange(64), parallelism=8)
+    shards = ds.split(2, equal=True)
+
+    @ray_tpu.remote
+    def consume(shard):
+        total = 0
+        n_batches = 0
+        for b in shard.iter_batches(batch_size=8):
+            total += int(b["value"].sum())
+            n_batches += 1
+        return total, n_batches
+
+    outs = ray_tpu.get([consume.remote(s) for s in shards], timeout=60)
+    assert sum(t for t, _ in outs) == int(np.arange(64).sum())
+    assert all(n == 4 for _, n in outs)
+
+
+def test_union_and_schema(rt):
+    a = rd.from_items([{"x": 1}], parallelism=1)
+    b = rd.from_items([{"x": 2}], parallelism=1)
+    u = a.union(b)
+    assert u.count() == 2
+    assert u.schema() == {"x": "int"}
+    assert rd.from_numpy(np.arange(3, dtype=np.int32)).schema() == {"value": "int32"}
+
+
+def test_read_parquet_csv_json(rt, tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    table = pa.table({"a": list(range(10)), "b": [f"s{i}" for i in range(10)]})
+    pq.write_table(table, tmp_path / "part0.parquet")
+    pq.write_table(table, tmp_path / "part1.parquet")
+    ds = rd.read_parquet(str(tmp_path / "*.parquet"))
+    assert ds.count() == 20
+    blk = ray_tpu.get(ds._block_refs[0])
+    assert isinstance(blk, NumpyBlock)  # parquet reads columnar
+    assert ds.schema() is not None
+
+    csv_path = tmp_path / "t.csv"
+    csv_path.write_text("a,b\n1,x\n2,y\n")
+    assert rd.read_csv(str(csv_path)).take_all() == [
+        {"a": 1, "b": "x"},
+        {"a": 2, "b": "y"},
+    ]
+
+    json_path = tmp_path / "t.jsonl"
+    json_path.write_text('{"v": 1}\n{"v": 2}\n')
+    assert rd.read_json(str(json_path)).take_all() == [{"v": 1}, {"v": 2}]
+
+    txt = tmp_path / "t.txt"
+    txt.write_text("hello\nworld\n")
+    assert rd.read_text(str(txt)).take_all() == ["hello", "world"]
+
+
+def test_from_pandas_to_pandas(rt):
+    import pandas as pd
+
+    df = pd.DataFrame({"a": [1, 2, 3], "b": ["x", "y", "z"]})
+    ds = rd.from_pandas(df, parallelism=2)
+    out = ds.to_pandas()
+    assert sorted(out["a"].tolist()) == [1, 2, 3]
