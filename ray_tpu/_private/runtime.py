@@ -188,6 +188,21 @@ class Runtime:
         self.parked_gets: Dict[str, List[Tuple[str, int]]] = {}  # oid -> [(worker, req)]
         self.contained_map: Dict[str, List[str]] = {}  # oid -> contained oids
         self.pending_pgs: List[str] = []
+        # Lineage: producer TaskSpec per task-returned object, enabling
+        # re-execution when an object's bytes are lost (evicted / spill file
+        # gone) — ray: task_manager.h:97 lineage + object_recovery_manager.h:41.
+        # Bounded FIFO (the reference bounds by footprint bytes); actor tasks
+        # are excluded (actor state is not replayable).
+        from collections import OrderedDict
+
+        self.lineage: "OrderedDict[str, Any]" = OrderedDict()
+        self.lineage_max = int(os.environ.get("RAY_TPU_LINEAGE_MAX", "10000"))
+        # Footprint bound (bytes of retained args_blob) in addition to the
+        # entry-count cap — ray: task_manager.h:97-104 lineage accounting.
+        self.lineage_max_bytes = int(
+            os.environ.get("RAY_TPU_LINEAGE_MAX_BYTES", str(64 * 1024 * 1024))
+        )
+        self.lineage_bytes = 0
 
         from multiprocessing.connection import Listener
 
@@ -229,7 +244,14 @@ class Runtime:
         with self.lock:
             if self.store.refcount(oid) == 1:
                 contained = self.contained_map.pop(oid, None)
-            self.store.remove_ref(oid)
+            freed = self.store.remove_ref(oid)
+            if freed:
+                # No ref can ever need this object again — its lineage
+                # entry is dead weight (ray: lineage release callback,
+                # task_manager.h:116).
+                entry = self.lineage.pop(oid, None)
+                if entry is not None:
+                    self.lineage_bytes -= self._lineage_cost(entry)
         if contained:
             for c in contained:
                 self._decref_local(c)
@@ -535,7 +557,45 @@ class Runtime:
             if not self.store.is_ready(oid):
                 self.parked_gets.setdefault(oid, []).append((wid, req_id))
                 return _PARKED
-        return self._object_reply_value(oid)
+        try:
+            return self._object_reply_value(oid)
+        except ObjectLostError:
+            # Bytes vanished (evicted past spill / spill file lost): lineage
+            # re-execution (ray: object_recovery_manager.h:41) — park the
+            # request behind the reconstructed producer.
+            with self.lock:
+                if self._reconstruct(oid):
+                    self.parked_gets.setdefault(oid, []).append((wid, req_id))
+                    return _PARKED
+            raise
+
+    @staticmethod
+    def _lineage_cost(spec) -> int:
+        return len(spec.args_blob or b"") + 256  # blob + record overhead
+
+    def _reconstruct(self, oid: str) -> bool:
+        """Re-execute the producer task of a lost object.  Caller holds
+        self.lock.  Returns False when no lineage exists (driver put() /
+        actor-task outputs / lineage evicted)."""
+        spec = self.lineage.get(oid)
+        if spec is None:
+            return False
+        if spec.task_id in self.tasks:
+            return True  # reconstruction already in flight
+        # Invalidate readiness of every return of this task so gets re-park
+        # and wait() blocks until the re-execution completes.
+        with self.store._available:
+            for rid in spec.return_ids():
+                self.store._ready.pop(rid, None)
+        # Dependencies may have been freed since the original run: recurse
+        # up the lineage first (ray: recovery walks the lineage DAG).  A dep
+        # that is "ready" but with lost bytes is handled lazily when the
+        # worker's get parks on it.
+        for d in set(spec.deps):
+            if not self.store.is_ready(d) and not self._reconstruct(d):
+                return False
+        self.submit_task(spec)
+        return True
 
     def _object_reply_value(self, oid: str):
         err = self.store.error_for(oid)
@@ -763,6 +823,16 @@ class Runtime:
                 else:
                     self._put_packed(oid, data)
                 ready_ids.append(oid)
+                if spec.actor_id is None:
+                    if oid not in self.lineage:
+                        self.lineage_bytes += self._lineage_cost(spec)
+                    self.lineage[oid] = spec
+                    while self.lineage and (
+                        len(self.lineage) > self.lineage_max
+                        or self.lineage_bytes > self.lineage_max_bytes
+                    ):
+                        _, old = self.lineage.popitem(last=False)
+                        self.lineage_bytes -= self._lineage_cost(old)
             if spec.is_actor_creation:
                 self._on_actor_alive(spec.actor_id)
         else:
@@ -977,19 +1047,36 @@ class Runtime:
             if not isinstance(r, ObjectRef):
                 raise TypeError(f"ray_tpu.get() takes ObjectRefs, got {type(r)}")
         oids = [r.id for r in refs]
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
         ready = self.store.wait(oids, len(oids), timeout)
         if len(ready) < len(oids):
             raise GetTimeoutError(f"get timed out after {timeout}s")
-        values = []
-        for oid in oids:
+        values = [self._get_one_value(oid, deadline) for oid in oids]
+        return values[0] if single else values
+
+    def _get_one_value(self, oid: str, deadline: Optional[float]):
+        """Fetch + deserialize one ready object; transparently reconstruct
+        via lineage when its bytes are lost."""
+        import time as _time
+
+        for _ in range(3):  # bound cascading reconstructions per object
             err = self.store.error_for(oid)
             if err is not None:
                 raise err
             obj = self.store.get_sealed(oid)
-            if obj is None:
-                raise ObjectLostError(oid)
-            values.append(obj.deserialize())
-        return values[0] if single else values
+            if obj is not None:
+                return obj.deserialize()
+            with self.lock:
+                if not self._reconstruct(oid):
+                    raise ObjectLostError(oid)
+            remaining = (
+                None if deadline is None else max(deadline - _time.monotonic(), 0.0)
+            )
+            if not self.store.wait([oid], 1, remaining):
+                raise GetTimeoutError(f"reconstruction of {oid} timed out")
+        raise ObjectLostError(oid)
 
     async def get_async(self, ref: ObjectRef):
         import asyncio

@@ -34,6 +34,16 @@ def _default_shm_root() -> str:
     return tempfile.gettempdir()
 
 
+def _default_capacity(shm_dir: str) -> int:
+    """30% of the filesystem's free space at init (ray: plasma defaults to
+    30% of system memory, object_manager default_object_store_memory)."""
+    try:
+        st = os.statvfs(shm_dir)
+        return int(st.f_bavail * st.f_frsize * 0.3)
+    except OSError:
+        return 2 * 1024**3
+
+
 class SealedObject:
     """A stored, immutable object (serialized form + keepalive handles)."""
 
@@ -107,7 +117,12 @@ class OwnerStore:
     submissions flow through the owner in this runtime).
     """
 
-    def __init__(self, session_name: str, spill_dir: Optional[str] = None):
+    def __init__(
+        self,
+        session_name: str,
+        spill_dir: Optional[str] = None,
+        capacity_bytes: Optional[int] = None,
+    ):
         self.shm = ShmStore(session_name)
         self._mem: Dict[str, SealedObject] = {}
         self._in_shm: Dict[str, int] = {}  # id -> size
@@ -118,6 +133,28 @@ class OwnerStore:
         self._errors: Dict[str, Any] = {}  # id -> exception to raise on get
         self._spill_dir = spill_dir
         self._lock = threading.RLock()
+        # Capacity + LRU clock (ray: plasma_allocator.h:44 footprint cap,
+        # eviction_policy.h:105 LRUCache).  Overridable via env for tests/ops.
+        if capacity_bytes is None:
+            env = os.environ.get("RAY_TPU_OBJECT_STORE_MEMORY")
+            capacity_bytes = int(env) if env else _default_capacity(self.shm.dir)
+        self.capacity = capacity_bytes
+        self._clock = 0
+        self._last_access: Dict[str, int] = {}
+        self._shm_bytes = 0  # running total of _in_shm values
+        self._reserved = 0  # bytes admitted by _make_room but not yet sealed
+        # Background reclaimer: the worker-sealed path (mark_shm_sealed) runs
+        # on the runtime io thread under the global runtime lock — spill disk
+        # I/O there would stall all scheduling, so it only signals this
+        # thread (ray: local_object_manager spills async for the same
+        # reason).  Strict puts still reclaim inline: admission control must
+        # be synchronous.
+        self._reclaim_event = threading.Event()
+        self._reclaim_thread = threading.Thread(
+            target=self._reclaim_loop, daemon=True, name="raytpu-spill"
+        )
+        self._destroyed = False
+        self._reclaim_thread.start()
 
     # -- refcounting ---------------------------------------------------------
 
@@ -125,21 +162,25 @@ class OwnerStore:
         with self._lock:
             self._refcount[object_id] = self._refcount.get(object_id, 0) + n
 
-    def remove_ref(self, object_id: str, n: int = 1) -> None:
+    def remove_ref(self, object_id: str, n: int = 1) -> bool:
+        """Returns True when the count hit zero and the object was freed."""
         with self._lock:
             c = self._refcount.get(object_id, 0) - n
             if c > 0:
                 self._refcount[object_id] = c
-            else:
-                self._refcount.pop(object_id, None)
-                self._free(object_id)
+                return False
+            self._refcount.pop(object_id, None)
+            self._free(object_id)
+            return True
 
     def refcount(self, object_id: str) -> int:
         return self._refcount.get(object_id, 0)
 
     def _free(self, object_id: str) -> None:
         self._mem.pop(object_id, None)
-        if self._in_shm.pop(object_id, None) is not None:
+        size = self._in_shm.pop(object_id, None)
+        if size is not None:
+            self._shm_bytes -= size
             self.shm.delete(object_id)
         p = self._spilled.pop(object_id, None)
         if p:
@@ -149,17 +190,89 @@ class OwnerStore:
                 pass
         self._ready.pop(object_id, None)
         self._errors.pop(object_id, None)
+        self._last_access.pop(object_id, None)
 
     # -- put / seal ----------------------------------------------------------
+
+    def _touch(self, object_id: str) -> None:
+        self._clock += 1
+        self._last_access[object_id] = self._clock
+
+    def _usage(self) -> int:
+        return self._shm_bytes + self._reserved
+
+    def _make_room(self, incoming: int, strict: bool, reserve: bool = False) -> None:
+        """Reclaim shm (by SPILLING LRU objects to disk) until incoming fits
+        under capacity.
+
+        Spill-only, never delete: every sealed object stays retrievable via
+        transparent restore.  (Deleting refcount-0 objects would race the
+        seal→first-addref window — a just-created object has rc 0 until its
+        ObjectRef lands; unreferenced garbage is already freed eagerly by
+        remove_ref → _free, so there is nothing safe left to delete here.)
+
+        strict: raise ObjectStoreFullError when room cannot be made (caller
+        has not written yet — admission control).  Non-strict (bytes already
+        on tmpfs, e.g. a worker-sealed segment or a restore): tolerate the
+        overage.  reserve: on success, account `incoming` as reserved until
+        the caller seals or aborts — closes the check→write TOCTOU between
+        concurrent strict puts.
+        """
+        from ray_tpu.exceptions import ObjectStoreFullError
+
+        with self._lock:
+            if strict and incoming > self.capacity:
+                raise ObjectStoreFullError(
+                    f"object of {incoming} bytes exceeds store capacity "
+                    f"{self.capacity} bytes"
+                )
+            if self._usage() + incoming > self.capacity:
+                by_lru = sorted(
+                    self._in_shm, key=lambda o: self._last_access.get(o, 0)
+                )
+                for oid in by_lru:
+                    if self._usage() + incoming <= self.capacity:
+                        break
+                    self.spill(oid)
+            if strict and self._usage() + incoming > self.capacity:
+                raise ObjectStoreFullError(
+                    f"store full: {self._usage()} bytes used of "
+                    f"{self.capacity}, cannot fit {incoming} "
+                    f"(no spill dir or spill failed)"
+                )
+            if reserve:
+                self._reserved += incoming
+
+    def _reclaim_loop(self) -> None:
+        while not self._destroyed:
+            self._reclaim_event.wait(timeout=1.0)
+            if self._destroyed:
+                return
+            if not self._reclaim_event.is_set():
+                continue
+            self._reclaim_event.clear()
+            try:
+                self._make_room(0, strict=False)
+            except Exception:
+                pass  # reclaim is best-effort; next seal re-signals
 
     def put_serialized(
         self, object_id: str, payload: bytes, buffers: List[pickle.PickleBuffer]
     ) -> None:
         size = len(payload) + sum(len(b.raw()) for b in buffers)
         if size >= INLINE_THRESHOLD:
-            self.shm.create(object_id, payload, buffers)
+            self._make_room(size, strict=True, reserve=True)
+            try:
+                self.shm.create(object_id, payload, buffers)
+            except BaseException:
+                with self._lock:
+                    self._reserved -= size
+                raise
             with self._lock:
+                self._reserved -= size
                 self._in_shm[object_id] = size
+                self._shm_bytes += size
+                self._touch(object_id)
         else:
             obj = SealedObject(payload, [b.raw() for b in buffers])
             with self._lock:
@@ -177,9 +290,18 @@ class OwnerStore:
         self._mark_ready(object_id)
 
     def mark_shm_sealed(self, object_id: str, size: int) -> None:
-        """A worker already wrote the segment directly; record and publish."""
+        """A worker already wrote the segment directly; record and publish.
+        The bytes are on tmpfs already, so reclaim is best-effort and runs
+        on the background spill thread — this method is called on the
+        runtime io thread under the global runtime lock, where synchronous
+        disk I/O would stall all scheduling."""
         with self._lock:
             self._in_shm[object_id] = size
+            self._shm_bytes += size
+            self._touch(object_id)
+            over = self._usage() > self.capacity
+        if over:
+            self._reclaim_event.set()
         self._mark_ready(object_id)
 
     def _mark_ready(self, object_id: str) -> None:
@@ -219,6 +341,7 @@ class OwnerStore:
             if obj is not None:
                 return obj
             if object_id in self._in_shm:
+                self._touch(object_id)
                 return self.shm.get(object_id)
             p = self._spilled.get(object_id)
         if p:
@@ -240,18 +363,26 @@ class OwnerStore:
             f.write(ser.pack(bytes(obj.payload), [pickle.PickleBuffer(b) for b in obj.buffers]))
         with self._lock:
             self._spilled[object_id] = path
-            if self._in_shm.pop(object_id, None) is not None:
+            size = self._in_shm.pop(object_id, None)
+            if size is not None:
+                self._shm_bytes -= size
                 self.shm.delete(object_id)
         return path
 
     def _restore(self, object_id: str, path: str) -> None:
         with open(path, "rb") as f:
             data = f.read()
+        # Non-strict: the object exists and must come back even when it is
+        # individually larger than capacity (it got in via a worker-sealed
+        # overage) — raising here would make it permanently unreadable.
+        self._make_room(len(data), strict=False)
         payload, buffers = ser.unpack(memoryview(data))
         self.shm.create(object_id, bytes(payload), [pickle.PickleBuffer(b) for b in buffers])
         with self._lock:
             self._in_shm[object_id] = len(data)
+            self._shm_bytes += len(data)
             self._spilled.pop(object_id, None)
+            self._touch(object_id)
         try:
             os.unlink(path)
         except OSError:
@@ -259,7 +390,11 @@ class OwnerStore:
 
     def shm_usage(self) -> int:
         with self._lock:
-            return sum(self._in_shm.values())
+            return self._shm_bytes
 
     def destroy(self) -> None:
+        self._destroyed = True
+        self._reclaim_event.set()
         self.shm.destroy()
+        if self._spill_dir:
+            shutil.rmtree(self._spill_dir, ignore_errors=True)

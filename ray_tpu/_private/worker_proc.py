@@ -92,16 +92,22 @@ class WorkerRuntime:
         # Fast path: sealed segment already on this host's tmpfs.
         obj = self.shm.get(object_id)
         if obj is None:
-            kind, data = self.request("get_object", object_id)
-            if kind == "shm":
+            # The owner may spill the segment between its ("shm", None)
+            # reply and our mmap; re-requesting makes the owner restore it
+            # from the spill file (or reconstruct via lineage) — so a miss
+            # here is a retry, not a loss.
+            for _ in range(3):
+                kind, data = self.request("get_object", object_id)
+                if kind != "shm":
+                    payload, bufs = ser.unpack(memoryview(data))
+                    return ser.deserialize(payload, bufs, self.ref_factory)
                 obj = self.shm.get(object_id)
-                if obj is None:
-                    from ray_tpu.exceptions import ObjectLostError
-
-                    raise ObjectLostError(object_id)
+                if obj is not None:
+                    break
             else:
-                payload, bufs = ser.unpack(memoryview(data))
-                return ser.deserialize(payload, bufs, self.ref_factory)
+                from ray_tpu.exceptions import ObjectLostError
+
+                raise ObjectLostError(object_id)
         return obj.deserialize(self.ref_factory)
 
     def put_value(self, value: Any) -> str:

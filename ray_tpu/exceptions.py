@@ -59,6 +59,11 @@ class ObjectLostError(RayTpuError):
     pass
 
 
+class ObjectStoreFullError(RayTpuError):
+    """The shm store is at capacity and nothing can be evicted or spilled
+    (ray: plasma CreateRequestQueue backpressure → ObjectStoreFullError)."""
+
+
 class OwnerDiedError(ObjectLostError):
     pass
 
