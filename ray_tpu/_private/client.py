@@ -122,38 +122,58 @@ class CoreClient:
         obj = wr.shm.get(oid)
         if obj is not None:
             return obj.deserialize(wr.ref_factory)
-        try:
-            kind, data = wr.request("get_object", oid, timeout=timeout)
-        except _q.Empty:
-            raise GetTimeoutError(f"get({oid}) timed out")
-        if kind == "shm":
+        # A ("shm", None) reply can race the owner's spiller (segment
+        # unlinked before our mmap): re-request — the owner restores from
+        # the spill file or reconstructs via lineage.  One deadline covers
+        # all retries: the caller's timeout must not triple.
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for _ in range(3):
+            remaining = None if deadline is None else max(deadline - time.monotonic(), 0.0)
+            try:
+                kind, data = wr.request("get_object", oid, timeout=remaining)
+            except _q.Empty:
+                raise GetTimeoutError(f"get({oid}) timed out")
+            if kind != "shm":
+                payload, bufs = ser.unpack(memoryview(data))
+                return ser.deserialize(payload, bufs, wr.ref_factory)
             obj = wr.shm.get(oid)
-            if obj is None:
-                from ray_tpu.exceptions import ObjectLostError
+            if obj is not None:
+                return obj.deserialize(wr.ref_factory)
+        from ray_tpu.exceptions import ObjectLostError
 
-                raise ObjectLostError(oid)
-            return obj.deserialize(wr.ref_factory)
-        payload, bufs = ser.unpack(memoryview(data))
-        return ser.deserialize(payload, bufs, wr.ref_factory)
+        raise ObjectLostError(oid)
 
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
         wr = self._wr()
         if wr is None:
             return self._rt().wait_refs(refs, num_returns, timeout)
+        import queue as _q
+
+        # Event-driven: the owner parks each request until num_returns are
+        # ready (or its chunk timer lapses) and replies once — no poll loop.
+        # Chunking (30s server-side timers + a transport guard) bounds the
+        # damage of a lost reply: the next chunk re-asks instead of hanging.
         deadline = None if timeout is None else time.monotonic() + timeout
-        delay = 0.001
+        oids = [r.id for r in refs]
+        flags = [False] * len(refs)
         while True:
-            flags = wr.request("check_ready", [r.id for r in refs])
-            ready = [r for r, f in zip(refs, flags) if f]
-            if len(ready) >= num_returns or (
-                deadline is not None and time.monotonic() >= deadline
-            ):
-                ready = ready[:num_returns] if len(ready) >= num_returns else ready
-                ready_set = {r.id for r in ready}
-                not_ready = [r for r in refs if r.id not in ready_set]
-                return ready, not_ready
-            time.sleep(delay)
-            delay = min(delay * 2, 0.05)
+            remaining = None if deadline is None else deadline - time.monotonic()
+            chunk = 30.0 if remaining is None else max(min(remaining, 30.0), 0.0)
+            try:
+                flags = wr.request(
+                    "wait_objects", (oids, num_returns, chunk), timeout=chunk + 10
+                )
+            except _q.Empty:
+                pass  # lost reply: fall through and re-ask (or give up)
+            if sum(flags) >= num_returns:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+        ready = [r for r, f in zip(refs, flags) if f]
+        ready = ready[:num_returns] if len(ready) >= num_returns else ready
+        ready_set = {r.id for r in ready}
+        not_ready = [r for r in refs if r.id not in ready_set]
+        return ready, not_ready
 
     def cancel(self, ref: ObjectRef, force: bool = False) -> None:
         wr = self._wr()

@@ -1,0 +1,224 @@
+"""Core-runtime microbenchmarks (ray: python/ray/_private/ray_perf.py:93).
+
+Same workload shapes as the reference's `ray microbenchmark` so the numbers
+in BENCH_core_r*.json are comparable with BASELINE.md's table:
+
+  single_client_tasks_sync      submit f.remote(); get() one at a time
+  single_client_tasks_async     submit a window of tasks, get in batches
+  multi_client_tasks_async      N driver threads submitting concurrently
+  1_1_actor_calls_sync          one handle, call+get sequentially
+  1_1_actor_calls_async         one handle, windowed submission
+  n_n_actor_calls_async         N handles, N submitting threads
+  single_client_put_ops         small ray_tpu.put() throughput
+  single_client_put_gigabytes   1GB of 100MB puts + gets (zero-copy path)
+
+Run: `python -m ray_tpu._private.ray_perf [--json out.json]`
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List
+
+import ray_tpu
+
+
+def timeit(name: str, fn: Callable[[], int], warmup: int = 1, repeat: int = 3):
+    """Run fn (returns ops count) repeat times; report best ops/s."""
+    for _ in range(warmup):
+        fn()
+    best = 0.0
+    for _ in range(repeat):
+        t0 = time.perf_counter()
+        ops = fn()
+        dt = time.perf_counter() - t0
+        best = max(best, ops / dt)
+    return {"name": name, "ops_per_s": round(best, 1)}
+
+
+@ray_tpu.remote
+def _noop(*args):
+    return None
+
+
+@ray_tpu.remote
+class _Actor:
+    def noop(self, *args):
+        return None
+
+
+def bench_tasks_sync(n: int = 300) -> Dict:
+    def run():
+        for _ in range(n):
+            ray_tpu.get(_noop.remote(), timeout=60)
+        return n
+
+    return timeit("single_client_tasks_sync", run)
+
+
+def bench_tasks_async(n: int = 2000, window: int = 100) -> Dict:
+    def run():
+        refs: List = []
+        for _ in range(n):
+            refs.append(_noop.remote())
+            if len(refs) >= window:
+                ray_tpu.get(refs, timeout=120)
+                refs = []
+        if refs:
+            ray_tpu.get(refs, timeout=120)
+        return n
+
+    return timeit("single_client_tasks_async", run)
+
+
+def bench_multi_client_tasks_async(n_clients: int = 4, n_per: int = 1000) -> Dict:
+    def client():
+        refs = []
+        for _ in range(n_per):
+            refs.append(_noop.remote())
+            if len(refs) >= 100:
+                ray_tpu.get(refs, timeout=120)
+                refs = []
+        if refs:
+            ray_tpu.get(refs, timeout=120)
+
+    def run():
+        with ThreadPoolExecutor(n_clients) as pool:
+            futs = [pool.submit(client) for _ in range(n_clients)]
+            for f in futs:
+                f.result()
+        return n_clients * n_per
+
+    return timeit("multi_client_tasks_async", run)
+
+
+def bench_actor_calls_sync(n: int = 500) -> Dict:
+    a = _Actor.remote()
+    ray_tpu.get(a.noop.remote(), timeout=60)
+
+    def run():
+        for _ in range(n):
+            ray_tpu.get(a.noop.remote(), timeout=60)
+        return n
+
+    out = timeit("1_1_actor_calls_sync", run)
+    ray_tpu.kill(a)
+    return out
+
+
+def bench_actor_calls_async(n: int = 3000, window: int = 200) -> Dict:
+    a = _Actor.remote()
+    ray_tpu.get(a.noop.remote(), timeout=60)
+
+    def run():
+        refs = []
+        for _ in range(n):
+            refs.append(a.noop.remote())
+            if len(refs) >= window:
+                ray_tpu.get(refs, timeout=120)
+                refs = []
+        if refs:
+            ray_tpu.get(refs, timeout=120)
+        return n
+
+    out = timeit("1_1_actor_calls_async", run)
+    ray_tpu.kill(a)
+    return out
+
+
+def bench_n_n_actor_calls_async(n_actors: int = 4, n_per: int = 1000) -> Dict:
+    actors = [_Actor.remote() for _ in range(n_actors)]
+    ray_tpu.get([a.noop.remote() for a in actors], timeout=60)
+
+    def client(a):
+        refs = []
+        for _ in range(n_per):
+            refs.append(a.noop.remote())
+            if len(refs) >= 100:
+                ray_tpu.get(refs, timeout=120)
+                refs = []
+        if refs:
+            ray_tpu.get(refs, timeout=120)
+
+    def run():
+        with ThreadPoolExecutor(n_actors) as pool:
+            futs = [pool.submit(client, a) for a in actors]
+            for f in futs:
+                f.result()
+        return n_actors * n_per
+
+    out = timeit("n_n_actor_calls_async", run)
+    for a in actors:
+        ray_tpu.kill(a)
+    return out
+
+
+def bench_put_ops(n: int = 2000) -> Dict:
+    def run():
+        for i in range(n):
+            ray_tpu.put(i)
+        return n
+
+    return timeit("single_client_put_ops", run)
+
+
+def bench_put_gigabytes(total_gb: float = 1.0, chunk_mb: int = 100) -> Dict:
+    import numpy as np
+
+    chunk = np.zeros(chunk_mb * 1024 * 1024, dtype=np.uint8)
+    n_chunks = int(total_gb * 1024 / chunk_mb)
+
+    def run():
+        refs = [ray_tpu.put(chunk) for _ in range(n_chunks)]
+        for r in refs:
+            v = ray_tpu.get(r, timeout=120)
+            assert v.nbytes == chunk.nbytes
+        return 1
+
+    # report GB/s moved (put+get of total_gb counts as total_gb)
+    for _ in range(1):
+        run()
+    best = 0.0
+    for _ in range(2):
+        t0 = time.perf_counter()
+        run()
+        dt = time.perf_counter() - t0
+        best = max(best, total_gb / dt)
+    return {"name": "single_client_put_gigabytes", "gb_per_s": round(best, 2)}
+
+
+ALL = [
+    bench_tasks_sync,
+    bench_tasks_async,
+    bench_multi_client_tasks_async,
+    bench_actor_calls_sync,
+    bench_actor_calls_async,
+    bench_n_n_actor_calls_async,
+    bench_put_ops,
+    bench_put_gigabytes,
+]
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    out_path = None
+    if "--json" in argv:
+        out_path = argv[argv.index("--json") + 1]
+    ray_tpu.init(ignore_reinit_error=True)
+    results = []
+    for bench in ALL:
+        r = bench()
+        results.append(r)
+        print(json.dumps(r), flush=True)
+    ray_tpu.shutdown()
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(results, f, indent=1)
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -186,6 +186,7 @@ class Runtime:
         self.ready_queue: deque = deque()
         self.dep_waiters: Dict[str, Set[str]] = {}  # oid -> task_ids
         self.parked_gets: Dict[str, List[Tuple[str, int]]] = {}  # oid -> [(worker, req)]
+        self.parked_waits: Dict[str, List[dict]] = {}  # oid -> wait tokens
         self.contained_map: Dict[str, List[str]] = {}  # oid -> contained oids
         self.pending_pgs: List[str] = []
         # Lineage: producer TaskSpec per task-returned object, enabling
@@ -523,10 +524,8 @@ class Runtime:
             oid, force = payload
             self.cancel(oid, force)
             return None
-        if op == "check_ready":
-            return [
-                self.store.is_ready(o) for o in payload
-            ]
+        if op == "wait_objects":
+            return self._req_wait_objects(wid, req_id, *payload)
         if op == "kv_put":
             self.state.kv_put(*payload)
             return None
@@ -568,6 +567,62 @@ class Runtime:
                     self.parked_gets.setdefault(oid, []).append((wid, req_id))
                     return _PARKED
             raise
+
+    def _req_wait_objects(
+        self, wid: str, req_id: int, oids: List[str], num_returns: int,
+        timeout: Optional[float],
+    ):
+        """Event-driven worker wait (replaces the old check_ready poll loop):
+        park until num_returns of oids are ready, reply with the flag list.
+        A timer bounds parked time when the caller gave a timeout."""
+        with self.lock:
+            flags = [self.store.is_ready(o) for o in oids]
+            pendings = [o for o, f in zip(oids, flags) if not f]
+            if sum(flags) >= num_returns or not pendings:
+                return flags
+            if timeout is not None and timeout <= 0:
+                return flags
+            token = {
+                "need": num_returns - sum(flags),
+                "wid": wid,
+                "req_id": req_id,
+                "oids": oids,
+                "done": False,
+                "timer": None,
+            }
+            for o in pendings:
+                self.parked_waits.setdefault(o, []).append(token)
+            if timeout is not None:
+                t = threading.Timer(timeout, self._wait_token_timeout, args=(token,))
+                t.daemon = True
+                token["timer"] = t
+                t.start()
+            return _PARKED
+
+    def _wait_token_reply(self, token) -> None:
+        """Caller holds self.lock.  Reply once and detach the token from
+        every oid list it is parked on (a timed-out token would otherwise
+        leak in parked_waits until its oids happen to become ready)."""
+        if token["done"]:
+            return
+        token["done"] = True
+        if token["timer"] is not None:
+            token["timer"].cancel()
+        for o in token["oids"]:
+            lst = self.parked_waits.get(o)
+            if lst is not None:
+                try:
+                    lst.remove(token)
+                except ValueError:
+                    pass
+                if not lst:
+                    self.parked_waits.pop(o, None)
+        flags = [self.store.is_ready(o) for o in token["oids"]]
+        self._reply(token["wid"], token["req_id"], True, flags)
+
+    def _wait_token_timeout(self, token) -> None:
+        with self.lock:
+            self._wait_token_reply(token)
 
     @staticmethod
     def _lineage_cost(spec) -> int:
@@ -625,6 +680,10 @@ class Runtime:
     def _object_ready(self, oid: str) -> None:
         with self.lock:
             parked = self.parked_gets.pop(oid, [])
+            for token in self.parked_waits.pop(oid, []):
+                token["need"] -= 1
+                if token["need"] <= 0:
+                    self._wait_token_reply(token)
             waiters = self.dep_waiters.pop(oid, set())
             for tid in waiters:
                 rec = self.tasks.get(tid)
@@ -719,6 +778,17 @@ class Runtime:
     # ------------------------------------------------------------------
     # dispatch loop (ray: cluster_task_manager.h + local_task_manager.h)
 
+    @staticmethod
+    def _strategy_shape_key(strategy):
+        """Stable equality key for head-of-line grouping — the default repr
+        embeds the instance address, which would make every task its own
+        shape and silently disable the blocking."""
+        from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+        if isinstance(strategy, NodeAffinitySchedulingStrategy):
+            return ("affinity", strategy.node_id, strategy.soft)
+        return strategy if isinstance(strategy, (str, type(None))) else repr(strategy)
+
     def _dispatch(self) -> None:
         # caller holds self.lock
         for pg_id in list(self.pending_pgs):
@@ -729,6 +799,12 @@ class Runtime:
             if self.scheduler.reserve_placement_group(pg):
                 self.pending_pgs.remove(pg_id)
         n = len(self.ready_queue)
+        # Head-of-line blocking per resource shape (ray: ClusterTaskManager
+        # queues tasks by scheduling class): once one task of a shape fails
+        # to place, sibling tasks of the same shape are skipped this round —
+        # without this, every completion re-probes the ENTIRE backlog and
+        # dispatch degrades O(queue depth) per event.
+        blocked_shapes: set = set()
         for _ in range(n):
             tid = self.ready_queue.popleft()
             rec = self.tasks.get(tid)
@@ -746,19 +822,35 @@ class Runtime:
                 self._finish_with_error(rec, dep_err, release=False)
                 continue
             if Scheduler.is_pg_task(spec):
+                pg_id, want_idx = self.scheduler._pg_for_spec(spec)
+                # Shape must include bundle index + resources: a full bundle
+                # 0 must not block a sibling task targeting free bundle 1.
+                shape = ("pg", pg_id, want_idx, tuple(sorted(spec.resources.items())))
+                if shape in blocked_shapes:
+                    self.ready_queue.append(tid)
+                    continue
                 sel = self.scheduler.select_pg(spec, spec.resources)
                 if sel is None:
+                    blocked_shapes.add(shape)
                     self.ready_queue.append(tid)
                     continue
                 node, bidx = sel
-                rec.pg = (self.scheduler._pg_for_spec(spec)[0], bidx)
+                rec.pg = (pg_id, bidx)
             else:
+                shape = (
+                    tuple(sorted(spec.resources.items())),
+                    self._strategy_shape_key(spec.scheduling_strategy),
+                )
+                if shape in blocked_shapes:
+                    self.ready_queue.append(tid)
+                    continue
                 try:
                     node = self.scheduler.select_node(spec)
                 except ValueError as e:
                     self._finish_with_error(rec, e, release=False)
                     continue
                 if node is None or not self.scheduler.acquire(node, spec.resources):
+                    blocked_shapes.add(shape)
                     self.ready_queue.append(tid)
                     continue
             h = self._lease_worker(node, spec)

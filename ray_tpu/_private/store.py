@@ -44,6 +44,13 @@ def _default_capacity(shm_dir: str) -> int:
         return 2 * 1024**3
 
 
+class _WaitToken:
+    __slots__ = ("need",)
+
+    def __init__(self, need: int):
+        self.need = need
+
+
 class SealedObject:
     """A stored, immutable object (serialized form + keepalive handles)."""
 
@@ -130,6 +137,10 @@ class OwnerStore:
         self._refcount: Dict[str, int] = {}
         self._available = threading.Condition()
         self._ready: Dict[str, bool] = {}
+        # wait() bookkeeping: per-oid waiter tokens so a completion is O(its
+        # waiters), and each woken waiter checks one counter instead of
+        # rescanning its whole oid list (wakeup-storm O(n^2) otherwise).
+        self._oid_waiters: Dict[str, List["_WaitToken"]] = {}
         self._errors: Dict[str, Any] = {}  # id -> exception to raise on get
         self._spill_dir = spill_dir
         self._lock = threading.RLock()
@@ -307,6 +318,8 @@ class OwnerStore:
     def _mark_ready(self, object_id: str) -> None:
         with self._available:
             self._ready[object_id] = True
+            for token in self._oid_waiters.pop(object_id, ()):
+                token.need -= 1
             self._available.notify_all()
 
     # -- get / wait ----------------------------------------------------------
@@ -318,22 +331,41 @@ class OwnerStore:
         return self._errors.get(object_id)
 
     def wait(self, object_ids: List[str], num_returns: int, timeout: Optional[float]):
-        """Block until num_returns of object_ids are ready. Returns ready set."""
+        """Block until num_returns of object_ids are ready. Returns the
+        ready subset (may exceed num_returns).  Duplicate ids are counted
+        per occurrence both at registration and in the result — consistent,
+        though callers normally pass unique refs."""
         import time
 
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._available:
-            while True:
-                ready = [o for o in object_ids if self._ready.get(o, False)]
-                if len(ready) >= num_returns:
-                    return ready
-                if deadline is not None:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        return ready
-                    self._available.wait(remaining)
-                else:
-                    self._available.wait()
+            pending = [o for o in object_ids if not self._ready.get(o, False)]
+            satisfied = len(object_ids) - len(pending)
+            if satisfied >= num_returns or not pending:
+                return [o for o in object_ids if self._ready.get(o, False)]
+            token = _WaitToken(num_returns - satisfied)
+            for o in pending:
+                self._oid_waiters.setdefault(o, []).append(token)
+            try:
+                while token.need > 0:
+                    if deadline is not None:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        self._available.wait(remaining)
+                    else:
+                        self._available.wait()
+            finally:
+                for o in pending:
+                    lst = self._oid_waiters.get(o)
+                    if lst is not None:
+                        try:
+                            lst.remove(token)
+                        except ValueError:
+                            pass
+                        if not lst:
+                            self._oid_waiters.pop(o, None)
+            return [o for o in object_ids if self._ready.get(o, False)]
 
     def get_sealed(self, object_id: str) -> Optional[SealedObject]:
         with self._lock:
