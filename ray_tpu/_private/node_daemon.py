@@ -1,0 +1,150 @@
+"""Node daemon: the per-host worker-pool process (raylet-lite).
+
+ray: src/ray/raylet/main.cc + node_manager.h:115 — one daemon per host owns
+that host's worker processes.  TPU-first simplification: scheduling and
+ownership stay with the driver (single-controller); the daemon's job is
+ONLY process supervision on its host — spawn workers on request, kill them
+on request, and take the whole pool down with it when it dies (node
+failure).  Workers connect DIRECTLY to the driver over TCP (the direct task
+transport, ray: direct_task_transport.h:75 — no per-message daemon hop).
+
+Launch:  python -m ray_tpu._private.node_daemon
+with env RAY_TPU_DRIVER_HOST/PORT, RAY_TPU_AUTHKEY, RAY_TPU_NODE_CONFIG
+(json: node_id, num_cpus, resources, labels, session).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+from typing import Dict
+
+
+def _build_worker_env(
+    wid: str, host: str, port: int, authkey_hex: str, session: str, env_vars
+) -> Dict[str, str]:
+    env = os.environ.copy()
+    env.update(
+        {
+            "RAY_TPU_DRIVER_HOST": host,
+            "RAY_TPU_DRIVER_PORT": str(port),
+            "RAY_TPU_AUTHKEY": authkey_hex,
+            "RAY_TPU_WORKER_ID": wid,
+            "RAY_TPU_SESSION": session,
+            "RAY_TPU_ENV_VARS": json.dumps(env_vars or {}),
+        }
+    )
+    env.update({k: str(v) for k, v in (env_vars or {}).items()})
+    # Workers must die with their daemon even on SIGKILL (a raylet's workers
+    # don't outlive node death): worker_main arms PR_SET_PDEATHSIG.
+    env["RAY_TPU_PDEATHSIG"] = "1"
+    pkg_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    paths = [pkg_root] + [p for p in sys.path if p] + (
+        env.get("PYTHONPATH", "").split(os.pathsep) if env.get("PYTHONPATH") else []
+    )
+    env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(paths))
+    return env
+
+
+def main() -> None:
+    from multiprocessing.connection import Client
+
+    host = os.environ["RAY_TPU_DRIVER_HOST"]
+    port = int(os.environ["RAY_TPU_DRIVER_PORT"])
+    authkey_hex = os.environ["RAY_TPU_AUTHKEY"]
+    cfg = json.loads(os.environ["RAY_TPU_NODE_CONFIG"])
+    node_id = cfg["node_id"]
+    session = cfg["session"]
+
+    conn = Client((host, port), authkey=bytes.fromhex(authkey_hex))
+    conn.send(
+        (
+            "daemon",
+            node_id,
+            {
+                "num_cpus": cfg.get("num_cpus", 1.0),
+                "resources": cfg.get("resources") or {},
+                "labels": cfg.get("labels") or {},
+            },
+            os.getpid(),
+        )
+    )
+
+    children: Dict[str, subprocess.Popen] = {}
+
+    def shutdown(*_a):
+        for p in children.values():
+            try:
+                p.terminate()
+            except OSError:
+                pass
+        for p in children.values():
+            try:
+                p.wait(timeout=2)
+            except Exception:
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+        sys.exit(0)
+
+    signal.signal(signal.SIGTERM, shutdown)
+    signal.signal(signal.SIGINT, shutdown)
+
+    def reap() -> None:
+        """Collect exited children (no zombies) and report them — the
+        driver's reaper cannot see remote processes, so a worker that dies
+        before connecting would otherwise hang its task forever."""
+        for wid, p in list(children.items()):
+            rc = p.poll()
+            if rc is not None:
+                children.pop(wid, None)
+                try:
+                    conn.send(("worker_exited", wid, rc))
+                except OSError:
+                    pass
+
+    while True:
+        try:
+            has_msg = conn.poll(0.5)
+        except (EOFError, OSError):
+            shutdown()
+            return
+        reap()
+        if not has_msg:
+            continue
+        try:
+            msg = conn.recv()
+        except (EOFError, OSError):
+            # Driver gone: this host's pool dies with it.
+            shutdown()
+            return
+        kind = msg[0]
+        if kind == "spawn_worker":
+            _, wid, env_vars = msg
+            env = _build_worker_env(wid, host, port, authkey_hex, session, env_vars)
+            children[wid] = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.worker_proc"],
+                env=env,
+                close_fds=True,
+            )
+        elif kind == "kill_worker":
+            p = children.get(msg[1])
+            if p is not None:
+                try:
+                    p.terminate()
+                except OSError:
+                    pass
+                # reap() collects and reports it next cycle
+        elif kind == "shutdown":
+            shutdown()
+            return
+
+
+if __name__ == "__main__":
+    main()

@@ -89,6 +89,38 @@ class _PopenHandle:
         return self._p.pid
 
 
+class _RemoteProcHandle:
+    """Process facade for a worker owned by a node daemon: liveness comes
+    from the worker's connection state; terminate routes through the daemon."""
+
+    __slots__ = ("_rt", "_node_id", "_wid", "dead")
+
+    def __init__(self, rt, node_id, wid):
+        self._rt = rt
+        self._node_id = node_id
+        self._wid = wid
+        self.dead = False
+
+    def terminate(self):
+        self._rt._daemon_send(self._node_id, ("kill_worker", self._wid))
+
+    def kill(self):
+        self.terminate()
+
+    def join(self, timeout=None):
+        pass  # the daemon reaps its own children
+
+    def is_alive(self):
+        # Until the worker's conn EOFs (io loop marks it crashed) we assume
+        # it is alive; pre-connect spawn failures surface via the daemon's
+        # own death or the lease timeout paths.
+        return not self.dead
+
+    @property
+    def pid(self):
+        return None
+
+
 class WorkerHandle:
     __slots__ = (
         "worker_id",
@@ -215,6 +247,12 @@ class Runtime:
         self.address = self.listener.address
         self._shutdown = False
         self._conn_to_worker: Dict[Any, str] = {}
+        # Multi-host plane: per-node daemon processes owning remote worker
+        # pools (ray: raylet main.cc) — node_id -> daemon conn, plus the
+        # reverse map for EOF (= node death) detection in the io loop.
+        self.node_daemons: Dict[str, Any] = {}
+        self._conn_to_daemon: Dict[Any, str] = {}
+        self._daemon_procs: Dict[str, Any] = {}  # node_id -> Popen (local launch)
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="raytpu-accept"
         )
@@ -268,7 +306,118 @@ class Runtime:
     # ------------------------------------------------------------------
     # worker pool (ray: src/ray/raylet/worker_pool.h:156)
 
+    def _daemon_send(self, node_id: str, msg: tuple) -> None:
+        conn = self.node_daemons.get(node_id)
+        if conn is None:
+            return
+        try:
+            conn.send(msg)
+        except OSError:
+            pass
+
+    def _on_daemon_death(self, node_id: str) -> None:
+        """Caller holds self.lock.  Node failure: the daemon's whole worker
+        pool dies with it (the daemon terminates its children on exit)."""
+        self.node_daemons.pop(node_id, None)
+        self.state.remove_node(node_id)
+        for wid, h in list(self.workers.items()):
+            if h.node_id == node_id and h.state != "dead":
+                if isinstance(h.proc, _RemoteProcHandle):
+                    h.proc.dead = True
+                self._on_worker_crash(wid)
+
+    def _child_env(self, extra: Dict[str, str]) -> Dict[str, str]:
+        """Base env for child processes (workers/daemons): driver address +
+        authkey + a PYTHONPATH carrying the driver's module search path."""
+        import sys
+
+        host, port = self.address
+        env = os.environ.copy()
+        env.update(
+            {
+                "RAY_TPU_DRIVER_HOST": host,
+                "RAY_TPU_DRIVER_PORT": str(port),
+                "RAY_TPU_AUTHKEY": self._authkey.hex(),
+            }
+        )
+        env.update(extra)
+        pkg_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        paths = [pkg_root] + [p for p in sys.path if p] + (
+            env.get("PYTHONPATH", "").split(os.pathsep) if env.get("PYTHONPATH") else []
+        )
+        env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(paths))
+        return env
+
+    def add_daemon_node(
+        self,
+        num_cpus: float = 1.0,
+        resources: Optional[Dict] = None,
+        labels: Optional[Dict[str, str]] = None,
+        wait_timeout: float = 30.0,
+    ) -> str:
+        """Launch a node daemon PROCESS on this machine and wait for it to
+        register (the test-side analogue of starting a raylet on another
+        host; in a real deployment the daemon starts remotely pointing at
+        this driver's address)."""
+        import json
+        import subprocess
+        import sys
+
+        nid = ids.node_id()
+        env = self._child_env(
+            {
+                "RAY_TPU_NODE_CONFIG": json.dumps(
+                    {
+                        "node_id": nid,
+                        "session": self.session_name,
+                        "num_cpus": num_cpus,
+                        "resources": resources or {},
+                        "labels": labels or {},
+                    }
+                ),
+            }
+        )
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.node_daemon"],
+            env=env,
+            close_fds=True,
+        )
+        self._daemon_procs[nid] = proc
+        deadline = time.monotonic() + wait_timeout
+        while time.monotonic() < deadline:
+            if nid in self.node_daemons:
+                return nid
+            if proc.poll() is not None:
+                self._daemon_procs.pop(nid, None)
+                raise RuntimeError(f"node daemon exited rc={proc.returncode}")
+            time.sleep(0.01)
+        # Kill the straggler BEFORE raising, or it could register moments
+        # later as a phantom node the caller was told doesn't exist.
+        try:
+            proc.terminate()
+        except OSError:
+            pass
+        self._daemon_procs.pop(nid, None)
+        raise TimeoutError("node daemon did not register in time")
+
     def _spawn_worker(self, node_id: str, env_key, env_vars, prestart: bool = False) -> WorkerHandle:
+        if node_id in self.node_daemons:
+            # Remote-node spawn: the daemon execs the worker on its host;
+            # the worker connects straight back to this driver.
+            wid = ids.worker_id()
+            self._daemon_send(node_id, ("spawn_worker", wid, env_vars or {}))
+            handle = WorkerHandle(
+                wid, node_id, env_key, env_vars, _RemoteProcHandle(self, node_id, wid)
+            )
+            self.workers[wid] = handle
+            if prestart:
+                self.starting_pool.setdefault((node_id, env_key), []).append(wid)
+            return handle
+        return self._spawn_local_worker(node_id, env_key, env_vars, prestart)
+
+    def _spawn_local_worker(self, node_id: str, env_key, env_vars, prestart: bool = False) -> WorkerHandle:
         # Workers are exec'ed as fresh interpreters (`python -m ..worker_proc`)
         # rather than multiprocessing children: mp's spawn/forkserver children
         # re-import the driver's __main__ module during bootstrap, which
@@ -281,13 +430,8 @@ class Runtime:
         import sys
 
         wid = ids.worker_id()
-        host, port = self.address
-        env = os.environ.copy()
-        env.update(
+        env = self._child_env(
             {
-                "RAY_TPU_DRIVER_HOST": host,
-                "RAY_TPU_DRIVER_PORT": str(port),
-                "RAY_TPU_AUTHKEY": self._authkey.hex(),
                 "RAY_TPU_WORKER_ID": wid,
                 "RAY_TPU_SESSION": self.session_name,
                 "RAY_TPU_ENV_VARS": json.dumps(env_vars or {}),
@@ -296,15 +440,6 @@ class Runtime:
         # runtime_env vars must exist at interpreter start (sitecustomize may
         # import jax before worker_main applies them).
         env.update({k: str(v) for k, v in (env_vars or {}).items()})
-        # Workers inherit the driver's module search path (so driver-side
-        # modules — e.g. pytest-inserted test dirs — resolve on import;
-        # the reference equivalently execs workers with the driver's
-        # PYTHONPATH), plus the ray_tpu package root regardless of cwd.
-        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-        paths = [pkg_root] + [p for p in sys.path if p] + (
-            env.get("PYTHONPATH", "").split(os.pathsep) if env.get("PYTHONPATH") else []
-        )
-        env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(paths))
         popen = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_proc"],
             env=env,
@@ -366,6 +501,22 @@ class Runtime:
                 if self._shutdown:
                     return
                 continue
+            if first[0] == "daemon":
+                # Node daemon registration: ("daemon", node_id, cfg, pid).
+                _, node_id, cfg, _pid = first
+                res = {"CPU": float(cfg.get("num_cpus", 1.0)), **(cfg.get("resources") or {})}
+                with self.lock:
+                    if node_id not in self.state.nodes:
+                        self.state.register_node(
+                            NodeInfo(
+                                node_id, dict(res), dict(res),
+                                labels=dict(cfg.get("labels") or {}),
+                            )
+                        )
+                    self.node_daemons[node_id] = conn
+                    self._conn_to_daemon[conn] = node_id
+                    self._dispatch()
+                continue
             if first[0] != "ready":
                 conn.close()
                 continue
@@ -413,7 +564,9 @@ class Runtime:
                         ):
                             self._on_worker_crash(wid)
             with self.lock:
-                conns = list(self._conn_to_worker.keys())
+                conns = list(self._conn_to_worker.keys()) + list(
+                    self._conn_to_daemon.keys()
+                )
             if not conns:
                 time.sleep(0.02)
                 continue
@@ -422,6 +575,25 @@ class Runtime:
             except OSError:
                 continue
             for conn in readable:
+                nid = self._conn_to_daemon.get(conn)
+                if nid is not None:
+                    try:
+                        dmsg = conn.recv()
+                    except (EOFError, OSError):
+                        with self.lock:
+                            self._conn_to_daemon.pop(conn, None)
+                            self._on_daemon_death(nid)
+                        continue
+                    if isinstance(dmsg, tuple) and dmsg and dmsg[0] == "worker_exited":
+                        # A remote child died (possibly before connecting):
+                        # the driver-side reaper can't see it, the daemon can.
+                        with self.lock:
+                            h = self.workers.get(dmsg[1])
+                            if h is not None and isinstance(h.proc, _RemoteProcHandle):
+                                h.proc.dead = True
+                            if h is not None and h.state != "dead":
+                                self._on_worker_crash(dmsg[1])
+                    continue
                 wid = self._conn_to_worker.get(conn)
                 if wid is None:
                     continue
@@ -1305,6 +1477,8 @@ class Runtime:
         with self.lock:
             self.state.remove_node(node_id)
             victims = [h for h in self.workers.values() if h.node_id == node_id]
+            self._daemon_send(node_id, ("shutdown",))
+            self.node_daemons.pop(node_id, None)
         for h in victims:
             try:
                 h.proc.terminate()
@@ -1320,6 +1494,13 @@ class Runtime:
         self._shutdown = True
         atexit.unregister(self.shutdown)
         set_ref_hooks(None, None)
+        for nid in list(self.node_daemons):
+            self._daemon_send(nid, ("shutdown",))
+        for proc in self._daemon_procs.values():
+            try:
+                proc.terminate()
+            except OSError:
+                pass
         for h in list(self.workers.values()):
             try:
                 if h.conn is not None:

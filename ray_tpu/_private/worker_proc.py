@@ -238,6 +238,33 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
     # JAX_PLATFORMS / XLA_FLAGS take effect in this process).
     if env_vars:
         os.environ.update(env_vars)
+    if os.environ.get("RAY_TPU_PDEATHSIG"):
+        # Daemon-owned worker: die when the node daemon dies, even on
+        # SIGKILL of the daemon (node-failure semantics — a raylet's
+        # workers don't outlive it).  Linux prctl(PR_SET_PDEATHSIG); where
+        # unavailable, a watchdog thread polls for reparenting instead so
+        # the invariant holds on every platform.
+        armed = False
+        try:
+            import ctypes
+            import signal as _signal
+
+            ctypes.CDLL(None).prctl(1, _signal.SIGTERM)  # PR_SET_PDEATHSIG=1
+            armed = True
+        except Exception:
+            pass
+        if not armed:
+            import time as _time
+
+            parent = os.getppid()
+
+            def _orphan_watch():
+                while True:
+                    _time.sleep(2.0)
+                    if os.getppid() != parent:
+                        os._exit(0)
+
+            threading.Thread(target=_orphan_watch, daemon=True).start()
     global _runtime
     from multiprocessing.connection import Client
 

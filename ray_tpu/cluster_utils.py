@@ -29,12 +29,31 @@ class Cluster:
         num_cpus: float = 1.0,
         resources: Optional[Dict] = None,
         labels: Optional[Dict[str, str]] = None,
+        daemon: bool = False,
     ) -> str:
         """labels: node metadata; "mesh_coord" (e.g. "0,1") marks the host's
-        ICI torus coordinate, consumed by the MESH placement strategy."""
-        nid = self._rt.add_node(num_cpus=num_cpus, resources=resources, labels=labels)
+        ICI torus coordinate, consumed by the MESH placement strategy.
+
+        daemon=True starts a REAL node-daemon process owning the node's
+        worker pool (the reference's extra-raylet Cluster mode,
+        ray: cluster_utils.py:99) — killing it is a node failure."""
+        if daemon:
+            nid = self._rt.add_daemon_node(
+                num_cpus=num_cpus, resources=resources, labels=labels
+            )
+        else:
+            nid = self._rt.add_node(
+                num_cpus=num_cpus, resources=resources, labels=labels
+            )
         self._nodes.append(nid)
         return nid
+
+    def kill_node_daemon(self, node_id: str) -> None:
+        """Hard-kill a daemon node's process (fault injection — the
+        reference's NodeKillerActor pattern, test_utils.py:1347)."""
+        proc = self._rt._daemon_procs.get(node_id)
+        if proc is not None:
+            proc.kill()
 
     def remove_node(self, node_id: str) -> None:
         self._rt.remove_node(node_id)

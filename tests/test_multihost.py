@@ -1,0 +1,157 @@
+"""Multi-host plane v0: real node-daemon processes owning worker pools.
+
+Reference intents: python/ray/cluster_utils.py:99 (extra raylet processes
+as fake nodes), test_failure/test_actor_failures (node death), plus a
+2-"host" SPMD train run with workers under different daemons.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import NodeAffinitySchedulingStrategy
+
+
+@ray_tpu.remote
+def whereami():
+    return (os.getpid(), os.getppid())
+
+
+def test_daemon_node_runs_tasks(ray_start_cluster):
+    cluster = ray_start_cluster
+    nid = cluster.add_node(num_cpus=2, daemon=True)
+    driver_pid = os.getpid()
+
+    @ray_tpu.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(nid))
+    def f():
+        return (os.getpid(), os.getppid())
+
+    pid, ppid = ray_tpu.get(f.remote(), timeout=60)
+    # The worker is NOT a child of the driver: its parent is the daemon.
+    assert ppid != driver_pid
+    assert pid != driver_pid
+
+
+def test_actors_on_distinct_daemons(ray_start_cluster):
+    cluster = ray_start_cluster
+    n1 = cluster.add_node(num_cpus=2, daemon=True)
+    n2 = cluster.add_node(num_cpus=2, daemon=True)
+
+    @ray_tpu.remote
+    class Host:
+        def info(self):
+            return (os.getpid(), os.getppid())
+
+    a = Host.options(scheduling_strategy=NodeAffinitySchedulingStrategy(n1)).remote()
+    b = Host.options(scheduling_strategy=NodeAffinitySchedulingStrategy(n2)).remote()
+    (pa, ppa), (pb, ppb) = ray_tpu.get([a.info.remote(), b.info.remote()], timeout=60)
+    assert pa != pb
+    assert ppa != ppb, "actors share a parent: not under distinct daemons"
+    assert os.getpid() not in (ppa, ppb)
+
+
+def test_daemon_death_is_node_failure(ray_start_cluster):
+    cluster = ray_start_cluster
+    nid = cluster.add_node(num_cpus=2, daemon=True)
+
+    @ray_tpu.remote(max_restarts=1)
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+        def where(self):
+            return os.getppid()
+
+    a = Counter.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(nid, soft=True)
+    ).remote()
+    assert ray_tpu.get(a.incr.remote(), timeout=60) == 1
+    daemon_ppid = ray_tpu.get(a.where.remote(), timeout=30)
+    assert daemon_ppid != os.getpid()
+
+    cluster.kill_node_daemon(nid)
+    # Node death must propagate (daemon conn EOF → node removed).
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        nodes = {n["NodeID"]: n for n in ray_tpu.nodes()}
+        if not nodes[nid]["Alive"]:
+            break
+        time.sleep(0.05)
+    else:
+        pytest.fail("daemon death never marked the node dead")
+
+    # The actor (max_restarts=1, soft affinity) restarts on a surviving
+    # node — under a DIFFERENT parent — with fresh state.
+    deadline = time.time() + 60
+    ok = False
+    while time.time() < deadline and not ok:
+        try:
+            v = ray_tpu.get(a.incr.remote(), timeout=30)
+            new_parent = ray_tpu.get(a.where.remote(), timeout=30)
+            ok = v >= 1 and new_parent != daemon_ppid
+        except Exception:
+            time.sleep(0.2)
+    assert ok, "actor never came back off the dead node"
+
+
+def test_two_host_spmd_train(ray_start_cluster):
+    """The VERDICT 'done' bar: a 2-worker SPMD train run where the two
+    train-worker actors live under DIFFERENT node daemons.  The daemon
+    nodes carry a custom "slot" resource so the gang cannot land on the
+    head node."""
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, resources={"slot": 1}, daemon=True)
+    cluster.add_node(num_cpus=2, resources={"slot": 1}, daemon=True)
+
+    from ray_tpu.air.config import ScalingConfig
+    from ray_tpu.train import JaxTrainer
+
+    def loop(config):
+        import os as _os
+
+        from ray_tpu.train import session
+
+        session.report(
+            {"rank": session.get_world_rank(), "ppid": _os.getppid(), "loss": 1.0}
+        )
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(
+            num_workers=2,
+            resources_per_worker={"CPU": 1.0, "slot": 1.0},
+            placement_strategy="STRICT_SPREAD",
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is None
+
+    # Verify each rank's worker actor really lives under a daemon process:
+    # run a second group the same way and collect all ranks' parent pids.
+    from ray_tpu.train.backend_executor import BackendExecutor
+    from ray_tpu.train.backend import JaxConfig
+
+    ex = BackendExecutor(
+        JaxConfig(),
+        ScalingConfig(
+            num_workers=2,
+            resources_per_worker={"CPU": 1.0, "slot": 1.0},
+            placement_strategy="STRICT_SPREAD",
+        ),
+    )
+    ex.start()
+    try:
+        infos = ex.worker_group.execute(lambda: (os.getpid(), os.getppid()))
+        pids = {p for p, _ in infos}
+        ppids = {pp for _, pp in infos}
+        assert len(pids) == 2
+        assert len(ppids) == 2, f"ranks share a daemon parent: {infos}"
+        assert os.getpid() not in ppids
+    finally:
+        ex.shutdown()
