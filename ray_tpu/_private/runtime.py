@@ -174,6 +174,7 @@ class ActorRuntime:
         "expected_death",
         "no_restart",
         "placement",  # ("node", node_id) | ("pg", pg_id, bundle_idx)
+        "_creation_crash_retries",
     )
 
     def __init__(self, info):
@@ -184,6 +185,7 @@ class ActorRuntime:
         self.expected_death = False
         self.no_restart = False
         self.placement = None
+        self._creation_crash_retries = 0
 
 
 class Runtime:
@@ -236,6 +238,20 @@ class Runtime:
             os.environ.get("RAY_TPU_LINEAGE_MAX_BYTES", str(64 * 1024 * 1024))
         )
         self.lineage_bytes = 0
+        # Task-event sink (ray: gcs_task_manager.h:61 ring-buffer storage):
+        # bounded history of finished tasks powering the state API + metrics.
+        self.task_events: deque = deque(maxlen=int(os.environ.get("RAY_TPU_TASK_EVENTS_MAX", "2000")))
+        self.metrics: Dict[str, float] = {
+            "tasks_submitted": 0,
+            "tasks_finished": 0,
+            "tasks_failed": 0,
+            "tasks_retried": 0,
+            "actors_created": 0,
+            "actor_restarts": 0,
+            "objects_put": 0,
+            "workers_spawned": 0,
+            "worker_crashes": 0,
+        }
 
         from multiprocessing.connection import Listener
 
@@ -407,6 +423,7 @@ class Runtime:
             # Remote-node spawn: the daemon execs the worker on its host;
             # the worker connects straight back to this driver.
             wid = ids.worker_id()
+            self.metrics["workers_spawned"] += 1
             self._daemon_send(node_id, ("spawn_worker", wid, env_vars or {}))
             handle = WorkerHandle(
                 wid, node_id, env_key, env_vars, _RemoteProcHandle(self, node_id, wid)
@@ -430,6 +447,7 @@ class Runtime:
         import sys
 
         wid = ids.worker_id()
+        self.metrics["workers_spawned"] += 1
         env = self._child_env(
             {
                 "RAY_TPU_WORKER_ID": wid,
@@ -880,6 +898,9 @@ class Runtime:
         rec = TaskRecord(spec)
         return_ids = spec.return_ids()
         with self.lock:
+            self.metrics["tasks_submitted"] += 1
+            if spec.is_actor_creation:
+                self.metrics["actors_created"] += 1
             self.tasks[spec.task_id] = rec
             for c in spec.contained_refs:
                 self.store.add_ref(c)  # arg borrow for the task's lifetime
@@ -1077,6 +1098,12 @@ class Runtime:
         if rec is None:
             return
         spec = rec.spec
+        if error_blob is None:
+            self._record_task_end(rec, wid, "FINISHED")
+        elif not (spec.retry_exceptions and spec.attempt < spec.max_retries):
+            # Only FINAL failures count — a retried attempt is not a failed
+            # task (tasks_retried tracks attempts).
+            self._record_task_end(rec, wid, "FAILED")
         ready_ids = []
         if error_blob is None:
             for item in results:
@@ -1135,6 +1162,7 @@ class Runtime:
     def _retry_task(self, rec: TaskRecord, h: Optional[WorkerHandle]) -> None:
         spec = rec.spec
         spec.attempt += 1
+        self.metrics["tasks_retried"] += 1
         if spec.actor_id is None:
             self._release_for(rec)
         if h is not None and h.state == "busy":
@@ -1165,6 +1193,7 @@ class Runtime:
         ar = self.actors.get(actor_id)
         if ar is None:
             return
+        ar._creation_crash_retries = 0  # fresh budget per successful start
         self.state.set_actor_state(actor_id, ALIVE, worker_id=ar.worker_id)
         while ar.queued:
             tid = ar.queued.popleft()
@@ -1194,11 +1223,28 @@ class Runtime:
                 self._decref_local(c)
         ar.in_flight.clear()
 
+    def _record_task_end(self, rec, wid, state: str) -> None:
+        spec = rec.spec
+        self.metrics["tasks_finished" if state == "FINISHED" else "tasks_failed"] += 1
+        self.task_events.append(
+            {
+                "task_id": spec.task_id,
+                "name": spec.name,
+                "state": state,
+                "node_id": rec.node_id,
+                "worker_id": wid,
+                "actor_id": spec.actor_id,
+                "attempt": spec.attempt,
+                "end_time": time.time(),
+            }
+        )
+
     def _on_worker_crash(self, wid: str) -> None:
         # caller holds self.lock
         h = self.workers.pop(wid, None)
         if h is None or h.state == "dead":
-            return
+            return  # duplicate notification (daemon report + conn EOF)
+        self.metrics["worker_crashes"] += 1
         h.state = "dead"
         pool = self.idle_pool.get((h.node_id, h.env_key))
         if pool and wid in pool:
@@ -1232,6 +1278,7 @@ class Runtime:
         else:
             self.tasks.pop(tid, None)
             self._release_for(rec)
+            self._record_task_end(rec, wid, "FAILED")
             err = WorkerCrashedError(
                 f"worker running task {spec.name} died unexpectedly"
             )
@@ -1248,6 +1295,32 @@ class Runtime:
         if ar is None or info is None or info.state == DEAD:
             return
         creation = ar.info.creation_spec
+        crash_retries = getattr(ar, "_creation_crash_retries", 0)
+        if (
+            info.state in (PENDING_CREATION, RESTARTING)
+            and crash_retries < 3
+            and not ar.expected_death
+            and not ar.no_restart
+        ):
+            # (expected_death/no_restart: a kill() during init must stay
+            # dead, not resurrect through the scheduling-retry path.)
+            ar._creation_crash_retries = crash_retries + 1
+            # The worker died BEFORE the actor (re)initialized — a
+            # scheduling/environment failure (e.g. it was placed on a node
+            # whose daemon died in the same instant), not an actor death.
+            # Re-schedule the creation without burning max_restarts budget,
+            # matching the reference's GCS actor scheduler, which retries
+            # placement and only counts ALIVE→dead transitions as restarts
+            # (ray: gcs_actor_scheduler.h:111, gcs_actor_manager.h:258-266).
+            self.tasks.pop(creation.task_id, None)
+            self._release_actor_placement(ar)
+            ar.worker_id = None
+            rec = TaskRecord(creation)
+            rec.state = "READY"
+            self.tasks[creation.task_id] = rec
+            self.ready_queue.append(creation.task_id)
+            self._dispatch()
+            return
         self._release_actor_placement(ar)
         err = ActorDiedError(
             f"actor {actor_id} died"
@@ -1272,6 +1345,7 @@ class Runtime:
         )
         if can_restart:
             info.num_restarts += 1
+            self.metrics["actor_restarts"] += 1
             self.state.set_actor_state(actor_id, RESTARTING)
             ar.worker_id = None
             # resubmit the creation task (restart FSM:
@@ -1297,6 +1371,7 @@ class Runtime:
     def put(self, value: Any) -> ObjectRef:
         if isinstance(value, ObjectRef):
             raise TypeError("ray_tpu.put() does not accept ObjectRefs")
+        self.metrics["objects_put"] += 1
         oid = ids.object_id()
         contained = self.store.put(oid, value)
         self._store_contained(oid, contained)

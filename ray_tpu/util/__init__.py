@@ -1,10 +1,16 @@
+from ray_tpu.util.actor_pool import ActorPool
 from ray_tpu.util.placement_group import placement_group, remove_placement_group, PlacementGroup
+from ray_tpu.util.queue import Empty, Full, Queue
 from ray_tpu.util.scheduling_strategies import (
     NodeAffinitySchedulingStrategy,
     PlacementGroupSchedulingStrategy,
 )
 
 __all__ = [
+    "ActorPool",
+    "Empty",
+    "Full",
+    "Queue",
     "placement_group",
     "remove_placement_group",
     "PlacementGroup",

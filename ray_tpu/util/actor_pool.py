@@ -1,0 +1,94 @@
+"""ActorPool: load-balance tasks over a fixed set of actors.
+
+ray: python/ray/util/actor_pool.py — same surface (map / map_unordered /
+submit / get_next / get_next_unordered / has_next / push / pop_idle).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: List[Any]):
+        self._idle = list(actors)
+        self._future_to_actor = {}
+        self._index_to_future = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits: List[tuple] = []
+
+    def submit(self, fn: Callable, value: Any) -> None:
+        """fn(actor, value) -> ObjectRef; queued if no actor is idle."""
+        if self._idle:
+            actor = self._idle.pop()
+            future = fn(actor, value)
+            self._future_to_actor[future.id] = (self._next_task_index, actor, future)
+            self._index_to_future[self._next_task_index] = future
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._future_to_actor) or bool(self._pending_submits)
+
+    def _return_actor(self, actor) -> None:
+        self._idle.append(actor)
+        if self._pending_submits:
+            self.submit(*self._pending_submits.pop(0))
+
+    def get_next(self, timeout=None):
+        """Next result in SUBMISSION order.  On timeout the pool state is
+        untouched (the slot can be retried); once a result is consumed the
+        actor returns to the pool even if the task raised."""
+        if self._next_return_index >= self._next_task_index and not self._pending_submits:
+            raise StopIteration("no pending results")
+        future = self._index_to_future[self._next_return_index]
+        ready, _ = ray_tpu.wait([future], num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("get_next timed out")
+        self._index_to_future.pop(self._next_return_index)
+        self._next_return_index += 1
+        _, actor, _ = self._future_to_actor.pop(future.id)
+        try:
+            return ray_tpu.get(future)
+        finally:
+            self._return_actor(actor)
+
+    def get_next_unordered(self, timeout=None):
+        """Next COMPLETED result, any order."""
+        if not self._future_to_actor:
+            raise StopIteration("no pending results")
+        futures = [f for _, _, f in self._future_to_actor.values()]
+        ready, _ = ray_tpu.wait(futures, num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("get_next_unordered timed out")
+        future = ready[0]
+        idx, actor, _ = self._future_to_actor.pop(future.id)
+        self._index_to_future.pop(idx, None)
+        try:
+            return ray_tpu.get(future)
+        finally:
+            self._return_actor(actor)
+
+    def map(self, fn: Callable, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    def push(self, actor) -> None:
+        """Add an idle actor to the pool."""
+        self._return_actor(actor)
+
+    def pop_idle(self):
+        """Remove and return an idle actor, or None."""
+        return self._idle.pop() if self._idle else None

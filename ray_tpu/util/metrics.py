@@ -1,0 +1,141 @@
+"""User-facing metric API: Counter / Gauge / Histogram.
+
+ray: python/ray/util/metrics.py (backed there by OpenCensus through the
+Cython layer, src/ray/stats/metric.h:103).  Here metrics record in-process
+into a registry; `collect()` snapshots every metric of the current process
+(driver or worker) — a scrape endpoint can export them.  Tag semantics
+match the reference: default_tags at construction, per-record overrides.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+_REGISTRY_LOCK = threading.Lock()
+_REGISTRY: Dict[str, "Metric"] = {}
+
+
+def _tag_key(tags: Optional[Dict[str, str]]) -> Tuple:
+    return tuple(sorted((tags or {}).items()))
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "", tag_keys: Tuple[str, ...] = ()):
+        if not name:
+            raise ValueError("metric name must be non-empty")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        with _REGISTRY_LOCK:
+            existing = _REGISTRY.get(name)
+            if existing is not None and type(existing) is not type(self):
+                raise ValueError(
+                    f"metric {name!r} already registered as {type(existing).__name__}"
+                )
+            _REGISTRY[name] = self
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
+        unknown = set(tags) - set(self.tag_keys)
+        if unknown:
+            raise ValueError(f"tags {unknown} not in declared tag_keys {self.tag_keys}")
+        self._default_tags = dict(tags)
+        return self
+
+    def _resolve_tags(self, tags: Optional[Dict[str, str]]) -> Dict[str, str]:
+        merged = dict(self._default_tags)
+        if tags:
+            unknown = set(tags) - set(self.tag_keys)
+            if unknown:
+                raise ValueError(
+                    f"tags {unknown} not in declared tag_keys {self.tag_keys}"
+                )
+            merged.update(tags)
+        return merged
+
+
+class Counter(Metric):
+    """Monotonic counter (ray: util/metrics.py Counter)."""
+
+    def __init__(self, name, description="", tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, float] = {}
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        if value < 0:
+            raise ValueError("Counter.inc() requires value >= 0")
+        k = _tag_key(self._resolve_tags(tags))
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+    def snapshot(self) -> Dict[Tuple, float]:
+        with self._lock:
+            return dict(self._values)
+
+
+class Gauge(Metric):
+    """Last-value gauge (ray: util/metrics.py Gauge)."""
+
+    def __init__(self, name, description="", tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, float] = {}
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        k = _tag_key(self._resolve_tags(tags))
+        with self._lock:
+            self._values[k] = float(value)
+
+    def snapshot(self) -> Dict[Tuple, float]:
+        with self._lock:
+            return dict(self._values)
+
+
+class Histogram(Metric):
+    """Bucketed histogram (ray: util/metrics.py Histogram)."""
+
+    def __init__(self, name, description="", boundaries: Optional[List[float]] = None, tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        if not boundaries:
+            raise ValueError("Histogram requires bucket boundaries")
+        self.boundaries = sorted(boundaries)
+        self._buckets: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+        self._counts: Dict[Tuple, int] = {}
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        k = _tag_key(self._resolve_tags(tags))
+        with self._lock:
+            buckets = self._buckets.setdefault(k, [0] * (len(self.boundaries) + 1))
+            idx = 0
+            while idx < len(self.boundaries) and value > self.boundaries[idx]:
+                idx += 1
+            buckets[idx] += 1
+            self._sums[k] = self._sums.get(k, 0.0) + value
+            self._counts[k] = self._counts.get(k, 0) + 1
+
+    def snapshot(self) -> Dict[Tuple, Dict]:
+        with self._lock:
+            return {
+                k: {
+                    "buckets": list(v),
+                    "sum": self._sums.get(k, 0.0),
+                    "count": self._counts.get(k, 0),
+                }
+                for k, v in self._buckets.items()
+            }
+
+
+def collect() -> Dict[str, Dict]:
+    """Snapshot every registered metric in this process."""
+    with _REGISTRY_LOCK:
+        metrics = dict(_REGISTRY)
+    return {
+        name: {
+            "type": type(m).__name__,
+            "description": m.description,
+            "data": m.snapshot() if hasattr(m, "snapshot") else {},
+        }
+        for name, m in metrics.items()
+    }

@@ -1,0 +1,161 @@
+"""State API: live introspection of tasks/actors/objects/nodes/workers.
+
+ray: python/ray/experimental/state/api.py (`ray list tasks/actors/objects`,
+summarize) + dashboard/state_aggregator.py.  Driver-side reads straight
+from the runtime's tables; the bounded task-event sink
+(runtime.task_events, analogue of gcs_task_manager.h ring buffer) supplies
+finished-task history.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+def _rt():
+    from ray_tpu._private.runtime import get_runtime
+
+    return get_runtime()
+
+
+def list_tasks(*, include_finished: bool = True, limit: int = 1000) -> List[Dict[str, Any]]:
+    """Live tasks (PENDING/READY/RUNNING) + bounded finished history."""
+    rt = _rt()
+    out: List[Dict[str, Any]] = []
+    with rt.lock:
+        for tid, rec in rt.tasks.items():
+            out.append(
+                {
+                    "task_id": tid,
+                    "name": rec.spec.name,
+                    "state": rec.state,
+                    "node_id": rec.node_id,
+                    "worker_id": rec.worker_id,
+                    "actor_id": rec.spec.actor_id,
+                    "attempt": rec.spec.attempt,
+                }
+            )
+        if include_finished:
+            out.extend(dict(e) for e in rt.task_events)
+    return out[:limit]
+
+
+def list_actors(limit: int = 1000) -> List[Dict[str, Any]]:
+    rt = _rt()
+    out = []
+    with rt.lock:
+        for aid, info in rt.state.actors.items():
+            out.append(
+                {
+                    "actor_id": aid,
+                    "name": info.name,
+                    "state": info.state,
+                    "node_id": info.node_id,
+                    "worker_id": info.worker_id,
+                    "num_restarts": info.num_restarts,
+                    "namespace": info.namespace,
+                    "death_cause": info.death_cause,
+                }
+            )
+    return out[:limit]
+
+
+def list_objects(limit: int = 1000) -> List[Dict[str, Any]]:
+    """Owner-store view: every live object with location + refcount."""
+    rt = _rt()
+    store = rt.store
+    out = []
+    with store._lock:
+        for oid in set(store._mem) | set(store._in_shm) | set(store._spilled):
+            if oid in store._mem:
+                loc, size = "memory", store._mem[oid].size
+            elif oid in store._in_shm:
+                loc, size = "shm", store._in_shm[oid]
+            else:
+                loc, size = "spilled", None
+            out.append(
+                {
+                    "object_id": oid,
+                    "location": loc,
+                    "size_bytes": size,
+                    "refcount": store._refcount.get(oid, 0),
+                    "ready": store._ready.get(oid, False),
+                }
+            )
+    return out[:limit]
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    rt = _rt()
+    return [
+        {
+            "node_id": n.node_id,
+            "alive": n.alive,
+            "is_head": n.is_head,
+            "resources": dict(n.resources),
+            "available": dict(n.available),
+            "labels": dict(n.labels),
+            "has_daemon": n.node_id in rt.node_daemons,
+        }
+        for n in rt.state.nodes.values()
+    ]
+
+
+def list_workers() -> List[Dict[str, Any]]:
+    rt = _rt()
+    with rt.lock:
+        return [
+            {
+                "worker_id": wid,
+                "node_id": h.node_id,
+                "state": h.state,
+                "pid": h.pid,
+                "actor_id": h.actor_id,
+                "current_task": h.current_task,
+            }
+            for wid, h in rt.workers.items()
+        ]
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    rt = _rt()
+    return [
+        {
+            "placement_group_id": pid,
+            "state": pg.state,
+            "strategy": pg.strategy,
+            "bundles": list(pg.bundles),
+            "bundle_nodes": dict(pg.bundle_nodes),
+        }
+        for pid, pg in rt.state.placement_groups.items()
+    ]
+
+
+def summarize_tasks() -> Dict[str, int]:
+    """Count by state (ray: `ray summary tasks`)."""
+    counts: Dict[str, int] = {}
+    for t in list_tasks():
+        counts[t["state"]] = counts.get(t["state"], 0) + 1
+    return counts
+
+
+def cluster_metrics() -> Dict[str, float]:
+    """Runtime counters + store gauges (ray: src/ray/stats/metric_defs.cc
+    reduced to the load-bearing set)."""
+    rt = _rt()
+    with rt.lock:
+        m = dict(rt.metrics)
+    m.update(
+        {
+            "object_store_bytes_used": float(rt.store.shm_usage()),
+            "object_store_capacity_bytes": float(rt.store.capacity),
+            "objects_spilled": float(len(rt.store._spilled)),
+            "live_tasks": float(len(rt.tasks)),
+            "live_workers": float(
+                sum(1 for h in rt.workers.values() if h.state != "dead")
+            ),
+            "lineage_entries": float(len(rt.lineage)),
+            "lineage_bytes": float(rt.lineage_bytes),
+        }
+    )
+    return m

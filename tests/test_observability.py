@@ -1,0 +1,169 @@
+"""State API + metrics + ActorPool + Queue tests (reference intents:
+python/ray/tests/test_state_api.py, test_metrics_agent.py,
+test_actor_pool.py, test_queue.py).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import ActorPool, Empty, Full, Queue
+from ray_tpu.util import state as state_api
+from ray_tpu.util.metrics import Counter, Gauge, Histogram, collect
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_list_tasks_actors_objects_nodes(rt):
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    refs = [f.remote(i) for i in range(5)]
+    a = A.remote()
+    ray_tpu.get(refs + [a.ping.remote()], timeout=60)
+    big = ray_tpu.put(b"x" * 500_000)
+
+    tasks = state_api.list_tasks()
+    assert any(t["name"].startswith("f") and t["state"] == "FINISHED" for t in tasks)
+
+    actors = state_api.list_actors()
+    assert any(x["state"] == "ALIVE" for x in actors)
+
+    objs = state_api.list_objects()
+    assert any(o["object_id"] == big.id and o["location"] == "shm" for o in objs)
+
+    nodes = state_api.list_nodes()
+    assert any(n["is_head"] and n["alive"] for n in nodes)
+
+    workers = state_api.list_workers()
+    assert any(w["state"] == "actor" for w in workers)
+
+    summary = state_api.summarize_tasks()
+    assert summary.get("FINISHED", 0) >= 5
+
+
+def test_cluster_metrics_counters(rt):
+    @ray_tpu.remote
+    def ok():
+        return 1
+
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("x")
+
+    before = state_api.cluster_metrics()
+    ray_tpu.get([ok.remote() for _ in range(3)], timeout=60)
+    with pytest.raises(Exception):
+        ray_tpu.get(boom.remote(), timeout=60)
+    after = state_api.cluster_metrics()
+    assert after["tasks_finished"] - before["tasks_finished"] >= 3
+    assert after["tasks_failed"] - before["tasks_failed"] >= 1
+    assert after["tasks_submitted"] >= after["tasks_finished"]
+    assert after["object_store_capacity_bytes"] > 0
+
+
+def test_metric_api():
+    c = Counter("test_requests", "reqs", tag_keys=("route",))
+    c.inc(tags={"route": "/a"})
+    c.inc(2, tags={"route": "/a"})
+    c.inc(tags={"route": "/b"})
+    snap = c.snapshot()
+    assert snap[(("route", "/a"),)] == 3
+    assert snap[(("route", "/b"),)] == 1
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    with pytest.raises(ValueError):
+        c.inc(tags={"nope": "x"})
+
+    g = Gauge("test_depth")
+    g.set(7)
+    g.set(3)
+    assert g.snapshot()[()] == 3
+
+    h = Histogram("test_latency", boundaries=[0.1, 1.0])
+    for v in (0.05, 0.5, 5.0, 0.7):
+        h.observe(v)
+    data = h.snapshot()[()]
+    assert data["count"] == 4
+    assert data["buckets"] == [1, 2, 1]
+
+    everything = collect()
+    assert {"test_requests", "test_depth", "test_latency"} <= set(everything)
+
+
+def test_actor_pool_ordered_and_unordered(rt):
+    @ray_tpu.remote
+    class Sq:
+        def compute(self, x):
+            time.sleep(0.01 * (x % 3))
+            return x * x
+
+    pool = ActorPool([Sq.remote() for _ in range(3)])
+    got = list(pool.map(lambda a, v: a.compute.remote(v), range(8)))
+    assert got == [x * x for x in range(8)]  # submission order
+
+    got2 = sorted(pool.map_unordered(lambda a, v: a.compute.remote(v), range(8)))
+    assert got2 == sorted(x * x for x in range(8))
+
+
+def test_actor_pool_queues_past_capacity(rt):
+    @ray_tpu.remote
+    class W:
+        def go(self, v):
+            return v
+
+    pool = ActorPool([W.remote()])
+    for i in range(5):
+        pool.submit(lambda a, v: a.go.remote(v), i)
+    out = [pool.get_next(timeout=30) for _ in range(5)]
+    assert out == list(range(5))
+    assert not pool.has_next()
+
+
+def test_queue_fifo_and_limits(rt):
+    q = Queue(maxsize=3)
+    q.put(1)
+    q.put(2)
+    q.put(3)
+    assert q.qsize() == 3 and q.full()
+    with pytest.raises(Full):
+        q.put_nowait(4)
+    assert [q.get(timeout=10) for _ in range(3)] == [1, 2, 3]
+    assert q.empty()
+    with pytest.raises(Empty):
+        q.get_nowait()
+
+    q.put_nowait_batch([7, 8])
+    assert q.get_nowait_batch(2) == [7, 8]
+    q.shutdown()
+
+
+def test_queue_cross_actor(rt):
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return n
+
+    @ray_tpu.remote
+    def consumer(q, n):
+        return [q.get(timeout=30) for _ in range(n)]
+
+    p = producer.remote(q, 5)
+    c = consumer.remote(q, 5)
+    assert ray_tpu.get(p, timeout=60) == 5
+    assert ray_tpu.get(c, timeout=60) == [0, 1, 2, 3, 4]
