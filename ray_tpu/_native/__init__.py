@@ -1,0 +1,18 @@
+"""Native (C++) components.
+
+SURVEY §2.1: the reference's hot runtime paths are C++; this package holds
+the TPU-native equivalents.  Current components:
+
+- shm_arena.cpp — the object-store core (plasma-core analogue,
+  ray: src/ray/object_manager/plasma/store.h:55): a process-shared mmap
+  arena with a mutex-protected first-fit allocator + open-addressed object
+  table.  Readers in every process slice objects out of ONE mapping
+  (zero per-object open/mmap syscalls).  Python binding: arena.py (ctypes).
+
+Build happens on demand with g++ into a per-user cache; every consumer
+falls back to the pure-Python implementation when the toolchain or
+platform is unavailable, so the native layer is an accelerator, never a
+hard dependency.
+"""
+
+from ray_tpu._native.arena import Arena, load_native  # noqa: F401

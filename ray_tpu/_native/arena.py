@@ -1,0 +1,240 @@
+"""ctypes binding + on-demand build for the C++ shm arena.
+
+The .so is compiled once per source-hash into ~/.cache/ray_tpu_native (or
+RAY_TPU_NATIVE_CACHE) and shared by every process of every session.  All
+data movement stays in Python via ONE mmap of the arena file — the C++
+side only does metadata (allocation + object table) under the
+process-shared mutex.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import mmap
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_build_lock = threading.Lock()
+_lib = None
+_lib_failed = False
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "shm_arena.cpp")
+
+
+def _cache_dir() -> str:
+    return os.environ.get(
+        "RAY_TPU_NATIVE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "ray_tpu_native"),
+    )
+
+
+def load_native() -> Optional[ctypes.CDLL]:
+    """Compile (once) and load the native library; None when unavailable."""
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    with _build_lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            with open(_SRC, "rb") as f:
+                src = f.read()
+            tag = hashlib.sha1(src).hexdigest()[:16]
+            out_dir = _cache_dir()
+            os.makedirs(out_dir, exist_ok=True)
+            so_path = os.path.join(out_dir, f"shm_arena-{tag}.so")
+            if not os.path.exists(so_path):
+                tmp = so_path + f".tmp-{os.getpid()}"
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp, "-lpthread"],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+                os.replace(tmp, so_path)  # atomic: racing builders converge
+            lib = ctypes.CDLL(so_path)
+            lib.arena_init.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+            lib.arena_init.restype = ctypes.c_int
+            lib.arena_open.argtypes = [ctypes.c_char_p]
+            lib.arena_open.restype = ctypes.c_void_p
+            lib.arena_close.argtypes = [ctypes.c_void_p]
+            lib.arena_alloc.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+            lib.arena_alloc.restype = ctypes.c_int64
+            lib.arena_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.arena_seal.restype = ctypes.c_int
+            lib.arena_lookup.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
+            lib.arena_lookup.restype = ctypes.c_int64
+            lib.arena_acquire.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
+            lib.arena_acquire.restype = ctypes.c_int64
+            lib.arena_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.arena_release.restype = ctypes.c_int
+            lib.arena_state.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.arena_state.restype = ctypes.c_int
+            lib.arena_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.arena_delete.restype = ctypes.c_int
+            lib.arena_used.argtypes = [ctypes.c_void_p]
+            lib.arena_used.restype = ctypes.c_uint64
+            lib.arena_capacity.argtypes = [ctypes.c_void_p]
+            lib.arena_capacity.restype = ctypes.c_uint64
+            _lib = lib
+        except Exception:
+            _lib_failed = True
+            _lib = None
+    return _lib
+
+
+class PinnedView:
+    """Zero-copy view of a sealed object that PINS its bytes for its own
+    lifetime (plasma's client-hold semantics): the arena will not reuse the
+    memory until this object is garbage-collected, even if the object is
+    deleted meanwhile (deferred free)."""
+
+    __slots__ = ("view", "_finalizer", "__weakref__")
+
+    def __init__(self, arena: "Arena", object_id: str, view: memoryview):
+        self.view = view
+        import weakref
+
+        self._finalizer = weakref.finalize(
+            self, Arena._release_pin, arena, object_id
+        )
+
+    def __bytes__(self) -> bytes:
+        return bytes(self.view)
+
+    def __len__(self) -> int:
+        return len(self.view)
+
+
+class Arena:
+    """One process's view of the session arena."""
+
+    ID_MAX = 47
+
+    @staticmethod
+    def _release_pin(arena: "Arena", object_id: str) -> None:
+        if not arena._closed:
+            arena._lib.arena_release(arena._h, object_id.encode())
+
+    def __init__(self, path: str, capacity: Optional[int] = None):
+        lib = load_native()
+        if lib is None:
+            raise RuntimeError("native arena unavailable (no g++ / build failed)")
+        self._lib = lib
+        self.path = path
+        if capacity is not None and not os.path.exists(path):
+            if lib.arena_init(path.encode(), capacity) != 0 and not os.path.exists(path):
+                raise RuntimeError(f"arena_init failed for {path}")
+        self._h = lib.arena_open(path.encode())
+        if not self._h:
+            raise RuntimeError(f"arena_open failed for {path}")
+        f = open(path, "r+b")
+        try:
+            self._mm = mmap.mmap(f.fileno(), 0)
+        finally:
+            f.close()
+        self._closed = False
+
+    # -- object ops -------------------------------------------------------
+    def _check_id(self, object_id: str) -> bytes:
+        b = object_id.encode()
+        if len(b) > self.ID_MAX:
+            # C-side ids are fixed-width; silently truncating would let
+            # distinct ids collide.
+            raise ValueError(f"object id longer than {self.ID_MAX} bytes: {object_id!r}")
+        return b
+
+    def create(self, object_id: str, data) -> None:
+        """Allocate + copy + seal in one call (data: bytes-like)."""
+        bid = self._check_id(object_id)
+        view = memoryview(data).cast("B")
+        off = self._lib.arena_alloc(self._h, bid, len(view))
+        if off == -2:
+            raise FileExistsError(object_id)
+        if off == -3:
+            raise RuntimeError("arena poisoned")
+        if off < 0:
+            raise MemoryError(
+                f"arena full: need {len(view)}, used {self.used()} of {self.capacity()}"
+            )
+        self._mm[off : off + len(view)] = view
+        if self._lib.arena_seal(self._h, bid) != 0:
+            raise RuntimeError(f"seal failed for {object_id}")
+
+    def allocate(self, object_id: str, size: int) -> memoryview:
+        """Two-phase create: returns a writable view; call seal() after."""
+        bid = self._check_id(object_id)
+        off = self._lib.arena_alloc(self._h, bid, size)
+        if off == -2:
+            raise FileExistsError(object_id)
+        if off == -3:
+            raise RuntimeError("arena poisoned")
+        if off < 0:
+            raise MemoryError(f"arena full: need {size}")
+        return memoryview(self._mm)[off : off + size]
+
+    def seal(self, object_id: str) -> None:
+        if self._lib.arena_seal(self._h, self._check_id(object_id)) != 0:
+            raise RuntimeError(f"seal failed for {object_id}")
+
+    def get(self, object_id: str) -> Optional[PinnedView]:
+        """Zero-copy PINNED view of a sealed object, or None.  The bytes
+        stay valid for the PinnedView's lifetime even across delete."""
+        bid = self._check_id(object_id)
+        size = ctypes.c_uint64()
+        off = self._lib.arena_acquire(self._h, bid, ctypes.byref(size))
+        if off < 0:
+            return None
+        view = memoryview(self._mm)[off : off + size.value]
+        return PinnedView(self, object_id, view)
+
+    def contains(self, object_id: str) -> bool:
+        size = ctypes.c_uint64()
+        return (
+            self._lib.arena_lookup(
+                self._h, self._check_id(object_id), ctypes.byref(size)
+            )
+            >= 0
+        )
+
+    def is_pending(self, object_id: str) -> bool:
+        """True when the id is taken but not sealed (creator may have died
+        mid-write) — callers can delete + retry."""
+        return self._lib.arena_state(self._h, self._check_id(object_id)) == 1
+
+    def delete(self, object_id: str) -> bool:
+        return self._lib.arena_delete(self._h, object_id.encode()) == 0
+
+    def used(self) -> int:
+        return self._lib.arena_used(self._h)
+
+    def capacity(self) -> int:
+        return self._lib.arena_capacity(self._h)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._mm.close()
+        except BufferError:
+            pass  # outstanding views keep the map alive until GC
+        self._lib.arena_close(self._h)
+
+    def destroy(self) -> None:
+        self.close()
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass

@@ -1,0 +1,450 @@
+// shm_arena: process-shared object arena — the native core of the object
+// store (plasma-core analogue; ray: src/ray/object_manager/plasma/store.h:55,
+// plasma_allocator.h:44, eviction metadata lives Python-side).
+//
+// One mmap'd file per session holds:
+//   [Header | object table (open addressing) | data heap]
+// All mutation is under a pthread process-shared mutex in the header; the
+// allocator is first-fit over an offset-sorted free list with coalescing.
+// Readers in ANY process (driver or workers) mmap the same file once and
+// slice objects out of it zero-copy — no per-object open/mmap syscalls,
+// which is what the Python file-per-object store pays on every access.
+//
+// C ABI (ctypes-friendly); all functions return <0 on error:
+//   -1 not found / no space   -2 already exists   -3 bad state
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t MAGIC = 0x52544055534852ULL;  // "RT@USHR"
+constexpr uint32_t N_SLOTS = 1 << 16;            // object table capacity
+constexpr uint32_t ID_MAX = 48;                  // max object-id length
+constexpr uint64_t ALIGN = 64;
+
+enum SlotState : uint32_t {
+  SLOT_FREE = 0,
+  SLOT_PENDING = 1,
+  SLOT_SEALED = 2,
+  SLOT_TOMBSTONE = 3,  // deleted; probe chains continue through it
+  SLOT_DOOMED = 4,     // deleted while pinned; freed at last release
+};
+
+struct Slot {
+  uint64_t hash;
+  uint32_t state;
+  uint32_t id_len;
+  char id[ID_MAX];
+  uint64_t offset;  // data offset from arena base
+  uint64_t size;
+  // Readers holding zero-copy views pin the slot (plasma's client-hold
+  // semantics: pinned bytes are never reused — the file backend got this
+  // for free from per-reader mmaps surviving unlink).
+  uint32_t pins;
+  uint32_t _pad;
+};
+
+struct FreeBlock {
+  uint64_t offset;
+  uint64_t size;
+};
+
+constexpr uint32_t FREELIST_MAX = 4096;
+
+struct Header {
+  uint64_t magic;
+  uint64_t capacity;     // total file size
+  uint64_t heap_start;   // first data byte
+  uint64_t bump;         // never-allocated frontier
+  uint64_t used_bytes;   // live (pending+sealed) payload bytes
+  uint32_t poisoned;     // a lock owner died mid-mutation: fail everything
+  uint32_t _pad;
+  pthread_mutex_t mu;    // process-shared
+  uint32_t n_free;
+  FreeBlock freelist[FREELIST_MAX];  // offset-sorted
+  Slot slots[N_SLOTS];
+};
+
+uint64_t fnv1a(const char* s, uint32_t len) {
+  uint64_t h = 1469598103934665603ULL;
+  for (uint32_t i = 0; i < len; i++) {
+    h ^= (unsigned char)s[i];
+    h *= 1099511628211ULL;
+  }
+  return h ? h : 1;
+}
+
+struct Handle {
+  Header* hdr;
+  uint64_t mapped;
+  int fd;
+};
+
+uint64_t align_up(uint64_t x) { return (x + ALIGN - 1) & ~(ALIGN - 1); }
+
+// Find the slot for id, or the first insertable slot when insert=true.
+Slot* find_slot(Header* h, const char* id, uint32_t id_len, bool insert) {
+  uint64_t hash = fnv1a(id, id_len);
+  uint32_t idx = (uint32_t)(hash & (N_SLOTS - 1));
+  Slot* first_insertable = nullptr;
+  for (uint32_t probe = 0; probe < N_SLOTS; probe++) {
+    Slot* s = &h->slots[(idx + probe) & (N_SLOTS - 1)];
+    if (s->state == SLOT_FREE) {
+      if (insert && first_insertable == nullptr) first_insertable = s;
+      return insert ? first_insertable : nullptr;
+    }
+    if (s->state == SLOT_TOMBSTONE) {
+      if (insert && first_insertable == nullptr) first_insertable = s;
+      continue;
+    }
+    if (s->hash == hash && s->id_len == id_len &&
+        memcmp(s->id, id, id_len) == 0) {
+      return s;  // existing entry (caller checks state)
+    }
+  }
+  return insert ? first_insertable : nullptr;
+}
+
+// First-fit allocate; splits blocks; falls back to the bump frontier.
+int64_t alloc_bytes(Header* h, uint64_t size) {
+  size = align_up(size);
+  for (uint32_t i = 0; i < h->n_free; i++) {
+    if (h->freelist[i].size >= size) {
+      uint64_t off = h->freelist[i].offset;
+      h->freelist[i].offset += size;
+      h->freelist[i].size -= size;
+      if (h->freelist[i].size == 0) {
+        memmove(&h->freelist[i], &h->freelist[i + 1],
+                (h->n_free - i - 1) * sizeof(FreeBlock));
+        h->n_free--;
+      }
+      return (int64_t)off;
+    }
+  }
+  if (h->bump + size <= h->capacity) {
+    uint64_t off = h->bump;
+    h->bump += size;
+    return (int64_t)off;
+  }
+  return -1;
+}
+
+// Insert [offset,size) into the offset-sorted free list, coalescing.
+void free_bytes(Header* h, uint64_t offset, uint64_t size) {
+  size = align_up(size);
+  // Frontier give-back: block touching the bump pointer shrinks it.
+  if (offset + size == h->bump) {
+    h->bump = offset;
+    // absorb a trailing free block that now touches the frontier
+    while (h->n_free > 0) {
+      FreeBlock* last = &h->freelist[h->n_free - 1];
+      if (last->offset + last->size == h->bump) {
+        h->bump = last->offset;
+        h->n_free--;
+      } else {
+        break;
+      }
+    }
+    return;
+  }
+  uint32_t i = 0;
+  while (i < h->n_free && h->freelist[i].offset < offset) i++;
+  // coalesce with predecessor
+  if (i > 0 && h->freelist[i - 1].offset + h->freelist[i - 1].size == offset) {
+    h->freelist[i - 1].size += size;
+    // and with successor
+    if (i < h->n_free &&
+        h->freelist[i - 1].offset + h->freelist[i - 1].size ==
+            h->freelist[i].offset) {
+      h->freelist[i - 1].size += h->freelist[i].size;
+      memmove(&h->freelist[i], &h->freelist[i + 1],
+              (h->n_free - i - 1) * sizeof(FreeBlock));
+      h->n_free--;
+    }
+    return;
+  }
+  // coalesce with successor
+  if (i < h->n_free && offset + size == h->freelist[i].offset) {
+    h->freelist[i].offset = offset;
+    h->freelist[i].size += size;
+    return;
+  }
+  if (h->n_free >= FREELIST_MAX) return;  // leak rather than corrupt
+  memmove(&h->freelist[i + 1], &h->freelist[i],
+          (h->n_free - i) * sizeof(FreeBlock));
+  h->freelist[i] = {offset, size};
+  h->n_free++;
+}
+
+void free_slot_bytes(Header* h, Slot* s) {
+  free_bytes(h, s->offset, s->size);
+  h->used_bytes -= s->size;
+  s->state = SLOT_TOMBSTONE;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create + initialize the arena file (driver, once per session).
+int arena_init(const char* path, uint64_t capacity) {
+  uint64_t meta = align_up(sizeof(Header));
+  if (capacity < meta + ALIGN) return -1;
+  int fd = open(path, O_RDWR | O_CREAT | O_EXCL, 0600);
+  if (fd < 0) return -1;
+  if (ftruncate(fd, (off_t)capacity) != 0) {
+    close(fd);
+    unlink(path);
+    return -1;
+  }
+  void* m = mmap(nullptr, capacity, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (m == MAP_FAILED) {
+    close(fd);
+    unlink(path);
+    return -1;
+  }
+  Header* h = (Header*)m;
+  memset(h, 0, sizeof(Header));
+  h->capacity = capacity;
+  h->heap_start = meta;
+  h->bump = meta;
+  h->used_bytes = 0;
+  h->n_free = 0;
+  pthread_mutexattr_t attr;
+  pthread_mutexattr_init(&attr);
+  pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+  // A crashed worker must not wedge every other process on the mutex.
+  pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mu, &attr);
+  pthread_mutexattr_destroy(&attr);
+  h->magic = MAGIC;  // last: marks fully initialized
+  msync(m, sizeof(Header), MS_SYNC);
+  munmap(m, capacity);
+  close(fd);
+  return 0;
+}
+
+void* arena_open(const char* path) {
+  int fd = open(path, O_RDWR);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* m = mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE,
+                 MAP_SHARED, fd, 0);
+  if (m == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  Header* h = (Header*)m;
+  if (h->magic != MAGIC) {
+    munmap(m, (size_t)st.st_size);
+    close(fd);
+    return nullptr;
+  }
+  Handle* out = new Handle{h, (uint64_t)st.st_size, fd};
+  return out;
+}
+
+void arena_close(void* hp) {
+  Handle* h = (Handle*)hp;
+  if (!h) return;
+  munmap(h->hdr, h->mapped);
+  close(h->fd);
+  delete h;
+}
+
+// Returns 0 when the arena is usable; nonzero when poisoned.  A lock owner
+// dying mid-mutation may have left the freelist/table half-updated —
+// continuing would hand the same bytes to two objects, so the arena is
+// POISONED: every op fails cleanly and callers fall back to the file
+// backend (existing objects reconstruct via lineage).
+static int lock_robust(Header* h) {
+  int rc = pthread_mutex_lock(&h->mu);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(&h->mu);
+    h->poisoned = 1;
+  }
+  return h->poisoned ? -3 : 0;
+}
+
+int64_t arena_alloc(void* hp, const char* id, uint64_t size) {
+  Handle* h = (Handle*)hp;
+  uint32_t id_len = (uint32_t)strnlen(id, ID_MAX);
+  if (lock_robust(h->hdr) != 0) {
+    pthread_mutex_unlock(&h->hdr->mu);
+    return -3;  // poisoned
+  }
+  Slot* s = find_slot(h->hdr, id, id_len, true);
+  if (s == nullptr) {
+    pthread_mutex_unlock(&h->hdr->mu);
+    return -1;  // table full
+  }
+  if (s->state == SLOT_PENDING || s->state == SLOT_SEALED ||
+      s->state == SLOT_DOOMED) {
+    pthread_mutex_unlock(&h->hdr->mu);
+    return -2;  // exists
+  }
+  int64_t off = alloc_bytes(h->hdr, size);
+  if (off < 0) {
+    pthread_mutex_unlock(&h->hdr->mu);
+    return -1;  // heap full
+  }
+  s->hash = fnv1a(id, id_len);
+  s->id_len = id_len;
+  memcpy(s->id, id, id_len);
+  s->offset = (uint64_t)off;
+  s->size = size;
+  s->pins = 0;
+  s->state = SLOT_PENDING;
+  h->hdr->used_bytes += size;
+  pthread_mutex_unlock(&h->hdr->mu);
+  return off;
+}
+
+int arena_seal(void* hp, const char* id) {
+  Handle* h = (Handle*)hp;
+  uint32_t id_len = (uint32_t)strnlen(id, ID_MAX);
+  if (lock_robust(h->hdr) != 0) {
+    pthread_mutex_unlock(&h->hdr->mu);
+    return -3;
+  }
+  Slot* s = find_slot(h->hdr, id, id_len, false);
+  if (s == nullptr || (s->state != SLOT_PENDING && s->state != SLOT_SEALED)) {
+    pthread_mutex_unlock(&h->hdr->mu);
+    return -1;
+  }
+  s->state = SLOT_SEALED;
+  pthread_mutex_unlock(&h->hdr->mu);
+  return 0;
+}
+
+// Sealed-object lookup + PIN: the caller holds a zero-copy view, so the
+// bytes must not be reused until arena_release.  Offset returned; size via
+// out-param.
+int64_t arena_acquire(void* hp, const char* id, uint64_t* size_out) {
+  Handle* h = (Handle*)hp;
+  uint32_t id_len = (uint32_t)strnlen(id, ID_MAX);
+  if (lock_robust(h->hdr) != 0) {
+    pthread_mutex_unlock(&h->hdr->mu);
+    return -3;
+  }
+  Slot* s = find_slot(h->hdr, id, id_len, false);
+  if (s == nullptr || s->state != SLOT_SEALED) {
+    pthread_mutex_unlock(&h->hdr->mu);
+    return -1;
+  }
+  s->pins++;
+  if (size_out) *size_out = s->size;
+  int64_t off = (int64_t)s->offset;
+  pthread_mutex_unlock(&h->hdr->mu);
+  return off;
+}
+
+int arena_release(void* hp, const char* id) {
+  Handle* h = (Handle*)hp;
+  uint32_t id_len = (uint32_t)strnlen(id, ID_MAX);
+  if (lock_robust(h->hdr) != 0) {
+    pthread_mutex_unlock(&h->hdr->mu);
+    return -3;
+  }
+  Slot* s = find_slot(h->hdr, id, id_len, false);
+  if (s == nullptr || (s->state != SLOT_SEALED && s->state != SLOT_DOOMED) ||
+      s->pins == 0) {
+    pthread_mutex_unlock(&h->hdr->mu);
+    return -1;
+  }
+  s->pins--;
+  if (s->pins == 0 && s->state == SLOT_DOOMED) {
+    free_slot_bytes(h->hdr, s);
+  }
+  pthread_mutex_unlock(&h->hdr->mu);
+  return 0;
+}
+
+// Unpinned existence/metadata check (state API, contains()).
+int64_t arena_lookup(void* hp, const char* id, uint64_t* size_out) {
+  Handle* h = (Handle*)hp;
+  uint32_t id_len = (uint32_t)strnlen(id, ID_MAX);
+  if (lock_robust(h->hdr) != 0) {
+    pthread_mutex_unlock(&h->hdr->mu);
+    return -3;
+  }
+  Slot* s = find_slot(h->hdr, id, id_len, false);
+  if (s == nullptr || s->state != SLOT_SEALED) {
+    pthread_mutex_unlock(&h->hdr->mu);
+    return -1;
+  }
+  if (size_out) *size_out = s->size;
+  int64_t off = (int64_t)s->offset;
+  pthread_mutex_unlock(&h->hdr->mu);
+  return off;
+}
+
+int arena_delete(void* hp, const char* id) {
+  Handle* h = (Handle*)hp;
+  uint32_t id_len = (uint32_t)strnlen(id, ID_MAX);
+  if (lock_robust(h->hdr) != 0) {
+    pthread_mutex_unlock(&h->hdr->mu);
+    return -3;
+  }
+  Slot* s = find_slot(h->hdr, id, id_len, false);
+  if (s == nullptr || s->state == SLOT_FREE || s->state == SLOT_TOMBSTONE) {
+    pthread_mutex_unlock(&h->hdr->mu);
+    return -1;
+  }
+  if (s->state == SLOT_DOOMED) {
+    pthread_mutex_unlock(&h->hdr->mu);
+    return 0;  // already deleted, awaiting last release
+  }
+  if (s->pins > 0) {
+    s->state = SLOT_DOOMED;  // invisible to lookups; freed at last release
+    pthread_mutex_unlock(&h->hdr->mu);
+    return 0;
+  }
+  free_slot_bytes(h->hdr, s);
+  pthread_mutex_unlock(&h->hdr->mu);
+  return 0;
+}
+
+// Slot state for diagnostics/recovery: 0 free/absent, 1 pending, 2 sealed,
+// 3 tombstone, 4 doomed, -3 poisoned.
+int arena_state(void* hp, const char* id) {
+  Handle* h = (Handle*)hp;
+  uint32_t id_len = (uint32_t)strnlen(id, ID_MAX);
+  if (lock_robust(h->hdr) != 0) {
+    pthread_mutex_unlock(&h->hdr->mu);
+    return -3;
+  }
+  Slot* s = find_slot(h->hdr, id, id_len, false);
+  int st = (s == nullptr) ? SLOT_FREE : (int)s->state;
+  pthread_mutex_unlock(&h->hdr->mu);
+  return st;
+}
+
+uint64_t arena_used(void* hp) {
+  Handle* h = (Handle*)hp;
+  if (lock_robust(h->hdr) != 0) {
+    pthread_mutex_unlock(&h->hdr->mu);
+    return 0;
+  }
+  uint64_t u = h->hdr->used_bytes;
+  pthread_mutex_unlock(&h->hdr->mu);
+  return u;
+}
+
+uint64_t arena_capacity(void* hp) {
+  Handle* h = (Handle*)hp;
+  return h->hdr->capacity - h->hdr->heap_start;
+}
+
+}  // extern "C"

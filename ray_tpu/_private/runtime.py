@@ -1176,6 +1176,7 @@ class Runtime:
     def _finish_with_error(self, rec: TaskRecord, err: Exception, release: bool) -> None:
         spec = rec.spec
         self.tasks.pop(spec.task_id, None)
+        self._record_task_end(rec, rec.worker_id, "FAILED")
         if release:
             self._release_for(rec)
         for c in spec.contained_refs:
@@ -1202,26 +1203,20 @@ class Runtime:
                 self._push_actor_task(ar, rec)
 
     def _fail_actor_queue(self, ar: ActorRuntime, err: Exception) -> None:
-        while ar.queued:
-            tid = ar.queued.popleft()
-            rec = self.tasks.pop(tid, None)
-            if rec is None:
-                continue
-            for oid in rec.spec.return_ids():
-                self.store.put_error(oid, err)
-                self._object_ready(oid)
-            for c in rec.spec.contained_refs:
-                self._decref_local(c)
-        for tid in list(ar.in_flight):
-            rec = self.tasks.pop(tid, None)
-            if rec is None:
-                continue
-            for oid in rec.spec.return_ids():
-                self.store.put_error(oid, err)
-                self._object_ready(oid)
-            for c in rec.spec.contained_refs:
-                self._decref_local(c)
+        # (each popped record below is also logged to the task-event sink)
+        doomed = list(ar.queued) + list(ar.in_flight)
+        ar.queued.clear()
         ar.in_flight.clear()
+        for tid in doomed:
+            rec = self.tasks.pop(tid, None)
+            if rec is None:
+                continue
+            self._record_task_end(rec, rec.worker_id, "FAILED")
+            for oid in rec.spec.return_ids():
+                self.store.put_error(oid, err)
+                self._object_ready(oid)
+            for c in rec.spec.contained_refs:
+                self._decref_local(c)
 
     def _record_task_end(self, rec, wid, state: str) -> None:
         spec = rec.spec
@@ -1270,6 +1265,7 @@ class Runtime:
             return
         if spec.attempt < spec.max_retries:
             spec.attempt += 1
+            self.metrics["tasks_retried"] += 1
             self._release_for(rec)
             rec.state = "READY"
             rec.worker_id = None

@@ -67,17 +67,78 @@ class SealedObject:
 
 
 class ShmStore:
-    """File-per-object tmpfs segments, mmap'ed zero-copy on read."""
+    """Host-shared object segments, mmap'ed zero-copy on read.
 
-    def __init__(self, session_name: str, root: Optional[str] = None):
+    Two backends behind one surface:
+    - native ARENA (default when the C++ component builds,
+      ray_tpu/_native/shm_arena.cpp): one mmap per process for the whole
+      session; C++ owns allocation + the object table, Python slices data
+      out of the single mapping — no per-object open/mmap syscalls;
+    - file-per-object tmpfs segments (fallback + overflow): atomic
+      rename-seal, still zero-copy via per-object mmap.
+
+    The driver decides (capacity= given + native available + env
+    RAY_TPU_NATIVE_STORE != 0) and creates the arena file; workers join
+    whatever exists on disk, so every process of a session agrees.
+    """
+
+    # Arena ids are fixed-width slots in C++ (ID_MAX); longer ids overflow
+    # to the file backend transparently.
+    _ARENA_ID_MAX = 47
+
+    def __init__(
+        self,
+        session_name: str,
+        root: Optional[str] = None,
+        capacity: Optional[int] = None,
+    ):
         self.dir = os.path.join(root or _default_shm_root(), f"raytpu-{session_name}")
         os.makedirs(self.dir, exist_ok=True)
+        self.arena = None
+        arena_path = os.path.join(self.dir, "arena")
+        if os.environ.get("RAY_TPU_NATIVE_STORE", "1") != "0":
+            try:
+                from ray_tpu._native.arena import Arena
+
+                if capacity is not None:
+                    # ~5.3MB of table metadata + the data heap
+                    self.arena = Arena(arena_path, capacity=capacity + 8 * 1024 * 1024)
+                elif os.path.exists(arena_path):
+                    self.arena = Arena(arena_path)
+            except Exception:
+                self.arena = None  # toolchain/platform unavailable: files
+
+    def _use_arena(self, object_id: str) -> bool:
+        return self.arena is not None and len(object_id) <= self._ARENA_ID_MAX
 
     def _path(self, object_id: str) -> str:
         return os.path.join(self.dir, object_id.replace(":", "_"))
 
     def create(self, object_id: str, payload: bytes, buffers: List[pickle.PickleBuffer]) -> int:
         size = ser.packed_size(payload, buffers)
+        if self._use_arena(object_id):
+            try:
+                try:
+                    view = self.arena.allocate(object_id, size)
+                except FileExistsError:
+                    if self.arena.is_pending(object_id):
+                        # The previous creator died between allocate and
+                        # seal: the stale PENDING slot would otherwise make
+                        # this id permanently unwritable AND unreadable.
+                        self.arena.delete(object_id)
+                        view = self.arena.allocate(object_id, size)
+                    else:
+                        return size  # sealed by the same producer re-run
+                try:
+                    ser.pack_into(view, payload, buffers)
+                finally:
+                    del view  # release the buffer before any later close()
+                self.arena.seal(object_id)
+                return size
+            except MemoryError:
+                pass  # fragmentation overflow: fall through to a file
+            except RuntimeError:
+                pass  # poisoned arena: file fallback
         path = self._path(object_id)
         tmp = path + ".tmp"
         with open(tmp, "wb+") as f:
@@ -88,9 +149,18 @@ class ShmStore:
         return size
 
     def contains(self, object_id: str) -> bool:
+        if self._use_arena(object_id) and self.arena.contains(object_id):
+            return True
         return os.path.exists(self._path(object_id))
 
     def get(self, object_id: str) -> Optional[SealedObject]:
+        if self._use_arena(object_id):
+            pinned = self.arena.get(object_id)
+            if pinned is not None:
+                # The PinnedView pins the arena bytes for the SealedObject's
+                # lifetime: delete/spill under live readers defers the free.
+                payload, buffers = ser.unpack(pinned.view)
+                return SealedObject(payload, buffers, keepalive=pinned)
         path = self._path(object_id)
         try:
             f = open(path, "rb")
@@ -105,12 +175,16 @@ class ShmStore:
         return SealedObject(payload, buffers, keepalive=m)
 
     def delete(self, object_id: str) -> None:
+        if self._use_arena(object_id) and self.arena.delete(object_id):
+            return
         try:
             os.unlink(self._path(object_id))
         except FileNotFoundError:
             pass
 
     def destroy(self) -> None:
+        if self.arena is not None:
+            self.arena.close()
         shutil.rmtree(self.dir, ignore_errors=True)
 
 
@@ -130,7 +204,12 @@ class OwnerStore:
         spill_dir: Optional[str] = None,
         capacity_bytes: Optional[int] = None,
     ):
-        self.shm = ShmStore(session_name)
+        if capacity_bytes is None:
+            env = os.environ.get("RAY_TPU_OBJECT_STORE_MEMORY")
+            capacity_bytes = (
+                int(env) if env else _default_capacity(_default_shm_root())
+            )
+        self.shm = ShmStore(session_name, capacity=capacity_bytes)
         self._mem: Dict[str, SealedObject] = {}
         self._in_shm: Dict[str, int] = {}  # id -> size
         self._spilled: Dict[str, str] = {}  # id -> file path
@@ -146,9 +225,6 @@ class OwnerStore:
         self._lock = threading.RLock()
         # Capacity + LRU clock (ray: plasma_allocator.h:44 footprint cap,
         # eviction_policy.h:105 LRUCache).  Overridable via env for tests/ops.
-        if capacity_bytes is None:
-            env = os.environ.get("RAY_TPU_OBJECT_STORE_MEMORY")
-            capacity_bytes = int(env) if env else _default_capacity(self.shm.dir)
         self.capacity = capacity_bytes
         self._clock = 0
         self._last_access: Dict[str, int] = {}

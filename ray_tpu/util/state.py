@@ -87,18 +87,19 @@ def list_objects(limit: int = 1000) -> List[Dict[str, Any]]:
 
 def list_nodes() -> List[Dict[str, Any]]:
     rt = _rt()
-    return [
-        {
-            "node_id": n.node_id,
-            "alive": n.alive,
-            "is_head": n.is_head,
-            "resources": dict(n.resources),
-            "available": dict(n.available),
-            "labels": dict(n.labels),
-            "has_daemon": n.node_id in rt.node_daemons,
-        }
-        for n in rt.state.nodes.values()
-    ]
+    with rt.state.lock:
+        return [
+            {
+                "node_id": n.node_id,
+                "alive": n.alive,
+                "is_head": n.is_head,
+                "resources": dict(n.resources),
+                "available": dict(n.available),
+                "labels": dict(n.labels),
+                "has_daemon": n.node_id in rt.node_daemons,
+            }
+            for n in rt.state.nodes.values()
+        ]
 
 
 def list_workers() -> List[Dict[str, Any]]:
@@ -119,16 +120,17 @@ def list_workers() -> List[Dict[str, Any]]:
 
 def list_placement_groups() -> List[Dict[str, Any]]:
     rt = _rt()
-    return [
-        {
-            "placement_group_id": pid,
-            "state": pg.state,
-            "strategy": pg.strategy,
-            "bundles": list(pg.bundles),
-            "bundle_nodes": dict(pg.bundle_nodes),
-        }
-        for pid, pg in rt.state.placement_groups.items()
-    ]
+    with rt.state.lock:
+        return [
+            {
+                "placement_group_id": pid,
+                "state": pg.state,
+                "strategy": pg.strategy,
+                "bundles": list(pg.bundles),
+                "bundle_nodes": dict(pg.bundle_nodes),
+            }
+            for pid, pg in rt.state.placement_groups.items()
+        ]
 
 
 def summarize_tasks() -> Dict[str, int]:
