@@ -58,6 +58,21 @@ from ray_tpu.exceptions import (
 _worker_mode = False  # set True inside worker processes (worker_proc.py)
 
 
+def _detect_tpu_chips() -> int:
+    """Local TPU chip count: RAY_TPU_CHIPS env override, else the TPU-VM
+    accelerator device files.  Never imports jax (backend init costs
+    seconds and this runs in every ray_tpu.init)."""
+    env = os.environ.get("RAY_TPU_CHIPS")
+    if env:
+        try:
+            return int(env)
+        except ValueError:
+            pass  # malformed override: fall through to device detection
+    import glob as _glob
+
+    return len(_glob.glob("/dev/accel*"))
+
+
 class _PopenHandle:
     """subprocess.Popen adapter exposing the mp.Process surface the runtime
     uses (terminate/join/is_alive/pid)."""
@@ -207,6 +222,12 @@ class Runtime:
         if num_cpus is None:
             num_cpus = max(os.cpu_count() or 1, 4)
         res = {"CPU": float(num_cpus), **(resources or {})}
+        chips = _detect_tpu_chips()
+        if chips > 0:
+            # TPU is a first-class schedulable resource (the reference's
+            # accelerators are GPU-only — accelerators.py:1-7): tasks/actors
+            # reserve chips via num_tpus / ScalingConfig.chips_per_worker.
+            res.setdefault("TPU", float(chips))
         self.state.register_node(
             NodeInfo(self.head_node_id, dict(res), dict(res), is_head=True)
         )
@@ -238,6 +259,10 @@ class Runtime:
             os.environ.get("RAY_TPU_LINEAGE_MAX_BYTES", str(64 * 1024 * 1024))
         )
         self.lineage_bytes = 0
+        # With an autoscaler attached, infeasible tasks PARK (the fleet may
+        # grow to fit them — ray's default behavior); without one they error
+        # fast (a fixed cluster can never run them).
+        self.allow_pending_infeasible = False
         # Task-event sink (ray: gcs_task_manager.h:61 ring-buffer storage):
         # bounded history of finished tasks powering the state API + metrics.
         self.task_events: deque = deque(maxlen=int(os.environ.get("RAY_TPU_TASK_EVENTS_MAX", "2000")))
@@ -1040,6 +1065,10 @@ class Runtime:
                 try:
                     node = self.scheduler.select_node(spec)
                 except ValueError as e:
+                    if self.allow_pending_infeasible:
+                        blocked_shapes.add(shape)
+                        self.ready_queue.append(tid)
+                        continue
                     self._finish_with_error(rec, e, release=False)
                     continue
                 if node is None or not self.scheduler.acquire(node, spec.resources):

@@ -1,0 +1,150 @@
+"""NodeProvider: the cloud-plugin seam of the autoscaler.
+
+ray: python/ray/autoscaler/node_provider.py:13 (NodeProvider ABC) +
+_private/fake_multi_node/node_provider.py:237 (FakeMultiNodeProvider).
+Providers own machine lifecycle; the autoscaler decides HOW MANY of each
+node type, the provider makes it so.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any, Dict, List, Optional
+
+
+class NodeProvider:
+    """Subclass per cloud.  node_type -> resource shape comes from the
+    autoscaler config's available_node_types table."""
+
+    def __init__(self, provider_config: Optional[Dict[str, Any]] = None):
+        self.provider_config = dict(provider_config or {})
+
+    def non_terminated_nodes(self) -> List[str]:
+        raise NotImplementedError
+
+    def node_resources(self, provider_node_id: str) -> Dict[str, float]:
+        raise NotImplementedError
+
+    def node_type(self, provider_node_id: str) -> str:
+        raise NotImplementedError
+
+    def create_node(self, node_type: str, resources: Dict[str, float]) -> str:
+        raise NotImplementedError
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        raise NotImplementedError
+
+    def runtime_node_id(self, provider_node_id: str) -> Optional[str]:
+        """The ray_tpu cluster node id this machine registered as (None
+        while still booting/joining)."""
+        raise NotImplementedError
+
+
+class LocalNodeProvider(NodeProvider):
+    """Test/dev provider: "machines" are in-process virtual nodes (or real
+    node-daemon processes with daemon=True) of the current runtime — the
+    analogue of FakeMultiNodeProvider, which starts extra raylets."""
+
+    def __init__(self, provider_config: Optional[Dict[str, Any]] = None):
+        super().__init__(provider_config)
+        self._nodes: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.Lock()
+        self.use_daemons = bool(self.provider_config.get("use_daemons", False))
+
+    def non_terminated_nodes(self) -> List[str]:
+        with self._lock:
+            return list(self._nodes.keys())
+
+    def node_resources(self, provider_node_id: str) -> Dict[str, float]:
+        return dict(self._nodes[provider_node_id]["resources"])
+
+    def node_type(self, provider_node_id: str) -> str:
+        return self._nodes[provider_node_id]["type"]
+
+    def create_node(self, node_type: str, resources: Dict[str, float]) -> str:
+        from ray_tpu._private.runtime import get_runtime
+
+        rt = get_runtime()
+        res = dict(resources)
+        cpus = res.pop("CPU", 1.0)
+        if self.use_daemons:
+            nid = rt.add_daemon_node(num_cpus=cpus, resources=res)
+        else:
+            nid = rt.add_node(num_cpus=cpus, resources=res)
+        pid = f"local-{uuid.uuid4().hex[:8]}"
+        with self._lock:
+            self._nodes[pid] = {
+                "type": node_type,
+                "resources": dict(resources),
+                "runtime_node_id": nid,
+            }
+        return pid
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        from ray_tpu._private.runtime import get_runtime
+
+        with self._lock:
+            info = self._nodes.pop(provider_node_id, None)
+        if info is not None:
+            get_runtime().remove_node(info["runtime_node_id"])
+
+    def runtime_node_id(self, provider_node_id: str) -> Optional[str]:
+        info = self._nodes.get(provider_node_id)
+        return info["runtime_node_id"] if info else None
+
+
+class TPUPodNodeProvider(NodeProvider):
+    """GCP TPU-VM provider sketch: node types are TPU slice shapes
+    (e.g. v5p-8 hosts), created via the TPU API / gcloud.
+
+    SURVEY §7.5 commits to a TPU-pod provider; this class carries the
+    shape of that integration (the commands the reference's GCP provider
+    pattern would run) — execution requires cloud credentials + egress, so
+    environments without them get a clear error instead of a silent no-op.
+    """
+
+    def __init__(self, provider_config: Optional[Dict[str, Any]] = None):
+        super().__init__(provider_config)
+        self.project = self.provider_config.get("project")
+        self.zone = self.provider_config.get("zone")
+        self._nodes: Dict[str, Dict[str, Any]] = {}
+
+    def _gcloud(self, *args: str) -> str:
+        import subprocess
+
+        cmd = ["gcloud", "compute", "tpus", "tpu-vm", *args,
+               f"--project={self.project}", f"--zone={self.zone}", "--format=json"]
+        out = subprocess.run(cmd, capture_output=True, text=True, timeout=600)
+        if out.returncode != 0:
+            raise RuntimeError(f"gcloud failed: {out.stderr[-500:]}")
+        return out.stdout
+
+    def non_terminated_nodes(self) -> List[str]:
+        return list(self._nodes.keys())
+
+    def node_resources(self, provider_node_id: str) -> Dict[str, float]:
+        return dict(self._nodes[provider_node_id]["resources"])
+
+    def node_type(self, provider_node_id: str) -> str:
+        return self._nodes[provider_node_id]["type"]
+
+    def create_node(self, node_type: str, resources: Dict[str, float]) -> str:
+        # node_type e.g. "v5p-8"; boots a TPU VM that runs
+        # `python -m ray_tpu._private.node_daemon` pointed at the head's
+        # address (cloud-init via --metadata startup-script).
+        name = f"raytpu-{node_type}-{uuid.uuid4().hex[:6]}"
+        self._gcloud(
+            "create", name, f"--accelerator-type={node_type}",
+            "--version=tpu-ubuntu2204-base",
+        )
+        self._nodes[name] = {"type": node_type, "resources": dict(resources), "runtime_node_id": None}
+        return name
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        if provider_node_id in self._nodes:
+            self._gcloud("delete", provider_node_id, "--quiet")
+            self._nodes.pop(provider_node_id, None)
+
+    def runtime_node_id(self, provider_node_id: str) -> Optional[str]:
+        return self._nodes.get(provider_node_id, {}).get("runtime_node_id")

@@ -1,0 +1,225 @@
+"""Autoscaler + job submission + TPU resource tests (reference intents:
+python/ray/tests/test_autoscaler.py with mock providers,
+test_autoscaler_fake_multinode.py, dashboard job tests).
+"""
+
+import os
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (
+    AutoscalerConfig,
+    LocalNodeProvider,
+    NodeTypeConfig,
+    StandardAutoscaler,
+)
+from ray_tpu.job_submission import FAILED, STOPPED, SUCCEEDED, JobSubmissionClient
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.init(num_cpus=1, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def _autoscaler(idle_timeout=60.0, max_workers=5, min_workers=0):
+    provider = LocalNodeProvider()
+    config = AutoscalerConfig(
+        node_types={
+            "cpu-2": NodeTypeConfig(
+                resources={"CPU": 2.0},
+                min_workers=min_workers,
+                max_workers=max_workers,
+            ),
+        },
+        idle_timeout_s=idle_timeout,
+    )
+    return StandardAutoscaler(provider, config), provider
+
+
+def test_scale_up_for_queued_tasks(rt):
+    """Tasks demanding more CPU than the cluster has → autoscaler launches
+    nodes → tasks complete."""
+    autoscaler, provider = _autoscaler()
+
+    @ray_tpu.remote(num_cpus=2)
+    def heavy():
+        return os.getpid()
+
+    refs = [heavy.remote() for _ in range(3)]  # head has 1 CPU: all queued
+    time.sleep(0.3)
+    result = autoscaler.update()
+    assert sum(result["launched"].values()) >= 1
+    # Demand-based launch must be enough to run the tasks.
+    out = ray_tpu.get(refs, timeout=120)
+    assert len(out) == 3
+    assert len(provider.non_terminated_nodes()) >= 1
+
+
+def test_min_workers_floor_and_max_cap(rt):
+    autoscaler, provider = _autoscaler(min_workers=2, max_workers=3)
+    result = autoscaler.update()
+    assert sum(result["launched"].values()) == 2  # floors
+
+    @ray_tpu.remote(num_cpus=2)
+    def f():
+        time.sleep(10)
+
+    _ = [f.remote() for _ in range(10)]
+    time.sleep(0.3)
+    autoscaler.update()
+    autoscaler.update()
+    assert len(provider.non_terminated_nodes()) <= 3  # max_workers cap
+
+
+def test_idle_nodes_terminated(rt):
+    autoscaler, provider = _autoscaler(idle_timeout=0.2)
+
+    @ray_tpu.remote(num_cpus=2)
+    def quick():
+        return 1
+
+    refs = [quick.remote() for _ in range(2)]
+    time.sleep(0.3)
+    autoscaler.update()
+    assert ray_tpu.get(refs, timeout=120) == [1, 1]
+    # Wait out the idle timeout; nodes above min_workers=0 are reclaimed.
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        time.sleep(0.3)
+        autoscaler.update()
+        if not provider.non_terminated_nodes():
+            break
+    assert not provider.non_terminated_nodes()
+
+
+def test_infeasible_demand_not_launched(rt):
+    """Demand no node type fits: no launch, reported + warned."""
+    autoscaler, provider = _autoscaler()
+
+    @ray_tpu.remote(num_cpus=64)
+    def impossible():
+        return 1
+
+    _ = impossible.remote()  # parks (autoscaler attached), never awaited
+    time.sleep(0.2)
+    with pytest.warns(UserWarning, match="NO configured node type"):
+        result = autoscaler.update()
+    assert sum(result["launched"].values()) == 0
+    assert result["infeasible"] == [{"CPU": 64.0}]
+    # Repeat passes don't relaunch or rewarn-spam.
+    result2 = autoscaler.update()
+    assert sum(result2["launched"].values()) == 0
+
+
+def test_tpu_resource_discovery_env():
+    os.environ["RAY_TPU_CHIPS"] = "4"
+    try:
+        ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+        assert ray_tpu.cluster_resources().get("TPU") == 4.0
+
+        @ray_tpu.remote(num_tpus=1)
+        def on_chip():
+            return "ok"
+
+        assert ray_tpu.get(on_chip.remote(), timeout=60) == "ok"
+        # 4 chips: a 5th concurrent reservation must queue.
+        assert ray_tpu.available_resources().get("TPU") == 4.0
+    finally:
+        ray_tpu.shutdown()
+        os.environ.pop("RAY_TPU_CHIPS", None)
+
+
+# -- job submission ----------------------------------------------------------
+
+
+def test_job_lifecycle(tmp_path):
+    client = JobSubmissionClient(log_dir=str(tmp_path))
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('hello-from-job')\"",
+        metadata={"owner": "test"},
+    )
+    status = client.wait_until_finish(job_id, timeout=60)
+    assert status == SUCCEEDED
+    assert "hello-from-job" in client.get_job_logs(job_id)
+    info = client.get_job_info(job_id)
+    assert info.return_code == 0 and info.metadata["owner"] == "test"
+    assert client.list_jobs()[0].job_id == job_id
+
+
+def test_job_failure_and_env_vars(tmp_path):
+    client = JobSubmissionClient(log_dir=str(tmp_path))
+    ok = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"import os; print(os.environ['MY_FLAG'])\"",
+        runtime_env={"env_vars": {"MY_FLAG": "flag-value-42"}},
+    )
+    bad = client.submit_job(entrypoint=f"{sys.executable} -c \"raise SystemExit(3)\"")
+    assert client.wait_until_finish(ok, timeout=60) == SUCCEEDED
+    assert "flag-value-42" in client.get_job_logs(ok)
+    assert client.wait_until_finish(bad, timeout=60) == FAILED
+    assert client.get_job_info(bad).return_code == 3
+
+
+def test_job_stop(tmp_path):
+    client = JobSubmissionClient(log_dir=str(tmp_path))
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"import time; time.sleep(600)\""
+    )
+    time.sleep(0.5)
+    assert client.stop_job(job_id)
+    assert client.wait_until_finish(job_id, timeout=30) == STOPPED
+    assert not client.stop_job(job_id)  # already terminal
+
+
+def test_inflight_boots_not_relaunched(rt):
+    """Async provider (slow boot): repeated update() passes must not
+    launch more machines for the same unmet demand."""
+    from ray_tpu.autoscaler import NodeProvider
+
+    class SlowBootProvider(NodeProvider):
+        def __init__(self):
+            super().__init__()
+            self.created = []
+
+        def non_terminated_nodes(self):
+            return list(self.created)
+
+        def node_resources(self, pid):
+            return {"CPU": 2.0}
+
+        def node_type(self, pid):
+            return "cpu-2"
+
+        def create_node(self, node_type, resources):
+            pid = f"slow-{len(self.created)}"
+            self.created.append(pid)
+            return pid
+
+        def terminate_node(self, pid):
+            self.created.remove(pid)
+
+        def runtime_node_id(self, pid):
+            return None  # still booting forever (test never joins them)
+
+    provider = SlowBootProvider()
+    config = AutoscalerConfig(
+        node_types={"cpu-2": NodeTypeConfig(resources={"CPU": 2.0}, max_workers=10)},
+    )
+    autoscaler = StandardAutoscaler(provider, config)
+
+    @ray_tpu.remote(num_cpus=2)
+    def f():
+        return 1
+
+    _ = f.remote()
+    time.sleep(0.2)
+    r1 = autoscaler.update()
+    assert sum(r1["launched"].values()) == 1
+    for _ in range(3):
+        rn = autoscaler.update()
+        assert sum(rn["launched"].values()) == 0, "relaunched for in-flight boot"
+    assert len(provider.created) == 1
