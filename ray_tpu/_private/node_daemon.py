@@ -24,8 +24,12 @@ from typing import Dict
 
 
 def _build_worker_env(
-    wid: str, host: str, port: int, authkey_hex: str, session: str, env_vars
+    wid: str, host: str, port: int, authkey_hex: str, session: str, renv
 ) -> Dict[str, str]:
+    from ray_tpu._private.runtime_env import worker_env_entries
+
+    renv = renv or {}
+    env_vars = renv.get("env_vars") or {}
     env = os.environ.copy()
     env.update(
         {
@@ -34,10 +38,10 @@ def _build_worker_env(
             "RAY_TPU_AUTHKEY": authkey_hex,
             "RAY_TPU_WORKER_ID": wid,
             "RAY_TPU_SESSION": session,
-            "RAY_TPU_ENV_VARS": json.dumps(env_vars or {}),
+            **worker_env_entries(renv),
         }
     )
-    env.update({k: str(v) for k, v in (env_vars or {}).items()})
+    env.update({k: str(v) for k, v in env_vars.items()})
     # Workers must die with their daemon even on SIGKILL (a raylet's workers
     # don't outlive node death): worker_main arms PR_SET_PDEATHSIG.
     env["RAY_TPU_PDEATHSIG"] = "1"
@@ -126,8 +130,8 @@ def main() -> None:
             return
         kind = msg[0]
         if kind == "spawn_worker":
-            _, wid, env_vars = msg
-            env = _build_worker_env(wid, host, port, authkey_hex, session, env_vars)
+            _, wid, renv = msg
+            env = _build_worker_env(wid, host, port, authkey_hex, session, renv)
             children[wid] = subprocess.Popen(
                 [sys.executable, "-m", "ray_tpu._private.worker_proc"],
                 env=env,

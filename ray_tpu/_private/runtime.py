@@ -58,6 +58,19 @@ from ray_tpu.exceptions import (
 _worker_mode = False  # set True inside worker processes (worker_proc.py)
 
 
+def _runtime_env_key(renv) -> object:
+    """Worker-pool identity of a runtime env: workers are only shared
+    between tasks whose env_vars AND code packages match."""
+    if not renv:
+        return None
+    env_vars = renv.get("env_vars") or None
+    return (
+        tuple(sorted(env_vars.items())) if env_vars else None,
+        renv.get("working_dir"),
+        tuple(renv.get("py_modules") or ()) or None,
+    )
+
+
 def _detect_tpu_chips() -> int:
     """Local TPU chip count: RAY_TPU_CHIPS env override, else the TPU-VM
     accelerator device files.  Never imports jax (backend init costs
@@ -443,23 +456,23 @@ class Runtime:
         self._daemon_procs.pop(nid, None)
         raise TimeoutError("node daemon did not register in time")
 
-    def _spawn_worker(self, node_id: str, env_key, env_vars, prestart: bool = False) -> WorkerHandle:
+    def _spawn_worker(self, node_id: str, env_key, renv, prestart: bool = False) -> WorkerHandle:
         if node_id in self.node_daemons:
             # Remote-node spawn: the daemon execs the worker on its host;
             # the worker connects straight back to this driver.
             wid = ids.worker_id()
             self.metrics["workers_spawned"] += 1
-            self._daemon_send(node_id, ("spawn_worker", wid, env_vars or {}))
+            self._daemon_send(node_id, ("spawn_worker", wid, renv or {}))
             handle = WorkerHandle(
-                wid, node_id, env_key, env_vars, _RemoteProcHandle(self, node_id, wid)
+                wid, node_id, env_key, renv, _RemoteProcHandle(self, node_id, wid)
             )
             self.workers[wid] = handle
             if prestart:
                 self.starting_pool.setdefault((node_id, env_key), []).append(wid)
             return handle
-        return self._spawn_local_worker(node_id, env_key, env_vars, prestart)
+        return self._spawn_local_worker(node_id, env_key, renv, prestart)
 
-    def _spawn_local_worker(self, node_id: str, env_key, env_vars, prestart: bool = False) -> WorkerHandle:
+    def _spawn_local_worker(self, node_id: str, env_key, renv, prestart: bool = False) -> WorkerHandle:
         # Workers are exec'ed as fresh interpreters (`python -m ..worker_proc`)
         # rather than multiprocessing children: mp's spawn/forkserver children
         # re-import the driver's __main__ module during bootstrap, which
@@ -473,23 +486,25 @@ class Runtime:
 
         wid = ids.worker_id()
         self.metrics["workers_spawned"] += 1
-        env = self._child_env(
-            {
-                "RAY_TPU_WORKER_ID": wid,
-                "RAY_TPU_SESSION": self.session_name,
-                "RAY_TPU_ENV_VARS": json.dumps(env_vars or {}),
-            }
-        )
+        from ray_tpu._private.runtime_env import worker_env_entries
+
+        env_vars = (renv or {}).get("env_vars") or {}
+        extra = {
+            "RAY_TPU_WORKER_ID": wid,
+            "RAY_TPU_SESSION": self.session_name,
+            **worker_env_entries(renv),
+        }
+        env = self._child_env(extra)
         # runtime_env vars must exist at interpreter start (sitecustomize may
         # import jax before worker_main applies them).
-        env.update({k: str(v) for k, v in (env_vars or {}).items()})
+        env.update({k: str(v) for k, v in env_vars.items()})
         popen = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_proc"],
             env=env,
             close_fds=True,
         )
         proc = _PopenHandle(popen)
-        handle = WorkerHandle(wid, node_id, env_key, env_vars, proc)
+        handle = WorkerHandle(wid, node_id, env_key, renv, proc)
         self.workers[wid] = handle
         if prestart:
             # Only unleased spawns are advertised as leasable; a demand spawn
@@ -498,8 +513,8 @@ class Runtime:
         return handle
 
     def _lease_worker(self, node_id: str, spec: TaskSpec) -> WorkerHandle:
-        env_vars = (spec.runtime_env or {}).get("env_vars") or None
-        env_key = tuple(sorted(env_vars.items())) if env_vars else None
+        renv = spec.runtime_env or None
+        env_key = _runtime_env_key(renv)
         pool = self.idle_pool.get((node_id, env_key))
         while pool:
             wid = pool.pop()
@@ -514,7 +529,7 @@ class Runtime:
             h = self.workers.get(wid)
             if h is not None and h.state == "starting":
                 return h
-        return self._spawn_worker(node_id, env_key, env_vars)
+        return self._spawn_worker(node_id, env_key, renv)
 
     def _return_worker(self, h: WorkerHandle) -> None:
         if h.state == "dead":
@@ -536,56 +551,94 @@ class Runtime:
     # IO threads
 
     def _accept_loop(self):
+        # Each connection's first-message handshake runs on its own thread:
+        # a starting worker opens a kv_fetch side-channel BEFORE sending
+        # "ready" on its main conn, so a serial accept loop would deadlock
+        # (blocked recv'ing the main conn's handshake while the fetch conn
+        # waits for service).
         while not self._shutdown:
             try:
                 conn = self.listener.accept()
-                first = conn.recv()
             except (OSError, EOFError):
                 if self._shutdown:
                     return
                 continue
-            if first[0] == "daemon":
-                # Node daemon registration: ("daemon", node_id, cfg, pid).
-                _, node_id, cfg, _pid = first
-                res = {"CPU": float(cfg.get("num_cpus", 1.0)), **(cfg.get("resources") or {})}
-                with self.lock:
-                    if node_id not in self.state.nodes:
-                        self.state.register_node(
-                            NodeInfo(
-                                node_id, dict(res), dict(res),
-                                labels=dict(cfg.get("labels") or {}),
-                            )
-                        )
-                    self.node_daemons[node_id] = conn
-                    self._conn_to_daemon[conn] = node_id
-                    self._dispatch()
-                continue
-            if first[0] != "ready":
+            threading.Thread(
+                target=self._handshake, args=(conn,), daemon=True,
+                name="raytpu-handshake",
+            ).start()
+
+    def _handshake(self, conn) -> None:
+        try:
+            first = conn.recv()
+        except (OSError, EOFError):
+            conn.close()
+            return
+        try:
+            self._dispatch_handshake(conn, first)
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+            try:
                 conn.close()
-                continue
-            wid = first[1]
+            except OSError:
+                pass
+
+    def _dispatch_handshake(self, conn, first) -> None:
+        if first[0] == "kv_fetch":
+            # One-shot fetch channel: a STARTING worker materializes its
+            # runtime-env packages before its main conn says "ready"
+            # (the main conn can't serve requests yet — replies park
+            # behind the ready handshake).
+            try:
+                conn.send(self.state.kv_get(first[1]))
+            except OSError:
+                pass
+            conn.close()
+            return
+        if first[0] == "daemon":
+            # Node daemon registration: ("daemon", node_id, cfg, pid).
+            _, node_id, cfg, _pid = first
+            res = {"CPU": float(cfg.get("num_cpus", 1.0)), **(cfg.get("resources") or {})}
             with self.lock:
-                h = self.workers.get(wid)
-                if h is None:
-                    conn.close()
-                    continue
-                h.conn = conn
-                h.pid = first[2]
-                for msg in h.pending_sends:
-                    try:
-                        conn.send(msg)
-                    except OSError:
-                        pass
-                h.pending_sends = []
-                if h.state == "starting":
-                    h.state = "idle"
-                    sp = self.starting_pool.get((h.node_id, h.env_key))
-                    if sp and wid in sp:
-                        sp.remove(wid)
-                    self.idle_pool.setdefault((h.node_id, h.env_key), []).append(wid)
-                self._conn_to_worker[conn] = wid
-            with self.lock:
+                if node_id not in self.state.nodes:
+                    self.state.register_node(
+                        NodeInfo(
+                            node_id, dict(res), dict(res),
+                            labels=dict(cfg.get("labels") or {}),
+                        )
+                    )
+                self.node_daemons[node_id] = conn
+                self._conn_to_daemon[conn] = node_id
                 self._dispatch()
+            return
+        if first[0] != "ready":
+            conn.close()
+            return
+        wid = first[1]
+        with self.lock:
+            h = self.workers.get(wid)
+            if h is None:
+                conn.close()
+                return
+            h.conn = conn
+            h.pid = first[2]
+            for msg in h.pending_sends:
+                try:
+                    conn.send(msg)
+                except OSError:
+                    pass
+            h.pending_sends = []
+            if h.state == "starting":
+                h.state = "idle"
+                sp = self.starting_pool.get((h.node_id, h.env_key))
+                if sp and wid in sp:
+                    sp.remove(wid)
+                self.idle_pool.setdefault((h.node_id, h.env_key), []).append(wid)
+            self._conn_to_worker[conn] = wid
+        with self.lock:
+            self._dispatch()
 
     def _io_loop(self):
         from multiprocessing.connection import wait as conn_wait
@@ -920,6 +973,16 @@ class Runtime:
     # submission (ray: CoreWorker::SubmitTask -> direct_task_transport.h:75)
 
     def submit_task(self, spec: TaskSpec) -> List[str]:
+        if spec.runtime_env and (
+            spec.runtime_env.get("working_dir") or spec.runtime_env.get("py_modules")
+        ):
+            # Package local dirs into content-addressed KV entries ONCE;
+            # workers fetch + extract (ray: runtime_env packaging/uri_cache).
+            from ray_tpu._private.runtime_env import resolve_runtime_env
+
+            spec.runtime_env = resolve_runtime_env(
+                spec.runtime_env, lambda uri, data: self.state.kv_put(uri, data)
+            )
         rec = TaskRecord(spec)
         return_ids = spec.return_ids()
         with self.lock:

@@ -1,0 +1,158 @@
+"""Runtime environments: per-task/actor working_dir + py_modules + env_vars.
+
+ray: python/ray/_private/runtime_env/{working_dir,py_modules,packaging,
+uri_cache}.py — directories are zipped, content-addressed as pkg:// URIs,
+shipped through the cluster KV store, and extracted into a per-host cache
+that workers add to sys.path / chdir into.  env_vars flow through the
+worker spawn env (runtime.py) as before.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import tempfile
+import threading
+import zipfile
+from typing import Any, Dict, List, Optional, Tuple
+
+_EXCLUDES = {"__pycache__", ".git", ".venv", "node_modules"}
+_MAX_PKG_BYTES = 256 * 1024 * 1024  # ray: working_dir size cap spirit
+
+_pkg_cache_lock = threading.Lock()
+_packaged: Dict[Tuple, Tuple[str, bytes]] = {}  # fingerprint -> (uri, zip)
+
+
+def _dir_fingerprint(path: str) -> Tuple:
+    """Cheap change detector: (relpath, mtime, size) of every file.  The
+    directory's own mtime is NOT enough — editing a file's contents leaves
+    it unchanged, which would ship stale code."""
+    entries = []
+    for root, dirs, files in os.walk(path):
+        dirs[:] = [d for d in dirs if d not in _EXCLUDES]
+        for fname in sorted(files):
+            full = os.path.join(root, fname)
+            st = os.stat(full)
+            entries.append((os.path.relpath(full, path), st.st_mtime, st.st_size))
+    return (path, tuple(entries))
+
+
+def package_dir(path: str) -> Tuple[str, bytes]:
+    """Zip a directory into a content-addressed pkg:// URI."""
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        raise ValueError(f"runtime_env directory not found: {path}")
+    key = _dir_fingerprint(path)
+    with _pkg_cache_lock:
+        hit = _packaged.get(key)
+        if hit is not None:
+            return hit
+    buf = io.BytesIO()
+    total = 0
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        for root, dirs, files in os.walk(path):
+            dirs[:] = [d for d in dirs if d not in _EXCLUDES]
+            for fname in sorted(files):
+                full = os.path.join(root, fname)
+                rel = os.path.relpath(full, path)
+                total += os.path.getsize(full)
+                if total > _MAX_PKG_BYTES:
+                    raise ValueError(
+                        f"runtime_env dir {path} exceeds {_MAX_PKG_BYTES} bytes"
+                    )
+                z.write(full, rel)
+    data = buf.getvalue()
+    uri = "pkg://" + hashlib.sha1(data).hexdigest()[:20]
+    with _pkg_cache_lock:
+        _packaged[key] = (uri, data)
+    return uri, data
+
+
+def resolve_runtime_env(renv: Optional[Dict[str, Any]], kv_put) -> Optional[Dict[str, Any]]:
+    """Driver-side: package local dirs → URIs, upload once to the KV store.
+    Returns the resolved env shipped to workers (paths replaced by URIs)."""
+    if not renv:
+        return renv
+    out = dict(renv)
+    wd = out.get("working_dir")
+    if wd and not str(wd).startswith("pkg://"):
+        uri, data = package_dir(wd)
+        kv_put(uri, data)
+        out["working_dir"] = uri
+    mods = out.get("py_modules")
+    if mods:
+        uris = []
+        for m in mods:
+            if str(m).startswith("pkg://"):
+                uris.append(m)
+            else:
+                uri, data = package_dir(m)
+                kv_put(uri, data)
+                uris.append(uri)
+        out["py_modules"] = uris
+    return out
+
+
+def worker_env_entries(renv: Optional[Dict[str, Any]]) -> Dict[str, str]:
+    """The RAY_TPU_* env entries a worker spawn needs for its runtime env
+    (single source for the driver-local and node-daemon spawn paths)."""
+    import json
+
+    renv = renv or {}
+    out = {"RAY_TPU_ENV_VARS": json.dumps(renv.get("env_vars") or {})}
+    if renv.get("working_dir") or renv.get("py_modules"):
+        out["RAY_TPU_RUNTIME_ENV"] = json.dumps(
+            {k: renv.get(k) for k in ("working_dir", "py_modules")}
+        )
+    return out
+
+
+def _extract_cache_dir() -> str:
+    return os.environ.get(
+        "RAY_TPU_PKG_CACHE",
+        os.path.join(tempfile.gettempdir(), "raytpu-pkg-cache"),
+    )
+
+
+def fetch_and_extract(uri: str, kv_get) -> str:
+    """Worker-side: materialize a pkg:// URI into the host cache (idempotent
+    across workers — content-addressed dir + atomic rename)."""
+    assert uri.startswith("pkg://")
+    dest = os.path.join(_extract_cache_dir(), uri[len("pkg://") :])
+    if os.path.isdir(dest):
+        return dest
+    data = kv_get(uri)
+    if data is None:
+        raise ValueError(f"runtime_env package {uri} missing from KV store")
+    tmp = dest + f".tmp-{os.getpid()}"
+    os.makedirs(tmp, exist_ok=True)
+    with zipfile.ZipFile(io.BytesIO(data)) as z:
+        z.extractall(tmp)
+    try:
+        os.replace(tmp, dest)
+    except OSError:
+        # another worker won the race; use theirs
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    return dest
+
+
+def apply_worker_runtime_env(renv: Optional[Dict[str, Any]], kv_get) -> None:
+    """Worker-side: chdir into working_dir, put py_modules + working_dir on
+    sys.path (ray: workers import user code from the extracted URIs)."""
+    if not renv:
+        return
+    import sys
+
+    for uri in renv.get("py_modules") or []:
+        path = fetch_and_extract(uri, kv_get)
+        if path not in sys.path:
+            sys.path.insert(0, path)
+    wd = renv.get("working_dir")
+    if wd:
+        path = fetch_and_extract(wd, kv_get) if str(wd).startswith("pkg://") else wd
+        os.chdir(path)
+        if path not in sys.path:
+            sys.path.insert(0, path)

@@ -293,6 +293,8 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
 
     runtime_mod._worker_mode = True
 
+
+
     task_q: "queue.Queue[tuple]" = queue.Queue()
     pool = None  # ThreadPoolExecutor for max_concurrency > 1
 
@@ -335,6 +337,29 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
             conn.send(done)
 
     threading.Thread(target=recv_loop, daemon=True, name="worker-recv").start()
+
+    # Materialize working_dir / py_modules BEFORE the ready handshake (no
+    # task may run before its code exists).  Packages come over dedicated
+    # one-shot kv_fetch connections: the main conn cannot serve requests
+    # yet — the owner parks replies behind "ready".
+    renv_json = os.environ.get("RAY_TPU_RUNTIME_ENV")
+    if renv_json:
+        import json as _json
+
+        from multiprocessing.connection import Client as _Client
+
+        from ray_tpu._private.runtime_env import apply_worker_runtime_env
+
+        def _fetch(key):
+            c = _Client(address, authkey=authkey)
+            try:
+                c.send(("kv_fetch", key))
+                return c.recv()
+            finally:
+                c.close()
+
+        apply_worker_runtime_env(_json.loads(renv_json), kv_get=_fetch)
+
     with conn_lock:
         conn.send(("ready", worker_id, os.getpid()))
 

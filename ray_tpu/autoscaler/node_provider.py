@@ -130,15 +130,35 @@ class TPUPodNodeProvider(NodeProvider):
         return self._nodes[provider_node_id]["type"]
 
     def create_node(self, node_type: str, resources: Dict[str, float]) -> str:
-        # node_type e.g. "v5p-8"; boots a TPU VM that runs
+        # node_type e.g. "v5p-8"; boots a TPU VM whose startup script runs
         # `python -m ray_tpu._private.node_daemon` pointed at the head's
-        # address (cloud-init via --metadata startup-script).
+        # address, with a node_id PRE-ASSIGNED here — once the daemon
+        # registers under it, runtime_node_id() flips from None (booting)
+        # to the joined id, which is what the autoscaler's boot-timeout and
+        # idle logic key on.
         name = f"raytpu-{node_type}-{uuid.uuid4().hex[:6]}"
+        from ray_tpu._private import ids as _ids
+
+        nid = _ids.node_id()
+        startup = (
+            "export RAY_TPU_NODE_CONFIG='"
+            + '{"node_id": "%s", "session": "%s", "num_cpus": %s}' % (
+                nid,
+                self.provider_config.get("session", "default"),
+                resources.get("CPU", 1),
+            )
+            + "'; python -m ray_tpu._private.node_daemon"
+        )
         self._gcloud(
             "create", name, f"--accelerator-type={node_type}",
             "--version=tpu-ubuntu2204-base",
+            f"--metadata=startup-script={startup}",
         )
-        self._nodes[name] = {"type": node_type, "resources": dict(resources), "runtime_node_id": None}
+        self._nodes[name] = {
+            "type": node_type,
+            "resources": dict(resources),
+            "runtime_node_id": nid,
+        }
         return name
 
     def terminate_node(self, provider_node_id: str) -> None:
@@ -147,4 +167,10 @@ class TPUPodNodeProvider(NodeProvider):
             self._nodes.pop(provider_node_id, None)
 
     def runtime_node_id(self, provider_node_id: str) -> Optional[str]:
-        return self._nodes.get(provider_node_id, {}).get("runtime_node_id")
+        """None until the VM's daemon actually registers the node."""
+        nid = self._nodes.get(provider_node_id, {}).get("runtime_node_id")
+        if nid is None:
+            return None
+        from ray_tpu._private.runtime import get_runtime
+
+        return nid if nid in get_runtime().state.nodes else None

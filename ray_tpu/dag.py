@@ -1,0 +1,74 @@
+"""Lazy task DAGs: fn.bind(...) builds a graph, execute() runs it.
+
+ray: python/ray/dag/ (DAGNode, .bind()/.execute()) — the base the
+reference's Serve graphs and Workflow build on.  A DAGNode records a
+remote function + args (which may be other DAGNodes); execute() walks the
+graph ONCE per node (diamonds share results) and wires ObjectRefs so the
+runtime's dependency tracking does the scheduling — no driver-side joins
+between stages.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+
+class DAGNode:
+    """One lazy invocation of a remote function."""
+
+    def __init__(self, fn, args: Tuple, kwargs: Dict):
+        from ray_tpu.remote_function import RemoteFunction
+
+        if not isinstance(fn, RemoteFunction):
+            raise TypeError("DAGNode target must be a @ray_tpu.remote function")
+        self._fn = fn
+        self._args = args
+        self._kwargs = kwargs
+
+    # -- introspection ----------------------------------------------------
+    def _children(self) -> List["DAGNode"]:
+        out = []
+        for a in list(self._args) + list(self._kwargs.values()):
+            if isinstance(a, DAGNode):
+                out.append(a)
+        return out
+
+    def topological_order(self) -> List["DAGNode"]:
+        """Children before parents; each node once (diamond-safe)."""
+        seen: Dict[int, DAGNode] = {}
+        order: List[DAGNode] = []
+
+        def visit(node: "DAGNode", stack: set):
+            if id(node) in seen:
+                return
+            if id(node) in stack:
+                raise ValueError("cycle in DAG")
+            stack.add(id(node))
+            for c in node._children():
+                visit(c, stack)
+            stack.remove(id(node))
+            seen[id(node)] = node
+            order.append(node)
+
+        visit(self, set())
+        return order
+
+    # -- execution --------------------------------------------------------
+    def execute(self):
+        """Submit the whole graph; returns the root's ObjectRef.  Shared
+        subgraphs run once; inter-node edges are ObjectRefs, so stages
+        pipeline through the runtime's dependency tracking."""
+        results: Dict[int, Any] = {}
+        for node in self.topological_order():
+            args = [
+                results[id(a)] if isinstance(a, DAGNode) else a for a in node._args
+            ]
+            kwargs = {
+                k: results[id(v)] if isinstance(v, DAGNode) else v
+                for k, v in node._kwargs.items()
+            }
+            results[id(node)] = node._fn.remote(*args, **kwargs)
+        return results[id(self)]
+
+    def __repr__(self):
+        return f"DAGNode({getattr(self._fn, '_name', '?')}, deps={len(self._children())})"

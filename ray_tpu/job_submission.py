@@ -82,15 +82,24 @@ class JobSubmissionClient:
             paths.append(env["PYTHONPATH"])
         env["PYTHONPATH"] = os.pathsep.join(paths)
         log_f = open(info.log_path, "wb")
-        proc = subprocess.Popen(
-            entrypoint,
-            shell=True,
-            cwd=cwd,
-            env=env,
-            stdout=log_f,
-            stderr=subprocess.STDOUT,
-            start_new_session=True,  # stop_job kills the whole group
-        )
+        try:
+            proc = subprocess.Popen(
+                entrypoint,
+                shell=True,
+                cwd=cwd,
+                env=env,
+                stdout=log_f,
+                stderr=subprocess.STDOUT,
+                start_new_session=True,  # stop_job kills the whole group
+            )
+        except Exception as e:
+            # No ghost PENDING jobs: record the spawn failure durably.
+            log_f.write(f"job spawn failed: {e!r}\n".encode())
+            log_f.close()
+            with self._lock:
+                info.status = FAILED
+                info.end_time = time.time()
+            raise
         log_f.close()
         stop_now = False
         with self._lock:

@@ -40,6 +40,13 @@ class RemoteFunction:
             f"use {self.__name__}.remote()"
         )
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node (ray: dag .bind()); run via .execute() or
+        workflow.run()."""
+        from ray_tpu.dag import DAGNode
+
+        return DAGNode(self, args, kwargs)
+
     def remote(self, *args, **kwargs):
         fn_id = self._ensure_exported()
         o = self._opts

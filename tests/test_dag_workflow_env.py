@@ -1,0 +1,188 @@
+"""DAG API, durable workflows, and working_dir/py_modules runtime envs
+(reference intents: python/ray/dag tests, workflow tests, runtime_env
+working_dir tests).
+"""
+
+import os
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+# -- DAG ---------------------------------------------------------------------
+
+
+def test_dag_bind_execute(rt):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    @ray_tpu.remote
+    def mul(a, b):
+        return a * b
+
+    dag = mul.bind(add.bind(1, 2), add.bind(3, b=4))
+    assert ray_tpu.get(dag.execute(), timeout=60) == 21
+
+
+def test_dag_diamond_runs_shared_node_once(rt, tmp_path):
+    marker = tmp_path / "runs"
+
+    @ray_tpu.remote
+    def base():
+        with open(marker, "a") as f:
+            f.write("x")
+        return 10
+
+    @ray_tpu.remote
+    def left(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def right(x):
+        return x + 2
+
+    @ray_tpu.remote
+    def join(a, b):
+        return a + b
+
+    shared = base.bind()
+    dag = join.bind(left.bind(shared), right.bind(shared))
+    assert ray_tpu.get(dag.execute(), timeout=60) == 23
+    assert marker.read_text() == "x", "shared DAG node executed twice"
+
+
+def test_dag_cycle_detection(rt):
+    @ray_tpu.remote
+    def f(x):
+        return x
+
+    a = f.bind(1)
+    b = f.bind(a)
+    a._args = (b,)  # force a cycle
+    with pytest.raises(ValueError, match="cycle"):
+        b.execute()
+
+
+# -- workflow ----------------------------------------------------------------
+
+
+def test_workflow_run_and_durable_output(rt, tmp_path):
+    workflow.init(str(tmp_path))
+
+    @ray_tpu.remote
+    def fetch():
+        return [1, 2, 3]
+
+    @ray_tpu.remote
+    def total(xs):
+        return sum(xs)
+
+    out = workflow.run(total.bind(fetch.bind()), workflow_id="wf-basic")
+    assert out == 6
+    assert workflow.get_status("wf-basic") == workflow.SUCCEEDED
+    assert workflow.get_output("wf-basic") == 6
+    assert {"workflow_id": "wf-basic", "status": "SUCCEEDED"} in workflow.list_all()
+
+
+def test_workflow_resume_skips_completed_steps(rt, tmp_path):
+    workflow.init(str(tmp_path))
+    marker = tmp_path / "exec-count"
+
+    @ray_tpu.remote
+    def step_a():
+        with open(marker, "a") as f:
+            f.write("a")
+        return 5
+
+    @ray_tpu.remote
+    def step_b(x):
+        with open(marker, "a") as f:
+            f.write("b")
+        return x * 2
+
+    out = workflow.run(step_b.bind(step_a.bind()), workflow_id="wf-resume")
+    assert out == 10
+    assert marker.read_text() == "ab"
+
+    # Simulate a crash after step_a: delete step_b's durable result only.
+    wf_dir = tmp_path / "wf-resume"
+    removed = [p for p in os.listdir(wf_dir) if p.startswith("step_b")]
+    assert removed
+    for p in removed:
+        os.unlink(wf_dir / p)
+    (wf_dir / "status").write_text(workflow.RUNNING)
+
+    out2 = workflow.resume("wf-resume")
+    assert out2 == 10
+    # step_a was NOT re-executed (durable), step_b was.
+    assert marker.read_text() == "abb"
+
+
+# -- runtime envs ------------------------------------------------------------
+
+
+def test_working_dir_ships_to_workers(rt, tmp_path):
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "data.txt").write_text("shipped-content")
+    (proj / "helper_mod_xyz.py").write_text("VALUE = 'from-helper'\n")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(proj)})
+    def read_data():
+        import helper_mod_xyz  # importable: working_dir on sys.path
+
+        with open("data.txt") as f:  # cwd is the extracted working_dir
+            return f.read(), helper_mod_xyz.VALUE, os.getcwd()
+
+    content, helper, cwd = ray_tpu.get(read_data.remote(), timeout=60)
+    assert content == "shipped-content"
+    assert helper == "from-helper"
+    assert cwd != str(proj), "worker should run from the EXTRACTED copy"
+
+
+def test_py_modules_ship_to_workers(rt, tmp_path):
+    mod_dir = tmp_path / "mods"
+    (mod_dir / "mypkg_xyz").mkdir(parents=True)
+    (mod_dir / "mypkg_xyz" / "__init__.py").write_text("MAGIC = 424242\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(mod_dir)]})
+    def use_pkg():
+        from mypkg_xyz import MAGIC
+
+        return MAGIC
+
+    assert ray_tpu.get(use_pkg.remote(), timeout=60) == 424242
+
+
+def test_runtime_env_workers_not_shared_across_envs(rt, tmp_path):
+    d1 = tmp_path / "env1"
+    d2 = tmp_path / "env2"
+    for d, v in ((d1, "one"), (d2, "two")):
+        d.mkdir()
+        (d / "tag.txt").write_text(v)
+
+    @ray_tpu.remote
+    def read_tag():
+        with open("tag.txt") as f:
+            return f.read(), os.getpid()
+
+    t1, pid1 = ray_tpu.get(
+        read_tag.options(runtime_env={"working_dir": str(d1)}).remote(), timeout=60
+    )
+    t2, pid2 = ray_tpu.get(
+        read_tag.options(runtime_env={"working_dir": str(d2)}).remote(), timeout=60
+    )
+    assert (t1, t2) == ("one", "two")
+    assert pid1 != pid2, "different runtime envs must not share a worker"
