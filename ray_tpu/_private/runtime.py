@@ -181,7 +181,10 @@ class WorkerHandle:
 
 
 class TaskRecord:
-    __slots__ = ("spec", "state", "node_id", "worker_id", "unmet_deps", "cancelled", "pg")
+    __slots__ = (
+        "spec", "state", "node_id", "worker_id", "unmet_deps", "cancelled",
+        "pg", "start_time",
+    )
 
     def __init__(self, spec):
         self.spec = spec
@@ -191,6 +194,7 @@ class TaskRecord:
         self.unmet_deps = 0
         self.cancelled = False
         self.pg = None  # (pg_id, bundle_index) when resources come from a PG
+        self.start_time = None  # wall time when dispatched (timeline)
 
 
 class ActorRuntime:
@@ -297,7 +301,10 @@ class Runtime:
         # backlog: many workers connect at once on startup; the default
         # backlog of 1 silently drops simultaneous handshakes (the dropped
         # worker then blocks forever in its auth recv).
-        self.listener = Listener(("127.0.0.1", 0), backlog=128, authkey=self._authkey)
+        # Loopback by default; RAY_TPU_BIND_HOST=0.0.0.0 exposes the driver
+        # to daemons on OTHER machines (required for cloud node providers).
+        bind_host = os.environ.get("RAY_TPU_BIND_HOST", "127.0.0.1")
+        self.listener = Listener((bind_host, 0), backlog=128, authkey=self._authkey)
         self.address = self.listener.address
         self._shutdown = False
         self._conn_to_worker: Dict[Any, str] = {}
@@ -973,8 +980,13 @@ class Runtime:
     # submission (ray: CoreWorker::SubmitTask -> direct_task_transport.h:75)
 
     def submit_task(self, spec: TaskSpec) -> List[str]:
-        if spec.runtime_env and (
-            spec.runtime_env.get("working_dir") or spec.runtime_env.get("py_modules")
+        if (
+            spec.runtime_env
+            and not spec.runtime_env.get("_resolved")
+            and (
+                spec.runtime_env.get("working_dir")
+                or spec.runtime_env.get("py_modules")
+            )
         ):
             # Package local dirs into content-addressed KV entries ONCE;
             # workers fetch + extract (ray: runtime_env packaging/uri_cache).
@@ -1047,6 +1059,7 @@ class Runtime:
             ar.queued.append(rec.spec.task_id)
             return
         rec.state = "RUNNING"
+        rec.start_time = time.time()
         rec.worker_id = h.worker_id
         rec.node_id = h.node_id
         ar.in_flight.add(rec.spec.task_id)
@@ -1140,6 +1153,7 @@ class Runtime:
                     continue
             h = self._lease_worker(node, spec)
             rec.state = "RUNNING"
+            rec.start_time = time.time()
             rec.node_id = node
             rec.worker_id = h.worker_id
             h.current_task = tid
@@ -1313,6 +1327,7 @@ class Runtime:
     def _record_task_end(self, rec, wid, state: str) -> None:
         spec = rec.spec
         self.metrics["tasks_finished" if state == "FINISHED" else "tasks_failed"] += 1
+        end = time.time()
         self.task_events.append(
             {
                 "task_id": spec.task_id,
@@ -1322,7 +1337,8 @@ class Runtime:
                 "worker_id": wid,
                 "actor_id": spec.actor_id,
                 "attempt": spec.attempt,
-                "end_time": time.time(),
+                "end_time": end,
+                "duration": (end - rec.start_time) if rec.start_time else 0.0,
             }
         )
 

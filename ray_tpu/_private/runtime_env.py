@@ -21,7 +21,11 @@ _EXCLUDES = {"__pycache__", ".git", ".venv", "node_modules"}
 _MAX_PKG_BYTES = 256 * 1024 * 1024  # ray: working_dir size cap spirit
 
 _pkg_cache_lock = threading.Lock()
-_packaged: Dict[Tuple, Tuple[str, bytes]] = {}  # fingerprint -> (uri, zip)
+# (session, fingerprint) -> uri ONLY: retaining the zip payload would leak
+# every edited version of the dir in driver memory (the bytes are needed
+# exactly once per SESSION for the kv upload — the KV store dies with its
+# Runtime, so a new session must re-upload even for an unchanged dir).
+_fingerprint_to_uri: Dict[Tuple, str] = {}
 
 
 def _dir_fingerprint(path: str) -> Tuple:
@@ -38,16 +42,19 @@ def _dir_fingerprint(path: str) -> Tuple:
     return (path, tuple(entries))
 
 
-def package_dir(path: str) -> Tuple[str, bytes]:
-    """Zip a directory into a content-addressed pkg:// URI."""
+def package_dir(path: str, session: Optional[str] = None) -> Tuple[str, Optional[bytes]]:
+    """Zip a directory into a content-addressed pkg:// URI.
+
+    Returns (uri, zip_bytes); zip_bytes is None on a cache hit (the payload
+    was already uploaded to this session — nothing retains it)."""
     path = os.path.abspath(path)
     if not os.path.isdir(path):
         raise ValueError(f"runtime_env directory not found: {path}")
-    key = _dir_fingerprint(path)
+    key = (session, _dir_fingerprint(path))
     with _pkg_cache_lock:
-        hit = _packaged.get(key)
+        hit = _fingerprint_to_uri.get(key)
         if hit is not None:
-            return hit
+            return hit, None
     buf = io.BytesIO()
     total = 0
     with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
@@ -65,20 +72,23 @@ def package_dir(path: str) -> Tuple[str, bytes]:
     data = buf.getvalue()
     uri = "pkg://" + hashlib.sha1(data).hexdigest()[:20]
     with _pkg_cache_lock:
-        _packaged[key] = (uri, data)
+        _fingerprint_to_uri[key] = uri
     return uri, data
 
 
-def resolve_runtime_env(renv: Optional[Dict[str, Any]], kv_put) -> Optional[Dict[str, Any]]:
-    """Driver-side: package local dirs → URIs, upload once to the KV store.
-    Returns the resolved env shipped to workers (paths replaced by URIs)."""
+def resolve_runtime_env(
+    renv: Optional[Dict[str, Any]], kv_put, session: Optional[str] = None
+) -> Optional[Dict[str, Any]]:
+    """Driver-side: package local dirs → URIs, upload once PER SESSION to
+    the KV store.  Returns the resolved env shipped to workers."""
     if not renv:
         return renv
     out = dict(renv)
     wd = out.get("working_dir")
     if wd and not str(wd).startswith("pkg://"):
-        uri, data = package_dir(wd)
-        kv_put(uri, data)
+        uri, data = package_dir(wd, session)
+        if data is not None:
+            kv_put(uri, data)
         out["working_dir"] = uri
     mods = out.get("py_modules")
     if mods:
@@ -87,8 +97,9 @@ def resolve_runtime_env(renv: Optional[Dict[str, Any]], kv_put) -> Optional[Dict
             if str(m).startswith("pkg://"):
                 uris.append(m)
             else:
-                uri, data = package_dir(m)
-                kv_put(uri, data)
+                uri, data = package_dir(m, session)
+                if data is not None:
+                    kv_put(uri, data)
                 uris.append(uri)
         out["py_modules"] = uris
     return out

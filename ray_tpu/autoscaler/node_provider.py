@@ -137,17 +137,44 @@ class TPUPodNodeProvider(NodeProvider):
         # to the joined id, which is what the autoscaler's boot-timeout and
         # idle logic key on.
         name = f"raytpu-{node_type}-{uuid.uuid4().hex[:6]}"
-        from ray_tpu._private import ids as _ids
+        import json
 
+        from ray_tpu._private import ids as _ids
+        from ray_tpu._private.runtime import get_runtime
+
+        rt = get_runtime()
         nid = _ids.node_id()
-        startup = (
-            "export RAY_TPU_NODE_CONFIG='"
-            + '{"node_id": "%s", "session": "%s", "num_cpus": %s}' % (
-                nid,
-                self.provider_config.get("session", "default"),
-                resources.get("CPU", 1),
+        _bind_host, port = rt.address
+        # The driver's loopback bind address is useless to a remote VM: the
+        # head host must come from provider config (and the driver must run
+        # with RAY_TPU_BIND_HOST=0.0.0.0 or a routable interface).
+        host = self.provider_config.get("head_host")
+        if not host:
+            raise ValueError(
+                "TPUPodNodeProvider requires provider_config['head_host'] — "
+                "a driver address the TPU VMs can route to"
             )
-            + "'; python -m ray_tpu._private.node_daemon"
+        node_cfg = json.dumps(
+            {
+                "node_id": nid,
+                "session": rt.session_name,
+                "num_cpus": resources.get("CPU", 1),
+                # full shape + labels: a TPU node registering CPU-only would
+                # leave the TPU demand that triggered this launch infeasible
+                "resources": {k: v for k, v in resources.items() if k != "CPU"},
+                "labels": dict(self.provider_config.get("labels") or {}),
+            }
+        )
+        # NOTE: a hardened deployment should deliver the authkey via a
+        # secret manager rather than instance metadata.
+        import shlex
+
+        startup = (
+            f"export RAY_TPU_DRIVER_HOST={shlex.quote(str(host))}; "
+            f"export RAY_TPU_DRIVER_PORT={shlex.quote(str(port))}; "
+            f"export RAY_TPU_AUTHKEY={shlex.quote(rt._authkey.hex())}; "
+            f"export RAY_TPU_NODE_CONFIG={shlex.quote(node_cfg)}; "
+            "python -m ray_tpu._private.node_daemon"
         )
         self._gcloud(
             "create", name, f"--accelerator-type={node_type}",

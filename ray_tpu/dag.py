@@ -26,11 +26,23 @@ class DAGNode:
         self._kwargs = kwargs
 
     # -- introspection ----------------------------------------------------
+    @staticmethod
+    def _scan(value, found: List["DAGNode"]) -> None:
+        """Collect DAGNodes nested in containers (ray's DAG scans args the
+        same way) — a node hidden in a list must be executed, not pickled."""
+        if isinstance(value, DAGNode):
+            found.append(value)
+        elif isinstance(value, (list, tuple, set)):
+            for v in value:
+                DAGNode._scan(v, found)
+        elif isinstance(value, dict):
+            for v in value.values():
+                DAGNode._scan(v, found)
+
     def _children(self) -> List["DAGNode"]:
-        out = []
+        out: List[DAGNode] = []
         for a in list(self._args) + list(self._kwargs.values()):
-            if isinstance(a, DAGNode):
-                out.append(a)
+            self._scan(a, out)
         return out
 
     def topological_order(self) -> List["DAGNode"]:
@@ -59,14 +71,23 @@ class DAGNode:
         subgraphs run once; inter-node edges are ObjectRefs, so stages
         pipeline through the runtime's dependency tracking."""
         results: Dict[int, Any] = {}
+
+        def subst(value):
+            if isinstance(value, DAGNode):
+                return results[id(value)]
+            if isinstance(value, list):
+                return [subst(v) for v in value]
+            if isinstance(value, tuple):
+                return tuple(subst(v) for v in value)
+            if isinstance(value, set):
+                return {subst(v) for v in value}
+            if isinstance(value, dict):
+                return {k: subst(v) for k, v in value.items()}
+            return value
+
         for node in self.topological_order():
-            args = [
-                results[id(a)] if isinstance(a, DAGNode) else a for a in node._args
-            ]
-            kwargs = {
-                k: results[id(v)] if isinstance(v, DAGNode) else v
-                for k, v in node._kwargs.items()
-            }
+            args = [subst(a) for a in node._args]
+            kwargs = {k: subst(v) for k, v in node._kwargs.items()}
             results[id(node)] = node._fn.remote(*args, **kwargs)
         return results[id(self)]
 

@@ -1,0 +1,125 @@
+"""Dashboard-lite: HTTP endpoints over the state API + a timeline export.
+
+ray: dashboard/ (DashboardHead at head.py:70 + REST modules) reduced to
+the load-bearing surface: JSON endpoints for nodes/tasks/actors/objects/
+workers/metrics and a Chrome-trace timeline (the reference's
+`ray timeline`, python/ray/_private/profiling.py).  Serves with the stdlib
+threaded HTTP server — no frontend build, curl/jq-friendly.
+
+    GET /api/nodes | /api/tasks | /api/actors | /api/objects
+    GET /api/workers | /api/placement_groups | /api/metrics | /api/summary
+    GET /api/timeline        (chrome://tracing format)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+def timeline() -> list:
+    """Chrome-trace events from the runtime's task-event sink
+    (ray: `ray timeline` exports the same catapult format)."""
+    from ray_tpu._private.runtime import get_runtime
+
+    rt = get_runtime()
+    with rt.lock:
+        events = list(rt.task_events)
+    out = []
+    for e in events:
+        dur_us = int(max(e.get("duration", 0.0), 0.0) * 1e6)
+        end_us = int(e["end_time"] * 1e6)
+        out.append(
+            {
+                "name": e["name"],
+                "cat": "task",
+                "ph": "X",  # complete event
+                "ts": end_us - dur_us,
+                "dur": max(dur_us, 1),
+                "pid": e.get("node_id") or "head",
+                "tid": e.get("worker_id") or "?",
+                "args": {
+                    "task_id": e["task_id"],
+                    "state": e["state"],
+                    "attempt": e["attempt"],
+                },
+            }
+        )
+    return out
+
+
+class Dashboard:
+    """Embeddable dashboard server (one per driver)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        from ray_tpu.util import state as state_api
+
+        routes = {
+            "/api/nodes": state_api.list_nodes,
+            "/api/tasks": state_api.list_tasks,
+            "/api/actors": state_api.list_actors,
+            "/api/objects": state_api.list_objects,
+            "/api/workers": state_api.list_workers,
+            "/api/placement_groups": state_api.list_placement_groups,
+            "/api/metrics": state_api.cluster_metrics,
+            "/api/summary": state_api.summarize_tasks,
+            "/api/timeline": timeline,
+        }
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                fn = routes.get(self.path.split("?")[0])
+                if fn is None:
+                    body = json.dumps(
+                        {"error": "unknown route", "routes": sorted(routes)}
+                    ).encode()
+                    code = 404
+                else:
+                    try:
+                        body = json.dumps(fn(), default=str).encode()
+                        code = 200
+                    except Exception as e:  # noqa: BLE001 — HTTP boundary
+                        body = json.dumps({"error": repr(e)}).encode()
+                        code = 500
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host = host
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="raytpu-dash"
+        )
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+
+
+_dashboard: Optional[Dashboard] = None
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 0) -> Dashboard:
+    global _dashboard
+    if _dashboard is None:
+        _dashboard = Dashboard(host, port)
+    return _dashboard
+
+
+def stop_dashboard() -> None:
+    global _dashboard
+    if _dashboard is not None:
+        _dashboard.shutdown()
+        _dashboard = None

@@ -50,6 +50,34 @@ class RemoteFunction:
     def remote(self, *args, **kwargs):
         fn_id = self._ensure_exported()
         o = self._opts
+        renv = o.get("runtime_env")
+        session = current_session()
+        if (
+            renv
+            and (renv.get("working_dir") or renv.get("py_modules"))
+            and renv.get("_resolved") != session
+        ):
+            # Package + upload ONCE per session per options instance — an
+            # os.walk per submit would sit on the hot path, but a cached
+            # resolution from a PREVIOUS session points at pkg:// blobs the
+            # new session's KV never saw, so the marker is the session name.
+            from ray_tpu._private.runtime_env import resolve_runtime_env
+
+            # Re-resolving for a NEW session must start from the original
+            # local paths (a prior resolution replaced them with pkg://
+            # URIs, which resolve_runtime_env passes through untouched).
+            raw = {k: v for k, v in renv.items() if k not in ("_resolved", "_orig")}
+            raw.update(renv.get("_orig") or {})
+            resolved = resolve_runtime_env(
+                raw, lambda u, d: client.kv_put(u, d), session
+            )
+            resolved["_orig"] = {
+                k: raw[k]
+                for k in ("working_dir", "py_modules")
+                if raw.get(k) and not str(raw[k]).startswith("pkg://")
+            }
+            resolved["_resolved"] = session
+            o["runtime_env"] = resolved
         resources = dict(o.get("resources") or {})
         resources["CPU"] = float(o.get("num_cpus", 1))
         if o.get("num_tpus"):

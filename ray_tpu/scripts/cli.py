@@ -1,0 +1,99 @@
+"""CLI: `python -m ray_tpu.scripts.cli <command>`.
+
+ray: python/ray/scripts/scripts.py (`ray status/list/microbenchmark/
+timeline/job submit`).  Commands that need a live cluster boot a local one
+unless attaching is implemented by the deployment (the daemons connect to
+a driver, so `status` etc. act on the CURRENT process's runtime — these
+commands are most useful embedded in driver scripts or via the dashboard).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def cmd_microbenchmark(args) -> int:
+    from ray_tpu._private import ray_perf
+
+    ray_perf.main(["--json", args.json] if args.json else [])
+    return 0
+
+
+def cmd_status(args) -> int:
+    import ray_tpu
+    from ray_tpu.util import state as state_api
+
+    ray_tpu.init(ignore_reinit_error=True)
+    print(json.dumps(
+        {
+            "nodes": state_api.list_nodes(),
+            "resources": ray_tpu.cluster_resources(),
+            "available": ray_tpu.available_resources(),
+            "metrics": state_api.cluster_metrics(),
+        },
+        indent=1,
+        default=str,
+    ))
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    import ray_tpu
+    from ray_tpu.dashboard import timeline
+
+    ray_tpu.init(ignore_reinit_error=True)
+    out = args.output or "timeline.json"
+    with open(out, "w") as f:
+        json.dump(timeline(), f)
+    print(f"wrote {out} (open in chrome://tracing or Perfetto)")
+    return 0
+
+
+def cmd_job_submit(args) -> int:
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(entrypoint=" ".join(args.entrypoint))
+    status = client.wait_until_finish(job_id, timeout=args.timeout)
+    sys.stdout.write(client.get_job_logs(job_id))
+    print(f"\njob {job_id}: {status}")
+    return 0 if status == "SUCCEEDED" else 1
+
+
+def cmd_bench(args) -> int:
+    import subprocess
+
+    return subprocess.call([sys.executable, "bench.py"])
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ray_tpu", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    mb = sub.add_parser("microbenchmark", help="core runtime microbenchmarks")
+    mb.add_argument("--json", help="write results to this file")
+    mb.set_defaults(fn=cmd_microbenchmark)
+
+    st = sub.add_parser("status", help="cluster nodes/resources/metrics")
+    st.set_defaults(fn=cmd_status)
+
+    tl = sub.add_parser("timeline", help="export chrome-trace task timeline")
+    tl.add_argument("--output", "-o")
+    tl.set_defaults(fn=cmd_timeline)
+
+    js = sub.add_parser("job", help="submit a job and stream its logs")
+    js.add_argument("entrypoint", nargs="+")
+    js.add_argument("--timeout", type=float, default=3600.0)
+    js.set_defaults(fn=cmd_job_submit)
+
+    be = sub.add_parser("bench", help="run the train benchmark (bench.py)")
+    be.set_defaults(fn=cmd_bench)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

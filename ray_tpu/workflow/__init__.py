@@ -178,7 +178,11 @@ def resume(workflow_id: str) -> Any:
     with open(os.path.join(wf_dir, "dag.pkl"), "rb") as f:
         dag = cloudpickle.load(f)
     ref = _submit_dag(workflow_id, dag)
-    out = ray_tpu.get(ref, timeout=None)
+    try:
+        out = ray_tpu.get(ref, timeout=None)
+    except Exception:
+        _atomic_write(os.path.join(wf_dir, "status"), FAILED.encode())
+        raise
     _atomic_write(os.path.join(wf_dir, "status"), SUCCEEDED.encode())
     return out
 

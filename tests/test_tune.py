@@ -42,21 +42,22 @@ def test_grid_and_random_search(tune_cluster):
 
 def test_asha_stops_bad_trials(tune_cluster):
     def trainable(config):
-        import time
-
         for step in range(1, 21):
             # lr quality is baked into the score slope
             tune.report({"score": config["lr"] * step, "training_iteration": step})
-            # pace reports so rungs fill across concurrent trials (ASHA
-            # compares within a rung; a burst-finishing trial sees no peers)
-            time.sleep(0.05)
 
+    # Serial execution, best-first order: ASHA's rungs retain completed
+    # trials' scores, so the later bad trials deterministically fall below
+    # the recorded cutoffs — no reliance on wall-clock overlap (the old
+    # sleep-paced concurrent version flaked under CI load when trials
+    # serialized worst-first and the single bad-first trial had no peers).
     tuner = tune.Tuner(
         trainable,
-        param_space={"lr": tune.grid_search([0.01, 0.1, 1.0, 10.0])},
+        param_space={"lr": tune.grid_search([10.0, 1.0, 0.1, 0.01])},
         tune_config=tune.TuneConfig(
             metric="score",
             mode="max",
+            max_concurrent_trials=1,
             scheduler=tune.ASHAScheduler(grace_period=2, reduction_factor=2, max_t=20),
         ),
         run_config=RunConfig(name="asha", storage_path=tune_cluster),
