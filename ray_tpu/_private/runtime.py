@@ -993,7 +993,9 @@ class Runtime:
             from ray_tpu._private.runtime_env import resolve_runtime_env
 
             spec.runtime_env = resolve_runtime_env(
-                spec.runtime_env, lambda uri, data: self.state.kv_put(uri, data)
+                spec.runtime_env,
+                lambda uri, data: self.state.kv_put(uri, data),
+                self.session_name,
             )
         rec = TaskRecord(spec)
         return_ids = spec.return_ids()

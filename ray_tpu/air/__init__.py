@@ -1,6 +1,8 @@
 """ray_tpu.air: shared ML plumbing (ray: python/ray/air/)."""
 
+from ray_tpu.air.batch_predictor import BatchPredictor, Predictor
 from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air import preprocessors
 from ray_tpu.air.config import (
     CheckpointConfig,
     FailureConfig,
@@ -11,11 +13,14 @@ from ray_tpu.air.result import Result
 from ray_tpu.train import session
 
 __all__ = [
+    "BatchPredictor",
     "Checkpoint",
     "CheckpointConfig",
     "FailureConfig",
+    "Predictor",
     "Result",
     "RunConfig",
     "ScalingConfig",
+    "preprocessors",
     "session",
 ]

@@ -154,6 +154,13 @@ class TPUPodNodeProvider(NodeProvider):
                 "TPUPodNodeProvider requires provider_config['head_host'] — "
                 "a driver address the TPU VMs can route to"
             )
+        if _bind_host in ("127.0.0.1", "localhost"):
+            # Fail BEFORE billing a VM whose daemon can never connect.
+            raise ValueError(
+                "driver listener is bound to loopback; start the driver "
+                "with RAY_TPU_BIND_HOST=0.0.0.0 (or a routable interface) "
+                "so remote node daemons can reach it"
+            )
         node_cfg = json.dumps(
             {
                 "node_id": nid,

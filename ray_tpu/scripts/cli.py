@@ -63,9 +63,16 @@ def cmd_job_submit(args) -> int:
 
 
 def cmd_bench(args) -> int:
+    import os
     import subprocess
 
-    return subprocess.call([sys.executable, "bench.py"])
+    import ray_tpu
+
+    bench = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__))),
+        "bench.py",
+    )
+    return subprocess.call([sys.executable, bench])
 
 
 def main(argv=None) -> int:

@@ -80,15 +80,25 @@ def _run_step(wf_dir: str, key: str, fn_blob: bytes, args, kwargs):
     import cloudpickle
 
     fn = cloudpickle.loads(fn_blob)
-    # Upstream step results arrive as refs nested in the arg list (only
-    # top-level args auto-resolve): fetch them worker-side.
-    args = [
-        ray_tpu.get(a) if isinstance(a, ray_tpu.ObjectRef) else a for a in args
-    ]
-    kwargs = {
-        k: ray_tpu.get(v) if isinstance(v, ray_tpu.ObjectRef) else v
-        for k, v in kwargs.items()
-    }
+
+    # Upstream step results arrive as refs nested anywhere in the args
+    # (only top-level task args auto-resolve): fetch them worker-side,
+    # descending containers the same way the DAG substitution does.
+    def resolve(value):
+        if isinstance(value, ray_tpu.ObjectRef):
+            return ray_tpu.get(value)
+        if isinstance(value, list):
+            return [resolve(v) for v in value]
+        if isinstance(value, tuple):
+            return tuple(resolve(v) for v in value)
+        if isinstance(value, set):
+            return {resolve(v) for v in value}
+        if isinstance(value, dict):
+            return {k: resolve(v) for k, v in value.items()}
+        return value
+
+    args = [resolve(a) for a in args]
+    kwargs = {k: resolve(v) for k, v in kwargs.items()}
     out = fn(*args, **kwargs)
     _atomic_write(os.path.join(wf_dir, f"{key}.pkl"), pickle.dumps(out))
     return out
@@ -157,11 +167,21 @@ def _submit_dag(workflow_id: str, dag: DAGNode):
             with open(done_path, "rb") as f:
                 results[id(node)] = ray_tpu.put(pickle.load(f))
             continue
-        args = [results[id(a)] if isinstance(a, DAGNode) else a for a in node._args]
-        kwargs = {
-            k: results[id(v)] if isinstance(v, DAGNode) else v
-            for k, v in node._kwargs.items()
-        }
+        def subst(value):
+            if isinstance(value, DAGNode):
+                return results[id(value)]
+            if isinstance(value, list):
+                return [subst(v) for v in value]
+            if isinstance(value, tuple):
+                return tuple(subst(v) for v in value)
+            if isinstance(value, set):
+                return {subst(v) for v in value}
+            if isinstance(value, dict):
+                return {k: subst(v) for k, v in value.items()}
+            return value
+
+        args = [subst(a) for a in node._args]
+        kwargs = {k: subst(v) for k, v in node._kwargs.items()}
         fn_blob = cloudpickle.dumps(node._fn._fn)
         results[id(node)] = _run_step.options(
             name=f"wf:{workflow_id}:{key}"

@@ -8,8 +8,12 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+import os
+
 import ray_tpu
 from ray_tpu.models.moe import MoEConfig, init_moe_params, moe_ffn
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
 from ray_tpu.parallel import MeshSpec, build_mesh, resolve_rules
 
 
@@ -103,7 +107,7 @@ def test_cli_status_and_timeline(tmp_path, monkeypatch):
         capture_output=True,
         text=True,
         timeout=120,
-        env={**__import__("os").environ, "PYTHONPATH": "/root/repo"},
+        env={**os.environ, "PYTHONPATH": REPO_ROOT},
     )
     assert out.returncode == 0, out.stderr[-500:]
     data = json.loads(out.stdout)
@@ -115,7 +119,7 @@ def test_cli_status_and_timeline(tmp_path, monkeypatch):
         capture_output=True,
         text=True,
         timeout=120,
-        env={**__import__("os").environ, "PYTHONPATH": "/root/repo"},
+        env={**os.environ, "PYTHONPATH": REPO_ROOT},
     )
     assert out2.returncode == 0, out2.stderr[-500:]
     assert json.loads(tl_path.read_text()) == []  # fresh runtime: no tasks
