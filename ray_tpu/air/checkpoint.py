@@ -45,13 +45,41 @@ class Checkpoint:
 
     @classmethod
     def from_jax_state(cls, state, **extra) -> "Checkpoint":
-        """Checkpoint a jax pytree (host-fetched, strategy-agnostic)."""
+        """Checkpoint a jax pytree (host-fetched, strategy-agnostic).
+
+        Gathers every leaf to host memory — simple and fine for small
+        models, but O(model × hosts) DCN traffic + host RAM at scale; use
+        from_jax_state_sharded for the big ones."""
         import jax
 
         host_state = jax.tree_util.tree_map(
             lambda x: _to_host(x), state
         )
         return cls.from_dict({"jax_state": host_state, **extra})
+
+    @classmethod
+    def from_jax_state_sharded(cls, state, directory: str, **extra) -> "Checkpoint":
+        """Scalable save: orbax writes each host's OWN shards straight to
+        `directory` (no cross-host gather, no full copy in host RAM — the
+        fix for gathering a 7B state to every v5p-64 host).  The directory
+        must be shared storage on multi-host; the returned checkpoint is a
+        lightweight directory reference that ships over the control plane
+        as a path, not as tensors."""
+        import jax
+
+        path = os.path.abspath(directory)
+        os.makedirs(path, exist_ok=True)
+        _orbax_save(os.path.join(path, "state"), state)
+        # Metadata pkl: exactly one writer on multi-host (orbax coordinates
+        # the tensor save; this file would otherwise be truncated by
+        # concurrent hosts).  Always written — to_dict()'s pkl branch is
+        # what merges the orbax state back under 'jax_state'.
+        if jax.process_index() == 0:
+            tmp = os.path.join(path, cls._DICT_FILE + f".tmp-{os.getpid()}")
+            with open(tmp, "wb") as f:
+                pickle.dump(dict(extra), f)
+            os.replace(tmp, os.path.join(path, cls._DICT_FILE))
+        return cls.from_directory(path)
 
     # -- accessors --------------------------------------------------------
     def to_dict(self) -> Dict[str, Any]:
@@ -99,12 +127,24 @@ class Checkpoint:
                 shutil.rmtree(path, ignore_errors=True)
 
     def get_jax_state(self, target=None, shardings=None):
-        """Restore the saved pytree; with shardings, device_put each leaf to
-        the requested layout (cross-strategy restore)."""
+        """Restore the saved pytree; with shardings, each leaf lands on the
+        requested layout (cross-strategy restore).
+
+        Directory checkpoints with shardings restore THROUGH orbax's
+        restore_args — each host reads only its shards, never materializing
+        the full state in host RAM (the scalable complement of
+        from_jax_state_sharded)."""
+        state_dir = (
+            os.path.join(self._dir, "state") if self._dir is not None else None
+        )
+        if shardings is not None and state_dir and os.path.isdir(state_dir):
+            state = _orbax_restore_sharded(state_dir, shardings)
+            if state is not None:
+                return state
         d = self.to_dict()
         state = d.get("jax_state")
-        if state is None and self._dir is not None:
-            state = _orbax_restore(os.path.join(self._dir, "state"))
+        if state is None and state_dir:
+            state = _orbax_restore(state_dir)
         if state is None:
             raise ValueError("checkpoint holds no jax state")
         if shardings is not None:
@@ -145,6 +185,25 @@ def _orbax_save(path: str, state) -> None:
         os.makedirs(path, exist_ok=True)
         with open(os.path.join(path, "state.pkl"), "wb") as f:
             pickle.dump(state, f)
+
+
+def _orbax_restore_sharded(path: str, shardings):
+    """Restore each leaf straight onto its target sharding (every host
+    reads only its own shards).  None when orbax/layout can't do it —
+    callers fall back to the host-gather path."""
+    if os.path.exists(os.path.join(path, "state.pkl")):
+        return None  # pickle-fallback save: no sharded restore possible
+    try:
+        import jax
+        import orbax.checkpoint as ocp
+
+        restore_args = jax.tree_util.tree_map(
+            lambda s: ocp.ArrayRestoreArgs(sharding=s), shardings
+        )
+        ckptr = ocp.PyTreeCheckpointer()
+        return ckptr.restore(os.path.abspath(path), restore_args=restore_args)
+    except Exception:
+        return None
 
 
 def _orbax_restore(path: str):
