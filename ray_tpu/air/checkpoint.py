@@ -69,7 +69,18 @@ class Checkpoint:
 
         path = os.path.abspath(directory)
         os.makedirs(path, exist_ok=True)
-        _orbax_save(os.path.join(path, "state"), state)
+        try:
+            import orbax.checkpoint as ocp
+
+            ckptr = ocp.PyTreeCheckpointer()
+            ckptr.save(os.path.join(path, "state"), state, force=True)
+        except Exception:
+            if jax.process_count() > 1:
+                # The pickle fallback cannot save non-addressable arrays and
+                # every host would race on one file: multi-host sharded
+                # saves genuinely require orbax.
+                raise
+            _orbax_save(os.path.join(path, "state"), state)
         # Metadata pkl: exactly one writer on multi-host (orbax coordinates
         # the tensor save; this file would otherwise be truncated by
         # concurrent hosts).  Always written — to_dict()'s pkl branch is
@@ -79,6 +90,12 @@ class Checkpoint:
             with open(tmp, "wb") as f:
                 pickle.dump(dict(extra), f)
             os.replace(tmp, os.path.join(path, cls._DICT_FILE))
+        if jax.process_count() > 1:
+            # Every host must see the complete directory (incl. the pkl just
+            # written by process 0) before anyone reads the checkpoint back.
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("raytpu_sharded_ckpt")
         return cls.from_directory(path)
 
     # -- accessors --------------------------------------------------------
@@ -202,7 +219,13 @@ def _orbax_restore_sharded(path: str, shardings):
         )
         ckptr = ocp.PyTreeCheckpointer()
         return ckptr.restore(os.path.abspath(path), restore_args=restore_args)
-    except Exception:
+    except Exception as e:
+        import warnings
+
+        warnings.warn(
+            f"sharded checkpoint restore failed ({e!r}); falling back to the "
+            f"host-gather path — expect full-state host memory use"
+        )
         return None
 
 

@@ -20,6 +20,7 @@ from ray_tpu.parallel.collectives import (
     reducescatter,
     send,
 )
+from ray_tpu.parallel.pipeline import pipeline_apply
 from ray_tpu.parallel.mesh import (
     AXIS_ORDER,
     MeshSpec,
@@ -54,6 +55,7 @@ __all__ = [
     "init_collective_group",
     "logical_to_spec",
     "mesh_axis_sizes",
+    "pipeline_apply",
     "pick_coordinator_address",
     "recv",
     "reducescatter",

@@ -1,0 +1,119 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over the
+`pipeline` mesh axis.
+
+Absent from the reference entirely (SURVEY §2.4: PP not built in) — built
+TPU-first: each pipeline-axis device holds ONE stage's parameters;
+microbatches stream through the stages with `ppermute` hops over ICI, and
+the whole schedule is a single `lax.scan` inside `shard_map`, so XLA
+overlaps each stage's matmuls with its neighbor transfers and reverse-mode
+AD differentiates straight through the schedule (backward pipeline for
+free — ppermute's transpose is the reverse ring).
+
+Composes with the other axes: the batch dim shards over ("data", "fsdp")
+as usual; stages over "pipeline".
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _pipeline_body(
+    stage_params,
+    x: jax.Array,
+    *,
+    fn: Callable,
+    n_microbatches: int,
+    axis: str,
+):
+    """Per-shard body (inside shard_map).
+
+    stage_params: this stage's params with a leading length-1 stage dim.
+    x: this data-shard's batch [B_local, ...]; only stage 0 consumes it,
+    but every stage holds it (replicated over the pipeline axis).
+    Returns y [B_local, ...] replicated over the pipeline axis.
+    """
+    n_stages = jax.lax.psum(1, axis)
+    stage = jax.lax.axis_index(axis)
+    params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+
+    M = n_microbatches
+    B = x.shape[0]
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible by n_microbatches {M}")
+    micro = x.reshape(M, B // M, *x.shape[1:])
+
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    zero_mb = jnp.zeros_like(micro[0])
+    outs0 = jnp.zeros_like(micro)
+
+    def step(carry, t):
+        recv, outs = carry
+        mb_idx = jnp.clip(t, 0, M - 1)
+        feed = jax.lax.dynamic_index_in_dim(micro, mb_idx, keepdims=False)
+        # Stage 0 injects fresh microbatches while they last; every other
+        # stage consumes what its predecessor sent last tick.
+        inject = jnp.logical_and(stage == 0, t < M)
+        inp = jnp.where(inject, feed, recv)
+        out = fn(params, inp)
+        # Last stage banks finished microbatches (valid for t >= P-1).
+        k = t - (n_stages - 1)
+        bank = jnp.logical_and(stage == n_stages - 1, k >= 0)
+        outs = jax.lax.dynamic_update_index_in_dim(
+            outs,
+            jnp.where(bank, out, jax.lax.dynamic_index_in_dim(outs, jnp.clip(k, 0, M - 1), keepdims=False)),
+            jnp.clip(k, 0, M - 1),
+            0,
+        )
+        recv_next = jax.lax.ppermute(out, axis, perm)
+        return (recv_next, outs), None
+
+    (recv, outs), _ = jax.lax.scan(
+        step, (zero_mb, outs0), jnp.arange(M + n_stages - 1)
+    )
+    # Results live on the last stage; broadcast so every stage returns the
+    # same value (out_specs replicate over the pipeline axis).
+    outs = jax.lax.psum(
+        jnp.where(stage == n_stages - 1, outs, jnp.zeros_like(outs)), axis
+    )
+    return outs.reshape(B, *x.shape[1:])
+
+
+def pipeline_apply(
+    fn: Callable,
+    stacked_params,
+    x: jax.Array,
+    mesh: Mesh,
+    *,
+    n_microbatches: int,
+    axis: str = "pipeline",
+    batch_axes: Sequence[str] = ("data", "fsdp"),
+):
+    """Apply `fn` (one stage's computation: fn(params, x) -> y, same shape)
+    as a pipeline of P stages.
+
+    stacked_params: pytree with a leading stage dim of size P (the pipeline
+    mesh-axis size), e.g. stacked layer weights [P, ...].
+    x: global batch [B, ...]; B shards over batch_axes; the microbatch
+    schedule runs inside each data shard.
+    """
+    from jax import shard_map
+
+    batch_axes = tuple(a for a in batch_axes if a in mesh.axis_names and mesh.shape[a] > 1)
+    param_spec = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    xspec = P(batch_axes if batch_axes else None)
+    body = functools.partial(
+        _pipeline_body, fn=fn, n_microbatches=n_microbatches, axis=axis
+    )
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(param_spec, xspec),
+        out_specs=xspec,
+        check_vma=False,
+    )(stacked_params, x)

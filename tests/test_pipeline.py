@@ -1,0 +1,89 @@
+"""Pipeline parallelism: forward + gradient parity with sequential
+execution, composition with the data axis, HLO collective check.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.parallel import MeshSpec, build_mesh
+from ray_tpu.parallel.pipeline import pipeline_apply
+
+
+def _stage_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _sequential(stacked, x):
+    for i in range(stacked["w"].shape[0]):
+        x = _stage_fn({"w": stacked["w"][i], "b": stacked["b"][i]}, x)
+    return x
+
+
+@pytest.fixture(scope="module")
+def setup():
+    P_, D, B = 4, 8, 16
+    key = jax.random.PRNGKey(0)
+    k1, k2, k3 = jax.random.split(key, 3)
+    stacked = {
+        "w": jax.random.normal(k1, (P_, D, D)) * 0.5,
+        "b": jax.random.normal(k2, (P_, D)) * 0.1,
+    }
+    x = jax.random.normal(k3, (B, D))
+    mesh = build_mesh(MeshSpec(data=2, pipeline=4))
+    return stacked, x, mesh
+
+
+def test_pipeline_forward_matches_sequential(setup):
+    stacked, x, mesh = setup
+    ref = _sequential(stacked, x)
+    out = jax.jit(
+        lambda p, h: pipeline_apply(_stage_fn, p, h, mesh, n_microbatches=4)
+    )(stacked, x)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=1e-5, rtol=1e-5)
+
+
+def test_pipeline_gradients_match_sequential(setup):
+    """Reverse-mode AD through the schedule = the backward pipeline."""
+    stacked, x, mesh = setup
+
+    def loss_pipe(p, h):
+        return jnp.sum(pipeline_apply(_stage_fn, p, h, mesh, n_microbatches=4) ** 2)
+
+    def loss_seq(p, h):
+        return jnp.sum(_sequential(p, h) ** 2)
+
+    g_pipe = jax.jit(jax.grad(loss_pipe))(stacked, x)
+    g_seq = jax.grad(loss_seq)(stacked, x)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4
+        ),
+        g_pipe,
+        g_seq,
+    )
+
+
+def test_pipeline_microbatch_counts(setup):
+    stacked, x, mesh = setup
+    ref = _sequential(stacked, x)
+    # per-data-shard batch is 16/2 = 8: microbatch counts must divide THAT
+    for m in (1, 2, 4, 8):
+        out = pipeline_apply(_stage_fn, stacked, x, mesh, n_microbatches=m)
+        np.testing.assert_allclose(
+            np.asarray(ref), np.asarray(out), atol=1e-5, rtol=1e-5
+        )
+    with pytest.raises(ValueError, match="not divisible"):
+        pipeline_apply(_stage_fn, stacked, x, mesh, n_microbatches=3)
+
+
+def test_pipeline_compiles_to_collective_permute(setup):
+    stacked, x, mesh = setup
+    hlo = (
+        jax.jit(lambda p, h: pipeline_apply(_stage_fn, p, h, mesh, n_microbatches=4))
+        .lower(stacked, x)
+        .compile()
+        .as_text()
+    )
+    assert "collective-permute" in hlo, "stage hops should ride ppermute"
