@@ -69,18 +69,17 @@ class Checkpoint:
 
         path = os.path.abspath(directory)
         os.makedirs(path, exist_ok=True)
-        try:
+        if jax.process_count() > 1:
+            # Multi-host genuinely requires orbax (the pickle fallback can't
+            # save non-addressable arrays and hosts would race on one file):
+            # let any orbax failure propagate.
             import orbax.checkpoint as ocp
 
-            ckptr = ocp.PyTreeCheckpointer()
-            ckptr.save(os.path.join(path, "state"), state, force=True)
-        except Exception:
-            if jax.process_count() > 1:
-                # The pickle fallback cannot save non-addressable arrays and
-                # every host would race on one file: multi-host sharded
-                # saves genuinely require orbax.
-                raise
-            _orbax_save(os.path.join(path, "state"), state)
+            ocp.PyTreeCheckpointer().save(
+                os.path.join(path, "state"), state, force=True
+            )
+        else:
+            _orbax_save(os.path.join(path, "state"), state)  # pickle fallback
         # Metadata pkl: exactly one writer on multi-host (orbax coordinates
         # the tensor save; this file would otherwise be truncated by
         # concurrent hosts).  Always written — to_dict()'s pkl branch is

@@ -22,11 +22,14 @@ from ray_tpu.rllib.env import (
 )
 from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.policy import JaxPolicy, apply_policy, init_policy_params
+from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae
 
 __all__ = [
     "CartPoleVectorEnv",
+    "DQN",
+    "DQNConfig",
     "EnvRunner",
     "JaxPolicy",
     "PPO",

@@ -1,0 +1,330 @@
+"""DQN: replay-buffer off-policy learning (double DQN + target network).
+
+ray: rllib/algorithms/dqn/ — the second algorithm on the Algorithm surface,
+showing the stack generalizes beyond on-policy PPO.  TPU-first: the whole
+sampled-minibatch update (gather, double-DQN targets, huber loss, adam) is
+one jitted function; rollout actors run epsilon-greedy over vectorized
+envs with a single jitted argmax per step.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import make_vector_env
+
+
+class ReplayBuffer:
+    """Uniform ring buffer of transitions (ray: utils/replay_buffers)."""
+
+    def __init__(self, capacity: int, obs_size: int):
+        self.capacity = capacity
+        self.obs = np.zeros((capacity, obs_size), np.float32)
+        self.next_obs = np.zeros((capacity, obs_size), np.float32)
+        self.actions = np.zeros(capacity, np.int64)
+        self.rewards = np.zeros(capacity, np.float32)
+        self.dones = np.zeros(capacity, np.float32)
+        self.idx = 0
+        self.size = 0
+
+    def add_batch(self, obs, actions, rewards, next_obs, dones):
+        n = len(actions)
+        idxs = (self.idx + np.arange(n)) % self.capacity
+        self.obs[idxs] = obs
+        self.actions[idxs] = actions
+        self.rewards[idxs] = rewards
+        self.next_obs[idxs] = next_obs
+        self.dones[idxs] = dones
+        self.idx = int((self.idx + n) % self.capacity)
+        self.size = int(min(self.size + n, self.capacity))
+
+    def sample(self, batch_size: int, rng: np.random.Generator):
+        idxs = rng.integers(0, self.size, size=batch_size)
+        return (
+            self.obs[idxs],
+            self.actions[idxs],
+            self.rewards[idxs],
+            self.next_obs[idxs],
+            self.dones[idxs],
+        )
+
+
+class _DQNRunner:
+    """Rollout actor: epsilon-greedy transitions over a vectorized env."""
+
+    def __init__(self, env, num_envs: int, seed: int):
+        self.env = make_vector_env(env, num_envs, seed=seed)
+        self.rng = np.random.default_rng(seed)
+        self._apply = None
+        self._params = None
+        self._obs = self.env.reset(seed=seed)
+
+    def _q_values(self, obs):
+        import jax
+        import jax.numpy as jnp
+
+        if self._apply is None:
+            from ray_tpu.rllib.policy import apply_policy
+
+            self._apply = jax.jit(lambda p, o: apply_policy(p, o)[0])
+        return np.asarray(self._apply(self._params, jnp.asarray(obs)))
+
+    def collect(self, weights, n_steps: int, epsilon: float) -> Dict[str, Any]:
+        import jax.numpy as jnp
+        import jax
+
+        self._params = jax.tree_util.tree_map(jnp.asarray, weights)
+        N = self.env.num_envs
+        obs_l, act_l, rew_l, next_l, done_l = [], [], [], [], []
+        obs = self._obs
+        for _ in range(n_steps):
+            q = self._q_values(obs)
+            greedy = q.argmax(axis=1)
+            explore = self.rng.random(N) < epsilon
+            actions = np.where(explore, self.rng.integers(0, q.shape[1], N), greedy)
+            final_obs, rewards, terminated, truncated = self.env.step(actions)
+            obs_l.append(obs)
+            act_l.append(actions)
+            rew_l.append(rewards)
+            next_l.append(final_obs)
+            done_l.append(terminated.astype(np.float32))  # truncation bootstraps
+            obs = self.env.current_obs()
+        self._obs = obs
+        return {
+            "obs": np.concatenate(obs_l),
+            "actions": np.concatenate(act_l),
+            "rewards": np.concatenate(rew_l),
+            "next_obs": np.concatenate(next_l),
+            "dones": np.concatenate(done_l),
+            "episode_returns": self.env.drain_episode_returns(),
+            "steps": n_steps * N,
+        }
+
+    def ping(self):
+        return "pong"
+
+
+class DQNConfig:
+    def __init__(self):
+        self.env: Optional[str | Callable] = None
+        self.num_env_runners = 1
+        self.num_envs_per_runner = 8
+        self.rollout_length = 32
+        self.gamma = 0.99
+        self.lr = 1e-3
+        self.buffer_capacity = 50_000
+        self.learn_batch_size = 128
+        self.updates_per_iteration = 32
+        self.target_sync_every = 4  # iterations
+        self.epsilon_start = 1.0
+        self.epsilon_end = 0.05
+        self.epsilon_decay_iters = 30
+        self.hidden = (64, 64)
+        self.seed = 0
+
+    def environment(self, env) -> "DQNConfig":
+        self.env = env
+        return self
+
+    def env_runners(self, num_env_runners=1, num_envs_per_runner=8, rollout_length=32):
+        self.num_env_runners = num_env_runners
+        self.num_envs_per_runner = num_envs_per_runner
+        self.rollout_length = rollout_length
+        return self
+
+    def training(self, **kw) -> "DQNConfig":
+        valid = {
+            "gamma", "lr", "buffer_capacity", "learn_batch_size",
+            "updates_per_iteration", "target_sync_every", "epsilon_start",
+            "epsilon_end", "epsilon_decay_iters", "hidden",
+        }
+        for k, v in kw.items():
+            if k not in valid:
+                raise TypeError(f"unknown DQN training option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def debugging(self, seed: int = 0) -> "DQNConfig":
+        self.seed = seed
+        return self
+
+    def build(self) -> "DQN":
+        if self.env is None:
+            raise ValueError("call .environment(env) first")
+        return DQN(self)
+
+
+def _make_learner(cfg: DQNConfig, obs_size: int, num_actions: int):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.rllib.policy import apply_policy, init_policy_params
+
+    opt = optax.adam(cfg.lr)
+
+    def init_state(seed: int):
+        params = init_policy_params(
+            jax.random.PRNGKey(seed), obs_size, num_actions, cfg.hidden
+        )
+        return {
+            "params": params,
+            "target": jax.tree_util.tree_map(jnp.copy, params),
+            "opt_state": opt.init(params),
+        }
+
+    def q_of(params, obs):
+        return apply_policy(params, obs)[0]  # logits head doubles as Q head
+
+    def update_many(state, batches):
+        """All of an iteration's updates as ONE scanned program (same
+        pattern as the PPO learner): batches are stacked [U, B, ...]."""
+
+        def one(carry, batch):
+            params, opt_state = carry
+            obs, actions, rewards, next_obs, dones = batch
+
+            def loss_fn(p):
+                q = q_of(p, obs)
+                q_sa = jnp.take_along_axis(q, actions[:, None], axis=1)[:, 0]
+                # double DQN: online net argmax, target net evaluation
+                next_a = q_of(p, next_obs).argmax(axis=1)
+                next_q = jnp.take_along_axis(
+                    q_of(state["target"], next_obs), next_a[:, None], axis=1
+                )[:, 0]
+                target = rewards + cfg.gamma * (1.0 - dones) * next_q
+                return optax.huber_loss(q_sa, jax.lax.stop_gradient(target)).mean()
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state), loss
+
+        (params, opt_state), losses = jax.lax.scan(
+            one, (state["params"], state["opt_state"]), batches
+        )
+        return {**state, "params": params, "opt_state": opt_state}, losses.mean()
+
+    def sync_target(state):
+        import jax
+
+        return {**state, "target": jax.tree_util.tree_map(jnp.copy, state["params"])}
+
+    return init_state, jax.jit(update_many), sync_target
+
+
+class DQN:
+    """ray: Algorithm surface — train()/save/restore/stop."""
+
+    def __init__(self, config: DQNConfig):
+        self.config = config
+        ray_tpu.init(ignore_reinit_error=True)
+        probe = make_vector_env(config.env, 1, seed=0)
+        self._obs_size = probe.observation_size
+        self._num_actions = probe.num_actions
+        init_state, self._update, self._sync = _make_learner(
+            config, self._obs_size, self._num_actions
+        )
+        self._state = init_state(config.seed)
+        self.buffer = ReplayBuffer(config.buffer_capacity, self._obs_size)
+        self._rng = np.random.default_rng(config.seed)
+        Runner = ray_tpu.remote(_DQNRunner)
+        self.runners = [
+            Runner.remote(
+                config.env,
+                config.num_envs_per_runner,
+                config.seed + 997 * (i + 1),
+            )
+            for i in range(config.num_env_runners)
+        ]
+        ray_tpu.get([r.ping.remote() for r in self.runners], timeout=120)
+        self.iteration = 0
+        self._total_steps = 0
+        self._episode_returns: List[float] = []
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree_util.tree_map(np.asarray, self._state["params"])
+
+    def _epsilon(self) -> float:
+        c = self.config
+        frac = min(self.iteration / max(c.epsilon_decay_iters, 1), 1.0)
+        return c.epsilon_start + frac * (c.epsilon_end - c.epsilon_start)
+
+    def train(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        c = self.config
+        t0 = time.time()
+        eps = self._epsilon()
+        w_ref = ray_tpu.put(self.get_weights())
+        outs = ray_tpu.get(
+            [r.collect.remote(w_ref, c.rollout_length, eps) for r in self.runners],
+            timeout=300,
+        )
+        for o in outs:
+            self.buffer.add_batch(
+                o["obs"], o["actions"], o["rewards"], o["next_obs"], o["dones"]
+            )
+            self._episode_returns.extend(o["episode_returns"])
+            self._total_steps += o["steps"]
+        self._episode_returns = self._episode_returns[-100:]
+
+        loss = 0.0
+        if self.buffer.size >= c.learn_batch_size:
+            # One stacked [U, B, ...] transfer + one scanned dispatch for
+            # the whole iteration's updates.
+            stacked = [
+                self.buffer.sample(c.learn_batch_size, self._rng)
+                for _ in range(c.updates_per_iteration)
+            ]
+            batches = tuple(
+                jnp.asarray(np.stack([s[i] for s in stacked])) for i in range(5)
+            )
+            self._state, loss = self._update(self._state, batches)
+        self.iteration += 1
+        if self.iteration % c.target_sync_every == 0:
+            self._state = self._sync(self._state)
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": (
+                float(np.mean(self._episode_returns)) if self._episode_returns else 0.0
+            ),
+            "epsilon": eps,
+            "loss": float(loss),
+            "num_env_steps_sampled": self._total_steps,
+            "buffer_size": self.buffer.size,
+            "time_this_iter_s": time.time() - t0,
+        }
+
+    def save(self, path: Optional[str] = None) -> str:
+        import jax
+
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        host = jax.tree_util.tree_map(np.asarray, self._state)
+        return Checkpoint.from_dict(
+            {"learner_state": host, "iteration": self.iteration}
+        ).to_directory(path)
+
+    def restore(self, path: str) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        d = Checkpoint.from_directory(path).to_dict()
+        self._state = jax.tree_util.tree_map(jnp.asarray, d["learner_state"])
+        self.iteration = d["iteration"]
+
+    def stop(self) -> None:
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        self.runners = []

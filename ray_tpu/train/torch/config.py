@@ -1,0 +1,85 @@
+"""Torch backend: process-group setup across the worker group.
+
+ray: python/ray/train/torch/config.py (_TorchBackend.on_start :145,
+_setup_torch_process_group :69, dist.init_process_group :113).  Rank 0
+picks a free TCP port; every worker joins the gloo group (CPU containers;
+NCCL has no place on a TPU host).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ray_tpu.train.backend import Backend, BackendConfig
+
+
+def _pick_free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _setup_process_group(
+    master_addr: str, master_port: int, rank: int, world_size: int, backend: str,
+    timeout_s: float,
+):
+    import datetime
+    import os
+
+    import torch.distributed as dist
+
+    os.environ["MASTER_ADDR"] = master_addr
+    os.environ["MASTER_PORT"] = str(master_port)
+    dist.init_process_group(
+        backend=backend,
+        rank=rank,
+        world_size=world_size,
+        timeout=datetime.timedelta(seconds=timeout_s),
+    )
+
+
+def _teardown_process_group():
+    import torch.distributed as dist
+
+    if dist.is_initialized():
+        dist.destroy_process_group()
+
+
+class _TorchBackend(Backend):
+    def on_start(self, worker_group, backend_config: "TorchConfig"):
+        import ray_tpu
+
+        master_addr = "127.0.0.1"
+        master_port = worker_group.execute_single(0, _pick_free_port)
+        # join everyone concurrently: init_process_group blocks until all
+        # ranks arrive, so this must NOT be a serial execute()
+        refs = [
+            w.run_fn.remote(
+                _setup_process_group,
+                master_addr,
+                master_port,
+                i,
+                worker_group.num_workers,
+                backend_config.backend,
+                backend_config.timeout_s,
+            )
+            for i, w in enumerate(worker_group.workers)
+        ]
+        ray_tpu.get(refs, timeout=backend_config.timeout_s + 30)
+
+    def on_shutdown(self, worker_group, backend_config: "TorchConfig"):
+        worker_group.execute(_teardown_process_group, timeout=30)
+
+
+@dataclasses.dataclass
+class TorchConfig(BackendConfig):
+    """ray: train/torch/config.py TorchConfig."""
+
+    backend: str = "gloo"
+    timeout_s: float = 120.0
+
+    def backend_cls(self):
+        return _TorchBackend

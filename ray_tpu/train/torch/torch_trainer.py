@@ -1,0 +1,39 @@
+"""TorchTrainer: DataParallelTrainer with the torch/gloo backend.
+
+ray: python/ray/train/torch/torch_trainer.py — same construction surface;
+the train_loop_per_worker runs with torch.distributed initialized across
+the worker group and uses prepare_model/prepare_data_loader + session
+reporting exactly like the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import RunConfig, ScalingConfig
+from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+from ray_tpu.train.torch.config import TorchConfig
+
+
+class TorchTrainer(DataParallelTrainer):
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        torch_config: Optional[TorchConfig] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+    ):
+        super().__init__(
+            train_loop_per_worker,
+            train_loop_config=train_loop_config,
+            backend_config=torch_config or TorchConfig(),
+            scaling_config=scaling_config,
+            run_config=run_config,
+            resume_from_checkpoint=resume_from_checkpoint,
+            datasets=datasets,
+        )

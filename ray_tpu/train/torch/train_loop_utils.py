@@ -1,0 +1,40 @@
+"""prepare_model / prepare_data_loader for torch train loops.
+
+ray: python/ray/train/torch/train_loop_utils.py:92-98 (DDP/FSDP wrap) —
+reduced to the CPU/gloo case this backend targets: DDP wrap + a
+DistributedSampler-equipped loader.
+"""
+
+from __future__ import annotations
+
+
+def prepare_model(model, parallel_strategy: str = "ddp"):
+    """Wrap an nn.Module for distributed training
+    (ray: prepare_model :92-98)."""
+    import torch.distributed as dist
+    from torch.nn.parallel import DistributedDataParallel as DDP
+
+    if parallel_strategy not in ("ddp", None):
+        raise ValueError(
+            f"parallel_strategy {parallel_strategy!r} unsupported here: this "
+            "backend is the CPU/gloo parity path (TPU training is JaxTrainer)"
+        )
+    if dist.is_initialized() and dist.get_world_size() > 1 and parallel_strategy:
+        return DDP(model)
+    return model
+
+
+def prepare_data_loader(dataset, batch_size: int, shuffle: bool = True):
+    """DataLoader with a per-rank DistributedSampler
+    (ray: prepare_data_loader)."""
+    import torch.distributed as dist
+    from torch.utils.data import DataLoader
+    from torch.utils.data.distributed import DistributedSampler
+
+    sampler = None
+    if dist.is_initialized() and dist.get_world_size() > 1:
+        sampler = DistributedSampler(dataset, shuffle=shuffle)
+        shuffle = False
+    return DataLoader(
+        dataset, batch_size=batch_size, shuffle=shuffle, sampler=sampler
+    )
