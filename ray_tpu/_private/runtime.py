@@ -1024,7 +1024,7 @@ class Runtime:
             name=spec.actor_name,
             max_restarts=spec.max_restarts,
             creation_spec=spec,
-            namespace=self.namespace,
+            namespace=spec.actor_namespace or self.namespace,
         )
         self.state.register_actor(info)
         with self.lock:

@@ -33,6 +33,7 @@ class TaskSpec:
     method_name: Optional[str] = None
     is_actor_creation: bool = False
     actor_name: Optional[str] = None
+    actor_namespace: Optional[str] = None
     actor_method_names: Optional[List[str]] = None
     max_concurrency: int = 1
     max_restarts: int = 0

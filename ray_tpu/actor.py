@@ -156,6 +156,7 @@ class ActorClass:
             actor_id=ids.actor_id(),
             is_actor_creation=True,
             actor_name=name,
+            actor_namespace=o.get("namespace"),
             actor_method_names=_public_methods(self._cls),
             max_restarts=int(o.get("max_restarts", 0)),
             max_concurrency=1,  # creation itself is ordered

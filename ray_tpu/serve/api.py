@@ -70,12 +70,41 @@ def _ensure_started():
 
 class Application:
     """A deployment bound to its init args (ray: serve 2.x Application —
-    the object `serve.run` accepts)."""
+    the object `serve.run` accepts).
+
+    Init args may contain OTHER Applications (deployment graphs,
+    ray: serve/deployment_graph_build.py): `serve.run` deploys children
+    first and the parent receives their DeploymentHandles — the ingress
+    fans out to downstream deployments over plain handle calls."""
 
     def __init__(self, deployment: "Deployment", args: tuple, kwargs: dict):
         self.deployment = deployment
         self.init_args = args
         self.init_kwargs = kwargs
+
+    def _resolve(self, _deployed: Optional[Dict[int, "DeploymentHandle"]] = None) -> "DeploymentHandle":
+        """Deploy this node's children (depth-first, each once — the memo
+        threads through the WHOLE graph so a diamond-shared child deploys
+        a single time), then this deployment with child handles substituted
+        into its init args."""
+        deployed = _deployed if _deployed is not None else {}
+
+        def subst(value):
+            if isinstance(value, Application):
+                if id(value) not in deployed:
+                    deployed[id(value)] = value._resolve(deployed)
+                return deployed[id(value)]
+            if isinstance(value, list):
+                return [subst(v) for v in value]
+            if isinstance(value, tuple):
+                return tuple(subst(v) for v in value)
+            if isinstance(value, dict):
+                return {k: subst(v) for k, v in value.items()}
+            return value
+
+        args = tuple(subst(a) for a in self.init_args)
+        kwargs = {k: subst(v) for k, v in self.init_kwargs.items()}
+        return self.deployment.deploy(*args, **kwargs)
 
 
 class Deployment:
@@ -152,10 +181,11 @@ def deployment(
 
 
 def run(app: Union[Application, Deployment], **kwargs) -> DeploymentHandle:
-    """Deploy an application and return its handle (ray: serve.run :458)."""
+    """Deploy an application — including any deployment GRAPH bound into
+    its init args — and return the ingress handle (ray: serve.run :458)."""
     if isinstance(app, Deployment):
         app = app.bind()
-    return app.deployment.deploy(*app.init_args, **app.init_kwargs)
+    return app._resolve()
 
 
 def get_deployment_handle(name: str) -> DeploymentHandle:

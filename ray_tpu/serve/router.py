@@ -139,6 +139,32 @@ class DeploymentHandle:
             raise AttributeError(name)
         return DeploymentHandle(self._name, self._router, name)
 
+    def __reduce__(self):
+        # Handles ship into OTHER processes (deployment-graph ingress
+        # replicas hold child handles): rebuild there with a fresh Router
+        # bound to the named controller — the local Router holds locks and
+        # a live controller handle wrapper that don't pickle.
+        return (_rebuild_handle, (self._name, self._method))
+
     def __repr__(self):
         m = f".{self._method}" if self._method else ""
         return f"DeploymentHandle({self._name}{m})"
+
+
+_process_router: Optional[Router] = None
+_process_router_lock = threading.Lock()
+
+
+def _rebuild_handle(name: str, method: Optional[str]) -> "DeploymentHandle":
+    """ONE Router per process, shared by every unpickled handle: per-handle
+    routers would each get their own in-flight accounting (N handles could
+    push N x max_concurrent to one replica) and each poll the controller."""
+    global _process_router
+    with _process_router_lock:
+        if _process_router is None:
+            import ray_tpu
+            from ray_tpu.serve.config import SERVE_CONTROLLER_NAME, SERVE_NAMESPACE
+
+            controller = ray_tpu.get_actor(SERVE_CONTROLLER_NAME, SERVE_NAMESPACE)
+            _process_router = Router(controller)
+    return DeploymentHandle(name, _process_router, method)

@@ -286,3 +286,37 @@ def test_batched_jax_inference(serve_instance):
     a = ray_tpu.get(h.remote([5, 6, 7]), timeout=60)
     b = ray_tpu.get(h.remote([5, 6, 7]), timeout=60)
     assert a == b
+
+
+def test_deployment_graph_composition(serve_instance):
+    """serve.run over a deployment GRAPH: children deploy first, the
+    ingress receives their handles and fans out per request
+    (ray: serve deployment graphs / deployment_graph_build.py)."""
+
+    @serve.deployment(name="doubler")
+    def doubler(x):
+        return x * 2
+
+    @serve.deployment(name="inc")
+    def inc(x):
+        return x + 1
+
+    @serve.deployment(name="ingress")
+    class Ingress:
+        def __init__(self, double_handle, inc_handle):
+            self.double = double_handle
+            self.inc = inc_handle
+
+        def __call__(self, x):
+            a = ray_tpu.get(self.double.remote(x), timeout=30)
+            b = ray_tpu.get(self.inc.remote(x), timeout=30)
+            return {"double": a, "inc": b, "sum": a + b}
+
+    h = serve.run(Ingress.bind(doubler.bind(), inc.bind()))
+    out = ray_tpu.get(h.remote(10), timeout=60)
+    assert out == {"double": 20, "inc": 11, "sum": 31}
+    # children are real deployments too
+    st = serve.status()
+    assert {"doubler", "inc", "ingress"} <= set(st)
+    direct = serve.get_deployment_handle("doubler")
+    assert ray_tpu.get(direct.remote(5), timeout=30) == 10
