@@ -51,12 +51,18 @@ def init(
     resources: Optional[Dict[str, float]] = None,
     namespace: str = "default",
     ignore_reinit_error: bool = False,
+    _system_config: Optional[Dict[str, Any]] = None,
     **_unused,
 ):
     """Start the per-host runtime (driver mode).
 
     Inside a worker process this is a no-op (the worker is already connected),
     matching the reference's behavior for nested init.
+
+    _system_config: programmatic overrides of the runtime knob table
+    (ray: ray.init(_system_config=...); see _private/config.py for the
+    knobs — env form is RAY_TPU_<NAME>).  Applied driver-side; workers read
+    the env forms they inherit.
     """
     from ray_tpu._private import runtime as rt
     from ray_tpu._private.worker_proc import get_worker_runtime
@@ -67,6 +73,10 @@ def init(
         if ignore_reinit_error:
             return
         raise RuntimeError("ray_tpu.init() called twice (pass ignore_reinit_error=True)")
+    if _system_config:
+        from ray_tpu._private import config as _cfg
+
+        _cfg.set_system_config(_system_config)
     rt.init_runtime(num_cpus=num_cpus, resources=resources, namespace=namespace)
 
 

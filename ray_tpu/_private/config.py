@@ -1,0 +1,140 @@
+"""Runtime config knobs: one table, env-var overridable.
+
+ray: src/ray/common/ray_config_def.h (the RAY_CONFIG X-macro table — every
+runtime knob declared once, overridable via RAY_<name> env vars) +
+python/ray/_private/ray_constants.py.  Same shape here: each knob is a row
+with a default and docstring; `RAY_TPU_<NAME>` env vars override at first
+access; `_system_config` overrides at init beat both.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict
+
+_DEFS: Dict[str, tuple] = {
+    # name: (default, type, doc)
+    "scheduler_spread_threshold": (
+        0.5, float,
+        "hybrid policy: head-node utilization above which tasks spill to "
+        "the least-utilized remote node (ray: RAY_scheduler_spread_threshold)",
+    ),
+    "max_direct_call_object_size": (
+        100 * 1024, int,
+        "results >= this many bytes go to the shm store; smaller inline "
+        "over the control conn (ray: max_direct_call_object_size)",
+    ),
+    "object_store_memory": (
+        0, int,
+        "shm store capacity in bytes; 0 = 30% of the shm filesystem's free "
+        "space at init (ray: object_store_memory)",
+    ),
+    "lineage_max_entries": (
+        10000, int,
+        "max producer TaskSpecs retained for object reconstruction",
+    ),
+    "lineage_max_bytes": (
+        64 * 1024 * 1024, int,
+        "max bytes of retained args blobs in the lineage table "
+        "(ray: max_lineage_bytes spirit, task_manager.h:97)",
+    ),
+    "task_events_max": (
+        2000, int,
+        "ring-buffer size of the finished-task event sink "
+        "(ray: task_events_max_num_task_in_gcs)",
+    ),
+    "worker_prestart_count": (
+        8, int,
+        "warm worker-pool size prestarted at init (capped by node CPUs; "
+        "ray: worker pool prestart)",
+    ),
+    "worker_handshake_timeout_s": (
+        60.0, float,
+        "a spawned worker that hasn't connected within this window dies "
+        "via its own watchdog",
+    ),
+    "native_store": (
+        1, int,
+        "1 = use the C++ shm arena when it builds; 0 = file-per-object",
+    ),
+    "bind_host": (
+        "127.0.0.1", str,
+        "driver listener bind address; 0.0.0.0 exposes it to node daemons "
+        "on other machines",
+    ),
+}
+
+# Back-compat env names from before the knob table existed.
+_ENV_ALIASES: Dict[str, tuple] = {
+    "lineage_max_entries": ("RAY_TPU_LINEAGE_MAX",),
+    "lineage_max_bytes": ("RAY_TPU_LINEAGE_MAX_BYTES",),
+}
+
+_lock = threading.Lock()
+_values: Dict[str, Any] = {}
+_frozen_overrides: Dict[str, Any] = {}
+
+
+def set_system_config(overrides: Dict[str, Any]) -> None:
+    """Programmatic overrides (ray: ray.init(_system_config=...)); applied
+    before first access wins over env vars."""
+    unknown = set(overrides) - set(_DEFS)
+    if unknown:
+        raise ValueError(f"unknown config keys {sorted(unknown)}; valid: {sorted(_DEFS)}")
+    coerced = {}
+    for k, v in overrides.items():
+        typ = _DEFS[k][1]
+        try:
+            coerced[k] = typ(v)
+        except (TypeError, ValueError) as e:
+            # fail HERE at the init() call site, not later inside Runtime
+            raise ValueError(f"config {k!r} expects {typ.__name__}, got {v!r}") from e
+    with _lock:
+        _frozen_overrides.update(coerced)
+        for k in coerced:
+            _values.pop(k, None)  # recompute on next access
+
+
+def get(name: str):
+    """Resolve a knob: _system_config > RAY_TPU_<NAME> env > default."""
+    try:
+        default, typ, _doc = _DEFS[name]
+    except KeyError:
+        raise KeyError(f"unknown config {name!r}; valid: {sorted(_DEFS)}")
+    with _lock:
+        if name in _values:
+            return _values[name]
+        if name in _frozen_overrides:
+            val = _frozen_overrides[name]
+        else:
+            env = os.environ.get(f"RAY_TPU_{name.upper()}")
+            if env is None:
+                for alias in _ENV_ALIASES.get(name, ()):
+                    env = os.environ.get(alias)
+                    if env is not None:
+                        break
+            if env is not None:
+                try:
+                    val = typ(env)
+                except ValueError:
+                    val = default
+            else:
+                val = default
+        _values[name] = val
+        return val
+
+
+def describe() -> Dict[str, Dict[str, Any]]:
+    """Every knob with default, current value, and doc (ray: the config
+    dump the dashboard shows)."""
+    return {
+        name: {"default": d, "value": get(name), "doc": doc}
+        for name, (d, _t, doc) in _DEFS.items()
+    }
+
+
+def _reset_for_tests() -> None:
+    with _lock:
+        _values.clear()
+        _frozen_overrides.clear()

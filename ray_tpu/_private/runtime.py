@@ -269,12 +269,12 @@ class Runtime:
         from collections import OrderedDict
 
         self.lineage: "OrderedDict[str, Any]" = OrderedDict()
-        self.lineage_max = int(os.environ.get("RAY_TPU_LINEAGE_MAX", "10000"))
+        from ray_tpu._private import config as _config
+
+        self.lineage_max = _config.get("lineage_max_entries")
         # Footprint bound (bytes of retained args_blob) in addition to the
         # entry-count cap — ray: task_manager.h:97-104 lineage accounting.
-        self.lineage_max_bytes = int(
-            os.environ.get("RAY_TPU_LINEAGE_MAX_BYTES", str(64 * 1024 * 1024))
-        )
+        self.lineage_max_bytes = _config.get("lineage_max_bytes")
         self.lineage_bytes = 0
         # With an autoscaler attached, infeasible tasks PARK (the fleet may
         # grow to fit them — ray's default behavior); without one they error
@@ -282,7 +282,7 @@ class Runtime:
         self.allow_pending_infeasible = False
         # Task-event sink (ray: gcs_task_manager.h:61 ring-buffer storage):
         # bounded history of finished tasks powering the state API + metrics.
-        self.task_events: deque = deque(maxlen=int(os.environ.get("RAY_TPU_TASK_EVENTS_MAX", "2000")))
+        self.task_events: deque = deque(maxlen=_config.get("task_events_max"))
         self.metrics: Dict[str, float] = {
             "tasks_submitted": 0,
             "tasks_finished": 0,
@@ -303,7 +303,7 @@ class Runtime:
         # worker then blocks forever in its auth recv).
         # Loopback by default; RAY_TPU_BIND_HOST=0.0.0.0 exposes the driver
         # to daemons on OTHER machines (required for cloud node providers).
-        bind_host = os.environ.get("RAY_TPU_BIND_HOST", "127.0.0.1")
+        bind_host = _config.get("bind_host")
         self.listener = Listener((bind_host, 0), backlog=128, authkey=self._authkey)
         self.address = self.listener.address
         self._shutdown = False
@@ -328,7 +328,12 @@ class Runtime:
         # prestarts workers per language): exec'ed workers pay a fresh
         # interpreter start, so overlap that cost with driver setup.
         with self.lock:
-            for _ in range(min(int(self.state.nodes[self.head_node_id].resources.get("CPU", 0)), 8)):
+            for _ in range(
+                min(
+                    int(self.state.nodes[self.head_node_id].resources.get("CPU", 0)),
+                    _config.get("worker_prestart_count"),
+                )
+            ):
                 self._spawn_worker(self.head_node_id, None, None, prestart=True)
 
     # ------------------------------------------------------------------

@@ -23,9 +23,6 @@ from typing import Dict, List, Optional, Tuple
 from ray_tpu._private.gcs import GlobalState, NodeInfo, PlacementGroupInfo
 from ray_tpu._private.task_spec import TaskSpec
 
-HYBRID_THRESHOLD = 0.5  # ray: RAY_scheduler_spread_threshold default
-
-
 def _feasible(node: NodeInfo, resources: Dict[str, float]) -> bool:
     return all(node.resources.get(k, 0.0) >= v for k, v in resources.items())
 
@@ -45,10 +42,15 @@ def _utilization(node: NodeInfo) -> float:
 
 class Scheduler:
     def __init__(self, state: GlobalState, head_node_id: str):
+        from ray_tpu._private import config
+
         self.state = state
         self.head_node_id = head_node_id
         self._rr = itertools.count()
         self.lock = threading.RLock()
+        # resolved once: the knob is fixed by the time the runtime builds
+        # its scheduler, and select_node is the dispatch hot path
+        self._spread_threshold = config.get("scheduler_spread_threshold")
 
     # -- resource accounting -------------------------------------------------
 
@@ -118,7 +120,7 @@ class Scheduler:
             # Prefer head node while below threshold, like ray's hybrid policy
             # prefers the local node (hybrid_scheduling_policy.h:50).
             head = next((n for n in nodes if n.node_id == self.head_node_id), None)
-            if head and _available(head, resources) and _utilization(head) < HYBRID_THRESHOLD:
+            if head and _available(head, resources) and _utilization(head) < self._spread_threshold:
                 return head.node_id
             avail = [n for n in nodes if _available(n, resources)]
             if not avail:

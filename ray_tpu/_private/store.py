@@ -25,7 +25,14 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import serialization as ser
 
-INLINE_THRESHOLD = 100 * 1024  # same knob as ray: max_direct_call_object_size
+from ray_tpu._private import config as _config
+
+# same knob as ray: max_direct_call_object_size
+# (env RAY_TPU_MAX_DIRECT_CALL_OBJECT_SIZE / _system_config) — a FUNCTION,
+# not an import-time constant: init()'s imports run before
+# set_system_config, so a frozen module constant would ignore overrides.
+def inline_threshold() -> int:
+    return _config.get("max_direct_call_object_size")
 
 
 def _default_shm_root() -> str:
@@ -96,7 +103,7 @@ class ShmStore:
         os.makedirs(self.dir, exist_ok=True)
         self.arena = None
         arena_path = os.path.join(self.dir, "arena")
-        if os.environ.get("RAY_TPU_NATIVE_STORE", "1") != "0":
+        if _config.get("native_store"):
             try:
                 from ray_tpu._native.arena import Arena
 
@@ -205,9 +212,8 @@ class OwnerStore:
         capacity_bytes: Optional[int] = None,
     ):
         if capacity_bytes is None:
-            env = os.environ.get("RAY_TPU_OBJECT_STORE_MEMORY")
-            capacity_bytes = (
-                int(env) if env else _default_capacity(_default_shm_root())
+            capacity_bytes = _config.get("object_store_memory") or _default_capacity(
+                _default_shm_root()
             )
         self.shm = ShmStore(session_name, capacity=capacity_bytes)
         self._mem: Dict[str, SealedObject] = {}
@@ -347,7 +353,7 @@ class OwnerStore:
         self, object_id: str, payload: bytes, buffers: List[pickle.PickleBuffer]
     ) -> None:
         size = len(payload) + sum(len(b.raw()) for b in buffers)
-        if size >= INLINE_THRESHOLD:
+        if size >= inline_threshold():
             self._make_room(size, strict=True, reserve=True)
             try:
                 self.shm.create(object_id, payload, buffers)

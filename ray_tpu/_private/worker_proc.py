@@ -26,7 +26,7 @@ import traceback
 from typing import Any, Dict, Optional
 
 from ray_tpu._private import serialization as ser
-from ray_tpu._private.store import INLINE_THRESHOLD, ShmStore
+from ray_tpu._private.store import ShmStore, inline_threshold
 from ray_tpu._private.task_spec import TaskSpec
 from ray_tpu.exceptions import TaskError
 
@@ -114,7 +114,7 @@ class WorkerRuntime:
         payload, buffers, contained = ser.serialize(value)
         size = len(payload) + sum(len(b.raw()) for b in buffers)
         oid = self.request("alloc_object_id", None)
-        if size >= INLINE_THRESHOLD:
+        if size >= inline_threshold():
             self.shm.create(oid, payload, buffers)
             self.request("seal_object", (oid, size, contained))
         else:
@@ -171,7 +171,7 @@ def _store_results(rt: WorkerRuntime, spec: TaskSpec, out) -> list:
         oid = f"o:{spec.task_id}:{i}"
         payload, buffers, contained = ser.serialize(value)
         size = len(payload) + sum(len(b.raw()) for b in buffers)
-        if size >= INLINE_THRESHOLD:
+        if size >= inline_threshold():
             rt.shm.create(oid, payload, buffers)
             results.append((oid, "shm", size, contained))
         else:
@@ -271,7 +271,11 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
     # Watchdog: if the connect/auth handshake wedges (e.g. the driver
     # vanished between spawn and connect), die instead of lingering — the
     # driver's reaper then reschedules anything leased to this worker.
-    watchdog = threading.Timer(60.0, lambda: os._exit(17))
+    from ray_tpu._private import config as _cfg
+
+    watchdog = threading.Timer(
+        _cfg.get("worker_handshake_timeout_s"), lambda: os._exit(17)
+    )
     watchdog.daemon = True
     watchdog.start()
     conn = Client(address, authkey=authkey)

@@ -167,3 +167,40 @@ def test_queue_cross_actor(rt):
     c = consumer.remote(q, 5)
     assert ray_tpu.get(p, timeout=60) == 5
     assert ray_tpu.get(c, timeout=60) == [0, 1, 2, 3, 4]
+
+
+def test_config_knob_table():
+    """§5.6 config system: defaults, env override, _system_config override
+    (ray: ray_config_def.h RAY_CONFIG table semantics)."""
+    import os
+
+    from ray_tpu._private import config
+
+    config._reset_for_tests()
+    try:
+        assert config.get("scheduler_spread_threshold") == 0.5
+        with pytest.raises(KeyError):
+            config.get("no_such_knob")
+
+        config._reset_for_tests()
+        os.environ["RAY_TPU_SCHEDULER_SPREAD_THRESHOLD"] = "0.9"
+        assert config.get("scheduler_spread_threshold") == 0.9
+
+        # programmatic beats env
+        config._reset_for_tests()
+        config.set_system_config({"scheduler_spread_threshold": 0.25})
+        assert config.get("scheduler_spread_threshold") == 0.25
+        with pytest.raises(ValueError, match="unknown config"):
+            config.set_system_config({"bogus": 1})
+
+        # malformed env falls back to default
+        config._reset_for_tests()
+        os.environ["RAY_TPU_SCHEDULER_SPREAD_THRESHOLD"] = "not-a-float"
+        assert config.get("scheduler_spread_threshold") == 0.5
+
+        desc = config.describe()
+        assert "object_store_memory" in desc
+        assert all("doc" in row for row in desc.values())
+    finally:
+        os.environ.pop("RAY_TPU_SCHEDULER_SPREAD_THRESHOLD", None)
+        config._reset_for_tests()
