@@ -68,7 +68,15 @@ class CoreClient:
 
     # -- tasks ---------------------------------------------------------------
 
+    @staticmethod
+    def _stamp_parent(spec: TaskSpec) -> None:
+        from ray_tpu._private.worker_proc import current_task_id
+
+        if spec.parent_task_id is None:
+            spec.parent_task_id = current_task_id()
+
     def submit(self, spec: TaskSpec) -> List[ObjectRef]:
+        self._stamp_parent(spec)
         wr = self._wr()
         if wr is not None:
             return_ids = wr.request("submit", spec)
@@ -77,12 +85,14 @@ class CoreClient:
         return [ObjectRef(oid) for oid in return_ids]
 
     def create_actor(self, spec: TaskSpec) -> str:
+        self._stamp_parent(spec)
         wr = self._wr()
         if wr is not None:
             return wr.request("create_actor", spec)
         return self._rt().create_actor(spec)
 
     def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        self._stamp_parent(spec)
         wr = self._wr()
         if wr is not None:
             return_ids = wr.request("actor_call", spec)

@@ -92,8 +92,13 @@ def set_system_config(overrides: Dict[str, Any]) -> None:
             raise ValueError(f"config {k!r} expects {typ.__name__}, got {v!r}") from e
     with _lock:
         _frozen_overrides.update(coerced)
-        for k in coerced:
+        for k, v in coerced.items():
             _values.pop(k, None)  # recompute on next access
+            # Children (workers/daemons) inherit os.environ, not this
+            # in-process table: export the env form so worker-side knobs
+            # (handshake timeout, inline threshold, native store) actually
+            # take effect there.
+            os.environ[f"RAY_TPU_{k.upper()}"] = str(v)
 
 
 def get(name: str):
@@ -102,6 +107,12 @@ def get(name: str):
         default, typ, _doc = _DEFS[name]
     except KeyError:
         raise KeyError(f"unknown config {name!r}; valid: {sorted(_DEFS)}")
+    # Lock-free fast path (GIL-atomic dict read): get() sits on hot paths
+    # like per-result inline_threshold checks.
+    try:
+        return _values[name]
+    except KeyError:
+        pass
     with _lock:
         if name in _values:
             return _values[name]

@@ -1343,6 +1343,7 @@ class Runtime:
                 "node_id": rec.node_id,
                 "worker_id": wid,
                 "actor_id": spec.actor_id,
+                "parent_task_id": spec.parent_task_id,
                 "attempt": spec.attempt,
                 "end_time": end,
                 "duration": (end - rec.start_time) if rec.start_time else 0.0,

@@ -34,6 +34,9 @@ class TaskSpec:
     is_actor_creation: bool = False
     actor_name: Optional[str] = None
     actor_namespace: Optional[str] = None
+    # Tracing (ray: tracing_helper.py injects context into task specs;
+    # ProfileEvent parentage): the submitting task, None for driver submits.
+    parent_task_id: Optional[str] = None
     actor_method_names: Optional[List[str]] = None
     max_concurrency: int = 1
     max_restarts: int = 0

@@ -137,6 +137,21 @@ class WorkerRuntime:
 
 _runtime: Optional[WorkerRuntime] = None
 
+# Currently-executing task id, tracked with a ContextVar: isolated per
+# thread (FIFO / pool executors) AND per asyncio task (async actors run
+# interleaved coroutines on one loop thread, where a thread-local would
+# bleed between concurrent requests).  Submissions made INSIDE a task read
+# this to stamp their parent for trace trees.
+import contextvars
+
+_current_task: "contextvars.ContextVar[Optional[str]]" = contextvars.ContextVar(
+    "raytpu_current_task", default=None
+)
+
+
+def current_task_id() -> Optional[str]:
+    return _current_task.get()
+
 
 def get_worker_runtime() -> Optional[WorkerRuntime]:
     return _runtime
@@ -181,6 +196,7 @@ def _store_results(rt: WorkerRuntime, spec: TaskSpec, out) -> list:
 
 def _execute(rt: WorkerRuntime, spec: TaskSpec, blob: Optional[bytes]):
     """Run one task/actor-method/creation; returns ("done", ...) message."""
+    _ctx_token = _current_task.set(spec.task_id)
     try:
         if spec.is_actor_creation:
             cls = rt.resolve_function(spec.fn_id, blob)
@@ -212,6 +228,8 @@ def _execute(rt: WorkerRuntime, spec: TaskSpec, blob: Optional[bytes]):
         import cloudpickle
 
         return ("done", spec.task_id, [], cloudpickle.dumps(err))
+    finally:
+        _current_task.reset(_ctx_token)
 
 
 def _is_coroutine(x) -> bool:
@@ -221,7 +239,11 @@ def _is_coroutine(x) -> bool:
 
 
 def _run_on_actor_loop(rt: WorkerRuntime, coro):
-    """Run a coroutine on the actor's persistent event loop (async actors)."""
+    """Run a coroutine on the actor's persistent event loop (async actors).
+
+    The task-id ContextVar is re-set INSIDE the wrapping coroutine: the
+    loop thread has its own context, and each asyncio Task gets an isolated
+    copy, so concurrent async methods keep distinct parents."""
     import asyncio
 
     if rt.async_loop is None:
@@ -229,7 +251,16 @@ def _run_on_actor_loop(rt: WorkerRuntime, coro):
         t = threading.Thread(target=loop.run_forever, daemon=True, name="actor-asyncio")
         t.start()
         rt.async_loop = loop
-    fut = asyncio.run_coroutine_threadsafe(coro, rt.async_loop)
+    task_id = current_task_id()
+
+    async def _with_context():
+        token = _current_task.set(task_id)
+        try:
+            return await coro
+        finally:
+            _current_task.reset(token)
+
+    fut = asyncio.run_coroutine_threadsafe(_with_context(), rt.async_loop)
     return fut.result()
 
 

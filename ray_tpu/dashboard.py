@@ -44,6 +44,7 @@ def timeline() -> list:
                     "task_id": e["task_id"],
                     "state": e["state"],
                     "attempt": e["attempt"],
+                    "parent_task_id": e.get("parent_task_id"),
                 },
             }
         )

@@ -32,6 +32,7 @@ def list_tasks(*, include_finished: bool = True, limit: int = 1000) -> List[Dict
                     "node_id": rec.node_id,
                     "worker_id": rec.worker_id,
                     "actor_id": rec.spec.actor_id,
+                    "parent_task_id": rec.spec.parent_task_id,
                     "attempt": rec.spec.attempt,
                 }
             )

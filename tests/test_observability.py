@@ -204,3 +204,26 @@ def test_config_knob_table():
     finally:
         os.environ.pop("RAY_TPU_SCHEDULER_SPREAD_THRESHOLD", None)
         config._reset_for_tests()
+
+
+def test_task_parentage_tracing(rt):
+    """§5.1 tracing: tasks submitted INSIDE a task record their parent —
+    the context propagation the reference injects into task specs
+    (tracing_helper.py:160)."""
+
+    @ray_tpu.remote
+    def child(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def parent():
+        return ray_tpu.get([child.remote(i) for i in range(2)], timeout=30)
+
+    assert ray_tpu.get(parent.remote(), timeout=60) == [1, 2]
+    events = {e["task_id"]: e for e in state_api.list_tasks()}
+    parents = [e for e in events.values() if e["name"] == "parent"]
+    children = [e for e in events.values() if e["name"] == "child"]
+    assert len(parents) == 1 and len(children) == 2
+    assert parents[0].get("parent_task_id") is None  # driver submit
+    for c in children:
+        assert c["parent_task_id"] == parents[0]["task_id"]
