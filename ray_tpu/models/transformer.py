@@ -53,6 +53,9 @@ class TransformerConfig:
     # (skips qkv matmul + rope recompute).  More saved = more HBM.
     remat_policy: Optional[str] = None
     attention_impl: Optional[str] = None  # None=auto, see ops.attention
+    # Microbatches per pipeline-stage schedule when the rules shard the
+    # layer stack over the `pipeline` axis (strategy="pp").
+    pp_microbatches: int = 4
 
     @property
     def head_dim(self) -> int:
@@ -263,6 +266,57 @@ def _layer(
     return x
 
 
+def _remat_policy(config: TransformerConfig):
+    """Validated checkpoint policy for the configured remat granularity
+    (shared by the scan and pipeline paths)."""
+    if config.remat_policy == "attn":
+        return jax.checkpoint_policies.save_only_these_names("attn")
+    if config.remat_policy == "qkv_attn":
+        return jax.checkpoint_policies.save_only_these_names("q", "k", "v", "attn")
+    if config.remat_policy is None:
+        return None
+    raise ValueError(
+        f"unknown remat_policy {config.remat_policy!r}; "
+        "expected None, 'attn', or 'qkv_attn'"
+    )
+
+
+def _run_layers_pipelined(
+    layer_params: Dict,
+    x: jax.Array,
+    positions: jax.Array,
+    config: TransformerConfig,
+    mesh,
+    axis: str,
+) -> jax.Array:
+    """Run the [L, ...] layer stack as a GPipe pipeline: the stack reshapes
+    to [P, L/P, ...] (stage-major), each pipeline-axis device scans its own
+    L/P layers, and microbatches stream between stages with ppermute
+    (parallel/pipeline.py)."""
+    from ray_tpu.parallel.pipeline import pipeline_apply
+
+    c = config
+    n_stages = mesh.shape[axis]
+    per_stage = c.n_layers // n_stages
+
+    stacked = jax.tree_util.tree_map(
+        lambda a: a.reshape(n_stages, per_stage, *a.shape[1:]), layer_params
+    )
+
+    def stage_fn(stage_params, h):
+        def body(carry, lp):
+            return _layer(carry, lp, positions, c, None, None), None
+
+        out, _ = jax.lax.scan(body, h, stage_params)
+        return out
+
+    if c.remat:
+        stage_fn = jax.checkpoint(stage_fn, policy=_remat_policy(c))
+    return pipeline_apply(
+        stage_fn, stacked, x, mesh, n_microbatches=c.pp_microbatches, axis=axis
+    )
+
+
 def forward(
     params: Dict,
     tokens: jax.Array,
@@ -278,29 +332,52 @@ def forward(
         x = with_logical_constraint(x, ("act_batch", "act_seq", "act_embed"), rules, mesh)
     positions = jnp.arange(tokens.shape[1])
 
-    layer_fn = functools.partial(
-        _layer, positions=positions, config=c, rules=rules, mesh=mesh
-    )
-    if c.remat:
-        if c.remat_policy == "attn":
-            policy = jax.checkpoint_policies.save_only_these_names("attn")
-        elif c.remat_policy == "qkv_attn":
-            policy = jax.checkpoint_policies.save_only_these_names(
-                "q", "k", "v", "attn"
-            )
-        elif c.remat_policy is None:
-            policy = None
-        else:
-            raise ValueError(
-                f"unknown remat_policy {c.remat_policy!r}; "
-                "expected None, 'attn', or 'qkv_attn'"
-            )
-        layer_fn = jax.checkpoint(layer_fn, policy=policy)
+    # Pipeline parallelism: rules shard the LAYER STACK over the pipeline
+    # axis — run the GPipe microbatch schedule instead of a plain scan
+    # (each stage device holds n_layers/P layers).
+    pp_mesh = mesh if mesh is not None else _ambient_mesh()
+    pp_axis = None
+    if rules is not None and rules.get("layers") is not None:
+        ax = rules["layers"]
+        ax = ax[0] if isinstance(ax, tuple) else ax
+        size = pp_mesh.shape[ax] if (pp_mesh is not None and ax in pp_mesh.axis_names) else 1
+        if size > 1:
+            # Explicit pp intent: misconfigurations are ERRORS, not silent
+            # fallbacks — replicated layers instead of pipelining would only
+            # surface as OOM/low MFU at scale.
+            if c.n_layers % size != 0:
+                raise ValueError(
+                    f"strategy 'pp': n_layers={c.n_layers} not divisible by "
+                    f"pipeline axis size {size}"
+                )
+            sharded_params = [
+                k for k in ("embed", "heads", "kv_heads", "head_dim", "mlp",
+                            "vocab", "expert")
+                if rules.get(k) is not None
+            ]
+            if sharded_params:
+                raise ValueError(
+                    "strategy 'pp' currently composes with data-sharded "
+                    f"batches only; param dims {sharded_params} are also "
+                    "sharded — the pipeline stage specs would silently "
+                    "all-gather them per stage device"
+                )
+            pp_axis = ax
+    if pp_axis is not None:
+        x = _run_layers_pipelined(
+            params["layers"], x, positions, c, pp_mesh, pp_axis
+        )
+    else:
+        layer_fn = functools.partial(
+            _layer, positions=positions, config=c, rules=rules, mesh=mesh
+        )
+        if c.remat:
+            layer_fn = jax.checkpoint(layer_fn, policy=_remat_policy(c))
 
-    def scan_body(carry, layer_params):
-        return layer_fn(carry, layer_params), None
+        def scan_body(carry, layer_params):
+            return layer_fn(carry, layer_params), None
 
-    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+        x, _ = jax.lax.scan(scan_body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], c.norm_eps)
     head = (
         params["embed"]["tokens"].T if c.tie_embeddings else params["lm_head"]

@@ -42,10 +42,23 @@ def _pipeline_body(
     stage = jax.lax.axis_index(axis)
     params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
 
-    M = n_microbatches
     B = x.shape[0]
-    if B % M != 0:
-        raise ValueError(f"batch {B} not divisible by n_microbatches {M}")
+    if B < 1:
+        raise ValueError("pipeline stage received an empty batch")
+    # Largest feasible microbatch count <= requested: the LOCAL batch (after
+    # data-axis sharding) must split evenly, and callers size n_microbatches
+    # against the global batch.
+    M = max(min(n_microbatches, B), 1)
+    while B % M:
+        M -= 1
+    if M != n_microbatches:
+        import warnings
+
+        warnings.warn(
+            f"pipeline: n_microbatches={n_microbatches} infeasible for local "
+            f"batch {B}; using {M} (at M=1 the schedule degrades to "
+            f"sequential stages — resize the batch for real pipelining)"
+        )
     micro = x.reshape(M, B // M, *x.shape[1:])
 
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]

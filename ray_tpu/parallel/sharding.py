@@ -27,7 +27,8 @@ Rules = Dict[str, MeshAxis]
 # a single rules table can't alias them.
 #
 #   embed/heads/kv_heads/head_dim/mlp/vocab/expert — parameter dims
-#   layers — scan-over-layers leading axis (never sharded)
+#   layers — scan-over-layers leading axis (sharded over `pipeline` by
+#            pp_rules; unsharded elsewhere)
 #   act_batch/act_seq/act_embed/act_heads/act_kv_heads/act_head_dim/
 #   act_mlp/act_vocab — activation dims
 
@@ -97,6 +98,16 @@ def sp_rules() -> Rules:
     return r
 
 
+def pp_rules() -> Rules:
+    """Pipeline parallel: the scan-over-layers param stack shards over the
+    `pipeline` axis — each pipeline-stage device holds L/P layers, and the
+    model dispatches the GPipe microbatch schedule
+    (parallel/pipeline.py) instead of a plain layer scan."""
+    r = dict(_BASE)
+    r.update(layers="pipeline")
+    return r
+
+
 def ep_rules() -> Rules:
     """Expert parallel for MoE layers."""
     r = fsdp_tp_rules()
@@ -110,6 +121,7 @@ PRESETS = {
     "tp": tp_rules,
     "fsdp_tp": fsdp_tp_rules,
     "sp": sp_rules,
+    "pp": pp_rules,
     "ep": ep_rules,
 }
 

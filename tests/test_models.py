@@ -43,6 +43,7 @@ def test_param_count_matches_config():
         ("tp", MeshSpec(data=2, tensor=4)),
         ("fsdp_tp", MeshSpec(data=2, fsdp=2, tensor=2)),
         ("sp", MeshSpec(data=2, seq=4)),
+        ("pp", MeshSpec(data=4, pipeline=2)),
     ],
 )
 def test_train_step_strategies_agree(strategy, spec):

@@ -68,14 +68,13 @@ def test_pipeline_gradients_match_sequential(setup):
 def test_pipeline_microbatch_counts(setup):
     stacked, x, mesh = setup
     ref = _sequential(stacked, x)
-    # per-data-shard batch is 16/2 = 8: microbatch counts must divide THAT
-    for m in (1, 2, 4, 8):
+    # per-data-shard batch is 16/2 = 8; non-divisors (3 -> 2, 32 -> 8)
+    # auto-adapt to the largest feasible count — results identical always
+    for m in (1, 2, 3, 4, 8, 32):
         out = pipeline_apply(_stage_fn, stacked, x, mesh, n_microbatches=m)
         np.testing.assert_allclose(
             np.asarray(ref), np.asarray(out), atol=1e-5, rtol=1e-5
         )
-    with pytest.raises(ValueError, match="not divisible"):
-        pipeline_apply(_stage_fn, stacked, x, mesh, n_microbatches=3)
 
 
 def test_pipeline_compiles_to_collective_permute(setup):
