@@ -917,18 +917,22 @@ class Runtime:
             return False
         if spec.task_id in self.tasks:
             return True  # reconstruction already in flight
+        # Dependencies may have been freed since the original run: recurse
+        # up the lineage first (ray: recovery walks the lineage DAG).  A dep
+        # that is "ready" but with lost bytes is handled lazily when the
+        # worker's get parks on it.  This must run BEFORE invalidating this
+        # task's own readiness flags: a dep with no lineage aborts the whole
+        # reconstruction, and popped flags would leave every sibling return
+        # id permanently un-ready (gets would park forever instead of
+        # raising ObjectLostError).
+        for d in set(spec.deps):
+            if not self.store.is_ready(d) and not self._reconstruct(d):
+                return False
         # Invalidate readiness of every return of this task so gets re-park
         # and wait() blocks until the re-execution completes.
         with self.store._available:
             for rid in spec.return_ids():
                 self.store._ready.pop(rid, None)
-        # Dependencies may have been freed since the original run: recurse
-        # up the lineage first (ray: recovery walks the lineage DAG).  A dep
-        # that is "ready" but with lost bytes is handled lazily when the
-        # worker's get parks on it.
-        for d in set(spec.deps):
-            if not self.store.is_ready(d) and not self._reconstruct(d):
-                return False
         self.submit_task(spec)
         return True
 

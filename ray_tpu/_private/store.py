@@ -291,6 +291,16 @@ class OwnerStore:
         self._clock += 1
         self._last_access[object_id] = self._clock
 
+    def _account_shm(self, object_id: str, size: int) -> None:
+        """Record id->size under the lock, displacing any prior entry.
+        Re-puts happen (lineage re-execution re-seals surviving return ids);
+        blindly adding would double-count _shm_bytes forever."""
+        prev = self._in_shm.get(object_id)
+        if prev is not None:
+            self._shm_bytes -= prev
+        self._in_shm[object_id] = size
+        self._shm_bytes += size
+
     def _usage(self) -> int:
         return self._shm_bytes + self._reserved
 
@@ -352,8 +362,12 @@ class OwnerStore:
     def put_serialized(
         self, object_id: str, payload: bytes, buffers: List[pickle.PickleBuffer]
     ) -> None:
-        size = len(payload) + sum(len(b.raw()) for b in buffers)
-        if size >= inline_threshold():
+        raw_size = len(payload) + sum(len(b.raw()) for b in buffers)
+        if raw_size >= inline_threshold():
+            # Account what the segment actually occupies (header + per-buffer
+            # framing + alignment), the same figure ShmStore.create allocates
+            # and _restore later records — raw bytes would undercount.
+            size = ser.packed_size(payload, buffers)
             self._make_room(size, strict=True, reserve=True)
             try:
                 self.shm.create(object_id, payload, buffers)
@@ -363,8 +377,7 @@ class OwnerStore:
                 raise
             with self._lock:
                 self._reserved -= size
-                self._in_shm[object_id] = size
-                self._shm_bytes += size
+                self._account_shm(object_id, size)
                 self._touch(object_id)
         else:
             obj = SealedObject(payload, [b.raw() for b in buffers])
@@ -389,8 +402,7 @@ class OwnerStore:
         runtime io thread under the global runtime lock, where synchronous
         disk I/O would stall all scheduling."""
         with self._lock:
-            self._in_shm[object_id] = size
-            self._shm_bytes += size
+            self._account_shm(object_id, size)
             self._touch(object_id)
             over = self._usage() > self.capacity
         if over:
@@ -476,11 +488,19 @@ class OwnerStore:
         with open(path, "wb") as f:
             f.write(ser.pack(bytes(obj.payload), [pickle.PickleBuffer(b) for b in obj.buffers]))
         with self._lock:
-            self._spilled[object_id] = path
             size = self._in_shm.pop(object_id, None)
-            if size is not None:
-                self._shm_bytes -= size
-                self.shm.delete(object_id)
+            if size is None:
+                # Freed (remove_ref -> _free) between the unlocked read above
+                # and here: recording _spilled would resurrect a dead object
+                # and leak the file.
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                return None
+            self._spilled[object_id] = path
+            self._shm_bytes -= size
+            self.shm.delete(object_id)
         return path
 
     def _restore(self, object_id: str, path: str) -> None:
@@ -493,8 +513,7 @@ class OwnerStore:
         payload, buffers = ser.unpack(memoryview(data))
         self.shm.create(object_id, bytes(payload), [pickle.PickleBuffer(b) for b in buffers])
         with self._lock:
-            self._in_shm[object_id] = len(data)
-            self._shm_bytes += len(data)
+            self._account_shm(object_id, len(data))
             self._spilled.pop(object_id, None)
             self._touch(object_id)
         try:

@@ -1,77 +1,92 @@
 """ActorPool: load-balance tasks over a fixed set of actors.
 
-ray: python/ray/util/actor_pool.py — same surface (map / map_unordered /
-submit / get_next / get_next_unordered / has_next / push / pop_idle).
+Same public surface as ray: python/ray/util/actor_pool.py (map /
+map_unordered / submit / get_next / get_next_unordered / has_next / push /
+pop_idle), built around a different core: each in-flight call is one
+record object, indexed twice — by a monotonically increasing submission
+sequence number (for ordered consumption) and by the ObjectRef id (for
+completion-order consumption).  Free actors sit in a FIFO deque; work that
+arrives while every actor is busy queues in a backlog deque and drains as
+records retire.
 """
 
 from __future__ import annotations
 
+import itertools
+from collections import deque
 from typing import Any, Callable, Iterable, List
 
 import ray_tpu
 
 
+class _InFlight:
+    __slots__ = ("seq", "actor", "ref")
+
+    def __init__(self, seq, actor, ref):
+        self.seq = seq
+        self.actor = actor
+        self.ref = ref
+
+
 class ActorPool:
     def __init__(self, actors: List[Any]):
-        self._idle = list(actors)
-        self._future_to_actor = {}
-        self._index_to_future = {}
-        self._next_task_index = 0
-        self._next_return_index = 0
-        self._pending_submits: List[tuple] = []
+        self._idle = deque(actors)
+        self._backlog: deque = deque()  # (fn, value) awaiting a free actor
+        self._seq = itertools.count()
+        self._by_seq: dict = {}  # seq -> _InFlight
+        self._by_ref: dict = {}  # ref.id -> _InFlight
 
     def submit(self, fn: Callable, value: Any) -> None:
         """fn(actor, value) -> ObjectRef; queued if no actor is idle."""
-        if self._idle:
-            actor = self._idle.pop()
-            future = fn(actor, value)
-            self._future_to_actor[future.id] = (self._next_task_index, actor, future)
-            self._index_to_future[self._next_task_index] = future
-            self._next_task_index += 1
-        else:
-            self._pending_submits.append((fn, value))
+        if not self._idle:
+            self._backlog.append((fn, value))
+            return
+        actor = self._idle.popleft()
+        ref = fn(actor, value)
+        rec = _InFlight(next(self._seq), actor, ref)
+        self._by_seq[rec.seq] = rec
+        self._by_ref[ref.id] = rec
 
     def has_next(self) -> bool:
-        return bool(self._future_to_actor) or bool(self._pending_submits)
+        return bool(self._by_seq) or bool(self._backlog)
 
-    def _return_actor(self, actor) -> None:
-        self._idle.append(actor)
-        if self._pending_submits:
-            self.submit(*self._pending_submits.pop(0))
+    def _retire(self, rec: _InFlight) -> None:
+        """Drop a consumed record and recycle its actor onto new work."""
+        self._by_seq.pop(rec.seq, None)
+        self._by_ref.pop(rec.ref.id, None)
+        self._idle.append(rec.actor)
+        if self._backlog and self._idle:
+            self.submit(*self._backlog.popleft())
 
     def get_next(self, timeout=None):
         """Next result in SUBMISSION order.  On timeout the pool state is
         untouched (the slot can be retried); once a result is consumed the
         actor returns to the pool even if the task raised."""
-        if self._next_return_index >= self._next_task_index and not self._pending_submits:
+        if not self._by_seq:
             raise StopIteration("no pending results")
-        future = self._index_to_future[self._next_return_index]
-        ready, _ = ray_tpu.wait([future], num_returns=1, timeout=timeout)
+        rec = self._by_seq[min(self._by_seq)]
+        ready, _ = ray_tpu.wait([rec.ref], num_returns=1, timeout=timeout)
         if not ready:
             raise TimeoutError("get_next timed out")
-        self._index_to_future.pop(self._next_return_index)
-        self._next_return_index += 1
-        _, actor, _ = self._future_to_actor.pop(future.id)
         try:
-            return ray_tpu.get(future)
+            return ray_tpu.get(rec.ref)
         finally:
-            self._return_actor(actor)
+            self._retire(rec)
 
     def get_next_unordered(self, timeout=None):
         """Next COMPLETED result, any order."""
-        if not self._future_to_actor:
+        if not self._by_ref:
             raise StopIteration("no pending results")
-        futures = [f for _, _, f in self._future_to_actor.values()]
-        ready, _ = ray_tpu.wait(futures, num_returns=1, timeout=timeout)
+        ready, _ = ray_tpu.wait(
+            [rec.ref for rec in self._by_ref.values()], num_returns=1, timeout=timeout
+        )
         if not ready:
             raise TimeoutError("get_next_unordered timed out")
-        future = ready[0]
-        idx, actor, _ = self._future_to_actor.pop(future.id)
-        self._index_to_future.pop(idx, None)
+        rec = self._by_ref[ready[0].id]
         try:
-            return ray_tpu.get(future)
+            return ray_tpu.get(rec.ref)
         finally:
-            self._return_actor(actor)
+            self._retire(rec)
 
     def map(self, fn: Callable, values: Iterable[Any]):
         for v in values:
@@ -87,7 +102,9 @@ class ActorPool:
 
     def push(self, actor) -> None:
         """Add an idle actor to the pool."""
-        self._return_actor(actor)
+        self._idle.append(actor)
+        if self._backlog:
+            self.submit(*self._backlog.popleft())
 
     def pop_idle(self):
         """Remove and return an idle actor, or None."""

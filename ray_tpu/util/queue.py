@@ -24,12 +24,23 @@ class Full(Exception):
 
 
 class _QueueActor:
-    def __init__(self, maxsize: int = 0):
+    def __init__(self, maxsize: int = 0, max_concurrency: int = 32):
         self.maxsize = maxsize
         self._items: List[Any] = []
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self._not_full = threading.Condition(self._lock)
+        # Per-side cap on callers parked inside the actor.  The actor has a
+        # finite concurrency budget; without the cap, enough getters parked
+        # on an empty queue consume every slot and the put that would wake
+        # them cannot even enter — a queue that is never actually full/empty
+        # stalls for whole chunk windows under fan-in.  Derived from the
+        # actor's real max_concurrency (caller-overridable), keeping slack
+        # for the non-blocking ops; overflow callers degrade to an immediate
+        # try + client-side backoff.
+        self._park_budget = max(1, (max_concurrency - 4) // 2)
+        self._parked_puts = 0
+        self._parked_gets = 0
 
     def qsize(self) -> int:
         return len(self._items)
@@ -70,26 +81,42 @@ class _QueueActor:
         chunk.  Returns whether the item was enqueued this chunk."""
         deadline = time.monotonic() + timeout_chunk
         with self._lock:
-            while self.maxsize > 0 and len(self._items) >= self.maxsize:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    return False
-                self._not_full.wait(remaining)
-            self._items.append(item)
-            self._not_empty.notify()
-            return True
+            if (
+                self._parked_puts >= self._park_budget
+                and self.maxsize > 0
+                and len(self._items) >= self.maxsize
+            ):
+                return False  # budget spent: immediate-fail, client backs off
+            self._parked_puts += 1
+            try:
+                while self.maxsize > 0 and len(self._items) >= self.maxsize:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return False
+                    self._not_full.wait(remaining)
+                self._items.append(item)
+                self._not_empty.notify()
+                return True
+            finally:
+                self._parked_puts -= 1
 
     def blocking_get(self, timeout_chunk: float) -> tuple:
         deadline = time.monotonic() + timeout_chunk
         with self._lock:
-            while not self._items:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    return (False, None)
-                self._not_empty.wait(remaining)
-            item = self._items.pop(0)
-            self._not_full.notify()
-            return (True, item)
+            if self._parked_gets >= self._park_budget and not self._items:
+                return (False, None)
+            self._parked_gets += 1
+            try:
+                while not self._items:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return (False, None)
+                    self._not_empty.wait(remaining)
+                item = self._items.pop(0)
+                self._not_full.notify()
+                return (True, item)
+            finally:
+                self._parked_gets -= 1
 
     def try_get_batch(self, n: int) -> tuple:
         with self._lock:
@@ -102,8 +129,15 @@ class _QueueActor:
 class Queue:
     def __init__(self, maxsize: int = 0, actor_options: Optional[dict] = None):
         opts = dict(actor_options or {})
-        opts.setdefault("max_concurrency", 16)
-        self.actor = ray_tpu.remote(_QueueActor).options(**opts).remote(maxsize)
+        opts.setdefault("max_concurrency", 32)
+        # The actor sizes its per-side park budgets off its real concurrency
+        # so a caller-supplied max_concurrency cannot reintroduce the
+        # park-slot-exhaustion stall.
+        self.actor = (
+            ray_tpu.remote(_QueueActor)
+            .options(**opts)
+            .remote(maxsize, max_concurrency=opts["max_concurrency"])
+        )
 
     def qsize(self) -> int:
         return ray_tpu.get(self.actor.qsize.remote())
@@ -127,10 +161,17 @@ class Queue:
             if remaining is not None and remaining <= 0:
                 raise Full
             chunk = self._CHUNK if remaining is None else min(remaining, self._CHUNK)
+            t0 = time.monotonic()
             if ray_tpu.get(
                 self.actor.blocking_put.remote(item, chunk), timeout=chunk + 10
             ):
                 return
+            if time.monotonic() - t0 < chunk / 2:
+                # Park budget saturated: degrade to polling, never past the
+                # caller's deadline.
+                left = None if deadline is None else deadline - time.monotonic()
+                if left is None or left > 0:
+                    time.sleep(0.05 if left is None else min(0.05, left))
 
     def get(self, block: bool = True, timeout: Optional[float] = None) -> Any:
         if not block:
@@ -144,11 +185,16 @@ class Queue:
             if remaining is not None and remaining <= 0:
                 raise Empty
             chunk = self._CHUNK if remaining is None else min(remaining, self._CHUNK)
+            t0 = time.monotonic()
             ok, item = ray_tpu.get(
                 self.actor.blocking_get.remote(chunk), timeout=chunk + 10
             )
             if ok:
                 return item
+            if time.monotonic() - t0 < chunk / 2:
+                left = None if deadline is None else deadline - time.monotonic()
+                if left is None or left > 0:
+                    time.sleep(0.05 if left is None else min(0.05, left))
 
     def put_nowait(self, item: Any) -> None:
         self.put(item, block=False)
