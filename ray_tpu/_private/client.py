@@ -127,31 +127,10 @@ class CoreClient:
         return values[0] if single else values
 
     def _worker_get_one(self, wr, oid: str, timeout: Optional[float]):
-        import queue as _q
-
-        obj = wr.shm.get(oid)
-        if obj is not None:
-            return obj.deserialize(wr.ref_factory)
-        # A ("shm", None) reply can race the owner's spiller (segment
-        # unlinked before our mmap): re-request — the owner restores from
-        # the spill file or reconstructs via lineage.  One deadline covers
-        # all retries: the caller's timeout must not triple.
-        deadline = None if timeout is None else time.monotonic() + timeout
-        for _ in range(3):
-            remaining = None if deadline is None else max(deadline - time.monotonic(), 0.0)
-            try:
-                kind, data = wr.request("get_object", oid, timeout=remaining)
-            except _q.Empty:
-                raise GetTimeoutError(f"get({oid}) timed out")
-            if kind != "shm":
-                payload, bufs = ser.unpack(memoryview(data))
-                return ser.deserialize(payload, bufs, wr.ref_factory)
-            obj = wr.shm.get(oid)
-            if obj is not None:
-                return obj.deserialize(wr.ref_factory)
-        from ray_tpu.exceptions import ObjectLostError
-
-        raise ObjectLostError(oid)
+        # One resolution path for arg resolution AND user-level get: local
+        # node store, then the owner, which replies inline / local-shm /
+        # pull-endpoints (cross-node transfer).
+        return wr.get_value(oid, timeout=timeout)
 
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
         wr = self._wr()

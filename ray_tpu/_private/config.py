@@ -63,6 +63,27 @@ _DEFS: Dict[str, tuple] = {
         "driver listener bind address; 0.0.0.0 exposes it to node daemons "
         "on other machines",
     ),
+    "object_transfer_chunk_bytes": (
+        8 * 1024 * 1024, int,
+        "chunk size for cross-node object pulls "
+        "(ray: object_manager_default_chunk_size)",
+    ),
+    "object_transfer_max_concurrency": (
+        8, int,
+        "max concurrent outbound transfers an object server runs; excess "
+        "fetches queue (ray: object_manager_max_bytes_in_flight spirit)",
+    ),
+    "object_transfer_timeout_s": (
+        120.0, float,
+        "bound on every blocking step of a cross-node object pull "
+        "(connect, header, each chunk) — a wedged server fails the fetch "
+        "instead of hanging the get (ray: pull retry timer spirit)",
+    ),
+    "node_ip": (
+        "127.0.0.1", str,
+        "address this node's object server advertises to other nodes "
+        "(set RAY_TPU_NODE_IP per host in real multi-host deployments)",
+    ),
 }
 
 # Back-compat env names from before the knob table existed.

@@ -1,16 +1,21 @@
-"""Node daemon: the per-host worker-pool process (raylet-lite).
+"""Node daemon: the per-host worker-pool + object-store process (raylet-lite).
 
 ray: src/ray/raylet/main.cc + node_manager.h:115 — one daemon per host owns
 that host's worker processes.  TPU-first simplification: scheduling and
-ownership stay with the driver (single-controller); the daemon's job is
-ONLY process supervision on its host — spawn workers on request, kill them
-on request, and take the whole pool down with it when it dies (node
-failure).  Workers connect DIRECTLY to the driver over TCP (the direct task
-transport, ray: direct_task_transport.h:75 — no per-message daemon hop).
+ownership stay with the driver (single-controller); the daemon's jobs are
+  * process supervision on its host — spawn workers on request, kill them
+    on request, and take the whole pool down with it when it dies (node
+    failure); workers connect DIRECTLY to the driver over TCP (the direct
+    task transport, ray: direct_task_transport.h:75);
+  * the NODE OBJECT STORE — an isolated per-node shm directory (no path is
+    shared across nodes) that this daemon creates, its workers seal results
+    into, and its ObjectServer serves to other nodes over the transfer
+    plane (ray: the plasma store + object manager attached to each raylet,
+    src/ray/object_manager/object_manager.h:117).
 
 Launch:  python -m ray_tpu._private.node_daemon
 with env RAY_TPU_DRIVER_HOST/PORT, RAY_TPU_AUTHKEY, RAY_TPU_NODE_CONFIG
-(json: node_id, num_cpus, resources, labels, session).
+(json: node_id, num_cpus, resources, labels, session, store_root?).
 """
 
 from __future__ import annotations
@@ -24,7 +29,8 @@ from typing import Dict
 
 
 def _build_worker_env(
-    wid: str, host: str, port: int, authkey_hex: str, session: str, renv
+    wid: str, host: str, port: int, authkey_hex: str, session: str, renv,
+    store_dir: str,
 ) -> Dict[str, str]:
     from ray_tpu._private.runtime_env import worker_env_entries
 
@@ -38,6 +44,9 @@ def _build_worker_env(
             "RAY_TPU_AUTHKEY": authkey_hex,
             "RAY_TPU_WORKER_ID": wid,
             "RAY_TPU_SESSION": session,
+            # This node's store, NOT the session default: workers seal into
+            # and read from their own node's directory only.
+            "RAY_TPU_STORE_DIR": store_dir,
             **worker_env_entries(renv),
         }
     )
@@ -65,7 +74,23 @@ def main() -> None:
     node_id = cfg["node_id"]
     session = cfg["session"]
 
-    conn = Client((host, port), authkey=bytes.fromhex(authkey_hex))
+    # The node object store: an isolated per-node directory (distinct even
+    # when several daemons share one machine in tests — no cross-node path
+    # sharing), created HERE so the arena exists before any worker joins.
+    from ray_tpu._private import config as _config
+    from ray_tpu._private.object_plane import ObjectServer
+    from ray_tpu._private.store import ShmStore, _default_capacity, _default_shm_root
+
+    store_root = cfg.get("store_root") or _default_shm_root()
+    store_dir = os.path.join(store_root, f"raytpu-{session}-{node_id}")
+    capacity = _config.get("object_store_memory") or _default_capacity(store_root)
+    store = ShmStore(session, capacity=capacity, dir_path=store_dir)
+    authkey = bytes.fromhex(authkey_hex)
+    obj_server = ObjectServer(
+        store.get_raw, authkey, advertise_host=_config.get("node_ip")
+    )
+
+    conn = Client((host, port), authkey=authkey)
     conn.send(
         (
             "daemon",
@@ -74,6 +99,7 @@ def main() -> None:
                 "num_cpus": cfg.get("num_cpus", 1.0),
                 "resources": cfg.get("resources") or {},
                 "labels": cfg.get("labels") or {},
+                "object_endpoint": obj_server.endpoint,
             },
             os.getpid(),
         )
@@ -95,6 +121,8 @@ def main() -> None:
                     p.kill()
                 except OSError:
                     pass
+        obj_server.close()
+        store.destroy()
         sys.exit(0)
 
     signal.signal(signal.SIGTERM, shutdown)
@@ -131,7 +159,9 @@ def main() -> None:
         kind = msg[0]
         if kind == "spawn_worker":
             _, wid, renv = msg
-            env = _build_worker_env(wid, host, port, authkey_hex, session, renv)
+            env = _build_worker_env(
+                wid, host, port, authkey_hex, session, renv, store_dir
+            )
             children[wid] = subprocess.Popen(
                 [sys.executable, "-m", "ray_tpu._private.worker_proc"],
                 env=env,
@@ -145,6 +175,11 @@ def main() -> None:
                 except OSError:
                     pass
                 # reap() collects and reports it next cycle
+        elif kind == "delete_object":
+            # Owner freed the object (refcount hit zero): drop this node's
+            # copy (ray: the raylet's local object manager eviction on
+            # ownership release).
+            store.delete(msg[1])
         elif kind == "shutdown":
             shutdown()
             return

@@ -1,0 +1,242 @@
+"""Cross-host object transfer: per-node object servers + chunked pull.
+
+The reference moves objects between nodes with a push/pull object manager
+attached to each raylet (ray: src/ray/object_manager/object_manager.h:117,
+pull_manager.h:52, push_manager.h:29) and locates copies through an
+ownership-based directory (ray: ownership_based_object_directory.h).  Here
+the single-controller design makes the directory trivial — the driver
+already sees every seal, so `Runtime.object_locations` IS the directory —
+and transfer reduces to a pull protocol:
+
+  * every node daemon runs an `ObjectServer` (a listener + a small bounded
+    pool of serving threads) that streams the raw packed segment of any
+    sealed object out of that node's local shm store in fixed-size chunks;
+  * the driver serves its own (head-node) store through one-shot
+    "object_fetch" connections on its main listener — no extra port;
+  * a consumer that misses locally asks the owner, gets back a list of
+    endpoints holding a copy, pulls from one into its OWN node store
+    (allocate-then-fill, zero-copy into the arena when available), seals,
+    and reports the new copy so siblings on its node skip the wire.
+
+Admission control: the server bounds concurrent outbound transfers with a
+semaphore (excess fetches queue on accept), and chunking keeps any single
+send from pinning a whole object in socket buffers — the pull_manager's
+"bounded in-flight bytes" intent at this design's scale.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable, List, Optional, Tuple
+
+from ray_tpu._private import config as _config
+
+
+def _chunk_size() -> int:
+    return _config.get("object_transfer_chunk_bytes")
+
+
+def stream_object(conn, read_raw: Callable[[str], Optional[tuple]], oid: str) -> None:
+    """Stream one object out over an accepted transfer connection and close
+    it.  ONE implementation of the wire protocol — the daemon ObjectServer
+    and the head's handshake-thread handler both call this, so the framing
+    cannot drift between them.
+
+    read_raw(oid) -> (buffer, keepalive) | None; the buffer is the PACKED
+    segment (header + payload + out-of-band buffers) exactly as stored, so
+    the receiver can seal it byte-for-byte without re-serialization.
+    """
+    try:
+        raw = read_raw(oid)
+        if raw is None:
+            conn.send(("missing",))
+            return
+        buf, _keepalive = raw
+        total = len(buf)
+        conn.send(("ok", total))
+        chunk = _chunk_size()
+        for off in range(0, total, chunk):
+            conn.send_bytes(buf[off : off + chunk])
+    except (OSError, EOFError):
+        pass  # peer vanished mid-transfer; it retries another endpoint
+    finally:
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+
+def serve_fetch_conn(conn, read_raw: Callable[[str], Optional[tuple]]) -> None:
+    """Recv one ("object_fetch", oid) request and stream the reply."""
+    try:
+        req = conn.recv()
+    except (OSError, EOFError):
+        try:
+            conn.close()
+        except OSError:
+            pass
+        return
+    if not (isinstance(req, tuple) and req and req[0] == "object_fetch"):
+        try:
+            conn.close()
+        except OSError:
+            pass
+        return
+    stream_object(conn, read_raw, req[1])
+
+
+class ObjectServer:
+    """Per-node transfer server (daemon-side object manager).
+
+    ray: object_manager.h:117 — ours serves only Pull (the driver's
+    directory turns broadcast into N pulls; a dedicated push path is not
+    needed when every consumer knows where copies live).
+    """
+
+    def __init__(
+        self,
+        read_raw: Callable[[str], Optional[tuple]],
+        authkey: bytes,
+        advertise_host: str,
+        bind_host: str = "0.0.0.0",
+    ):
+        from multiprocessing.connection import Listener
+
+        self._read_raw = read_raw
+        self._sem = threading.BoundedSemaphore(
+            _config.get("object_transfer_max_concurrency")
+        )
+        self.listener = Listener((bind_host, 0), backlog=64, authkey=authkey)
+        self.endpoint: Tuple[str, int] = (advertise_host, self.listener.address[1])
+        self._shutdown = False
+        self._thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="raytpu-objserve"
+        )
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                conn = self.listener.accept()
+            except (OSError, EOFError):
+                if self._shutdown:
+                    return
+                continue
+            threading.Thread(
+                target=self._serve_one, args=(conn,), daemon=True,
+                name="raytpu-objserve-conn",
+            ).start()
+
+    def _serve_one(self, conn) -> None:
+        with self._sem:
+            serve_fetch_conn(conn, self._read_raw)
+
+    def close(self) -> None:
+        self._shutdown = True
+        try:
+            self.listener.close()
+        except OSError:
+            pass
+
+
+def _connect_with_deadline(endpoint: Tuple[str, int], authkey: bytes, timeout: float):
+    """TCP connect with a bound, then the stdlib mutual-auth handshake.
+
+    The connect phase (SYN to a dead/partitioned host would otherwise hang
+    for the kernel's minutes-long default) is bounded by a socket timeout;
+    the auth exchange runs against a live accept loop that answers inline,
+    so it completes or EOFs promptly once connected.
+    """
+    import socket
+    from multiprocessing import connection as mpc
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        s.settimeout(max(timeout, 0.01))
+        s.connect(tuple(endpoint))
+    except BaseException:
+        s.close()
+        raise
+    s.setblocking(True)  # Connection does raw fd reads: no O_NONBLOCK
+    conn = mpc.Connection(s.detach())
+    try:
+        mpc.answer_challenge(conn, authkey)
+        mpc.deliver_challenge(conn, authkey)
+    except BaseException:
+        conn.close()
+        raise
+    return conn
+
+
+def _bounded_recv_bytes(conn, deadline: float) -> bytes:
+    import time
+
+    if not conn.poll(max(deadline - time.monotonic(), 0.0)):
+        raise OSError("object transfer timed out")
+    return conn.recv_bytes()
+
+
+def fetch_object(
+    endpoint: Tuple[str, int],
+    authkey: bytes,
+    oid: str,
+    write_chunks: Callable[[str, int, Iterable[bytes]], None],
+    timeout: Optional[float] = None,
+) -> Optional[int]:
+    """Pull one object from a remote ObjectServer endpoint.
+
+    write_chunks(oid, total_size, chunk_iter) lands the packed bytes in the
+    local store (ShmStore.create_from_chunks / OwnerStore.ingest_packed).
+    Returns the transferred size, or None when the endpoint lacks a copy.
+    Raises OSError/EOFError on transport failure or deadline overrun —
+    caller tries the next endpoint.  Every blocking step is bounded by
+    `timeout` (default: object_transfer_timeout_s), so a wedged server can
+    never hang a get() forever.
+    """
+    import time
+
+    if timeout is None:
+        timeout = _config.get("object_transfer_timeout_s")
+    deadline = time.monotonic() + timeout
+    conn = _connect_with_deadline(endpoint, authkey, timeout)
+    try:
+        conn.send(("object_fetch", oid))
+        if not conn.poll(max(deadline - time.monotonic(), 0.0)):
+            raise OSError("object transfer timed out awaiting header")
+        hdr = conn.recv()
+        if hdr[0] != "ok":
+            return None
+        total = int(hdr[1])
+
+        def chunks():
+            got = 0
+            while got < total:
+                b = _bounded_recv_bytes(conn, deadline)
+                got += len(b)
+                yield b
+
+        write_chunks(oid, total, chunks())
+        return total
+    finally:
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+
+def pull_from_any(
+    endpoints: List[Tuple[str, int]],
+    authkey: bytes,
+    oid: str,
+    write_chunks: Callable[[str, int, Iterable[bytes]], None],
+    timeout: Optional[float] = None,
+) -> Optional[int]:
+    """Try each endpoint in order until one yields the object."""
+    for ep in endpoints:
+        try:
+            n = fetch_object(tuple(ep), authkey, oid, write_chunks, timeout=timeout)
+        except (OSError, EOFError):
+            continue  # node died / wedged / conn refused: next copy
+        if n is not None:
+            return n
+    return None

@@ -260,6 +260,20 @@ class Runtime:
         self.parked_gets: Dict[str, List[Tuple[str, int]]] = {}  # oid -> [(worker, req)]
         self.parked_waits: Dict[str, List[dict]] = {}  # oid -> wait tokens
         self.contained_map: Dict[str, List[str]] = {}  # oid -> contained oids
+        # Object directory (ray: ownership_based_object_directory.h): which
+        # NON-head nodes hold a sealed copy of each object.  Head-node
+        # presence is the OwnerStore's own bookkeeping.  Single-controller
+        # means every seal/copy/free flows through this process, so the
+        # directory needs no pubsub.
+        self.object_locations: Dict[str, Set[str]] = {}
+        self.node_object_endpoints: Dict[str, Tuple[str, int]] = {}
+        # Head-side outbound-transfer admission (the daemon ObjectServer
+        # enforces the same bound for its node).
+        from ray_tpu._private import config as _cfg
+
+        self._transfer_sem = threading.BoundedSemaphore(
+            _cfg.get("object_transfer_max_concurrency")
+        )
         self.pending_pgs: List[str] = []
         # Lineage: producer TaskSpec per task-returned object, enabling
         # re-execution when an object's bytes are lost (evicted / spill file
@@ -357,6 +371,12 @@ class Runtime:
                 entry = self.lineage.pop(oid, None)
                 if entry is not None:
                     self.lineage_bytes -= self._lineage_cost(entry)
+                # Remote copies die with the ownership release (ray: the
+                # owner's directory drives eviction on every holder node).
+                locs = self.object_locations.pop(oid, None)
+                if locs:
+                    for n in locs:
+                        self._daemon_send(n, ("delete_object", oid))
         if contained:
             for c in contained:
                 self._decref_local(c)
@@ -385,6 +405,15 @@ class Runtime:
         """Caller holds self.lock.  Node failure: the daemon's whole worker
         pool dies with it (the daemon terminates its children on exit)."""
         self.node_daemons.pop(node_id, None)
+        self.node_object_endpoints.pop(node_id, None)
+        # Copies on the dead node are gone; objects whose ONLY copy lived
+        # there become lost-bytes (gets fall through to lineage
+        # reconstruction, exactly like a lost spill file).
+        for oid in list(self.object_locations):
+            locs = self.object_locations[oid]
+            locs.discard(node_id)
+            if not locs:
+                del self.object_locations[oid]
         self.state.remove_node(node_id)
         for wid, h in list(self.workers.items()):
             if h.node_id == node_id and h.state != "dead":
@@ -422,6 +451,7 @@ class Runtime:
         resources: Optional[Dict] = None,
         labels: Optional[Dict[str, str]] = None,
         wait_timeout: float = 30.0,
+        store_root: Optional[str] = None,
     ) -> str:
         """Launch a node daemon PROCESS on this machine and wait for it to
         register (the test-side analogue of starting a raylet on another
@@ -441,6 +471,7 @@ class Runtime:
                         "num_cpus": num_cpus,
                         "resources": resources or {},
                         "labels": labels or {},
+                        "store_root": store_root,
                     }
                 ),
             }
@@ -504,6 +535,10 @@ class Runtime:
         extra = {
             "RAY_TPU_WORKER_ID": wid,
             "RAY_TPU_SESSION": self.session_name,
+            # Head-node workers share the HEAD store (explicit, so a
+            # RAY_TPU_STORE_DIR inherited from any outer environment can
+            # never leak a foreign node's store into these workers).
+            "RAY_TPU_STORE_DIR": self.store.shm.dir,
             **worker_env_entries(renv),
         }
         env = self._child_env(extra)
@@ -609,6 +644,17 @@ class Runtime:
                 pass
             conn.close()
             return
+        if first[0] == "object_fetch":
+            # One-shot transfer conn: a remote node pulls an object from
+            # the HEAD store (this listener doubles as the head's object
+            # server — no extra port).  Same streaming body as the daemon
+            # ObjectServer, same admission bound, served on this
+            # handshake thread.
+            from ray_tpu._private import object_plane
+
+            with self._transfer_sem:
+                object_plane.stream_object(conn, self.store.get_raw_packed, first[1])
+            return
         if first[0] == "daemon":
             # Node daemon registration: ("daemon", node_id, cfg, pid).
             _, node_id, cfg, _pid = first
@@ -621,6 +667,9 @@ class Runtime:
                             labels=dict(cfg.get("labels") or {}),
                         )
                     )
+                ep = cfg.get("object_endpoint")
+                if ep:
+                    self.node_object_endpoints[node_id] = tuple(ep)
                 self.node_daemons[node_id] = conn
                 self._conn_to_daemon[conn] = node_id
                 self._dispatch()
@@ -733,6 +782,25 @@ class Runtime:
                     self.store.add_ref(msg[2])
                 else:
                     self._decref_local(msg[2])
+        elif kind == "object_copied":
+            # A worker pulled a copy into its node's store: record it so
+            # siblings on that node read locally — unless the object was
+            # freed while the pull was in flight (then reap the orphan).
+            oid, size = msg[1], msg[2]
+            with self.lock:
+                node = self._worker_node(wid)
+                if node == self.head_node_id:
+                    # The worker wrote straight into the HEAD store's shm:
+                    # without accounting, _free would never delete the
+                    # segment and capacity tracking would undercount.
+                    if self.store.is_ready(oid):
+                        self.store.mark_shm_sealed(oid, size)
+                    else:
+                        self.store.shm.delete(oid)
+                elif self.store.is_ready(oid):
+                    self.object_locations.setdefault(oid, set()).add(node)
+                else:
+                    self._daemon_send(node, ("delete_object", oid))
         elif kind == "actor_exit":
             with self.lock:
                 ar = self.actors.get(msg[1])
@@ -763,7 +831,7 @@ class Runtime:
         if op == "seal_object":
             oid, size, contained = payload
             self._store_contained(oid, contained)
-            self.store.mark_shm_sealed(oid, size)
+            self._record_sealed(wid, oid, size)
             self._object_ready(oid)
             return None
         if op == "put_object":
@@ -837,7 +905,7 @@ class Runtime:
                 self.parked_gets.setdefault(oid, []).append((wid, req_id))
                 return _PARKED
         try:
-            return self._object_reply_value(oid)
+            return self._object_reply_value(oid, self._worker_node(wid))
         except ObjectLostError:
             # Bytes vanished (evicted past spill / spill file lost): lineage
             # re-execution (ray: object_recovery_manager.h:41) — park the
@@ -936,15 +1004,68 @@ class Runtime:
         self.submit_task(spec)
         return True
 
-    def _object_reply_value(self, oid: str):
+    def _worker_node(self, wid: str) -> str:
+        h = self.workers.get(wid)
+        return h.node_id if h is not None else self.head_node_id
+
+    def _record_sealed(self, wid: str, oid: str, size: int) -> None:
+        """A worker sealed a large result into ITS node's store: head-node
+        seals land in the owner store's accounting; remote seals only enter
+        the object directory (the bytes live on that node until pulled)."""
+        node = self._worker_node(wid)
+        if node == self.head_node_id:
+            self.store.mark_shm_sealed(oid, size)
+            return
+        with self.lock:
+            self.object_locations.setdefault(oid, set()).add(node)
+        self.store.mark_remote_sealed(oid)
+
+    def _pull_endpoints(self, oid: str, exclude_head: bool = False) -> list:
+        """Endpoints currently holding a copy, head store first (its
+        listener serves object_fetch one-shots)."""
+        eps = []
+        if not exclude_head and self.store.has_local(oid):
+            eps.append(tuple(self.address))
+        with self.lock:
+            for n in self.object_locations.get(oid, ()):  # remote copies
+                ep = self.node_object_endpoints.get(n)
+                if ep is not None:
+                    eps.append(ep)
+        return eps
+
+    def _object_reply_value(self, oid: str, requester_node: Optional[str] = None):
+        """Build the get_object reply for a requester on requester_node:
+        "inline" (small, bytes ride the control conn), "shm" (a copy is in
+        the requester's OWN node store — mmap it), or ("pull", endpoints)
+        (fetch over the transfer plane)."""
         err = self.store.error_for(oid)
         if err is not None:
             raise err
-        if oid in self.store._in_shm:
-            return ("shm", None)
-        obj = self.store.get_sealed(oid)
-        if obj is None:
-            raise ObjectLostError(oid)
+        if requester_node is None:
+            requester_node = self.head_node_id
+        if requester_node != self.head_node_id:
+            with self.lock:
+                local_copy = requester_node in self.object_locations.get(oid, ())
+            if local_copy:
+                return ("shm", None)
+            obj = self.store._mem.get(oid)
+            if obj is None:
+                eps = self._pull_endpoints(oid)
+                if eps:
+                    return ("pull", eps)
+                raise ObjectLostError(oid)
+            # small: inline below
+        else:
+            if oid in self.store._in_shm:
+                return ("shm", None)
+            obj = self.store.get_sealed(oid)  # mem, or restore-from-spill
+            if obj is None:
+                eps = self._pull_endpoints(oid, exclude_head=True)
+                if eps:
+                    return ("pull", eps)
+                raise ObjectLostError(oid)
+            if oid in self.store._in_shm:  # a restore re-sealed it locally
+                return ("shm", None)
         import pickle
 
         packed = bytes(
@@ -980,7 +1101,7 @@ class Runtime:
             self._dispatch()
         for wid, req_id in parked:
             try:
-                value = self._object_reply_value(oid)
+                value = self._object_reply_value(oid, self._worker_node(wid))
                 self._reply(wid, req_id, True, value)
             except Exception as e:
                 self._reply(wid, req_id, False, e)
@@ -1227,7 +1348,7 @@ class Runtime:
                 oid, kind, data, contained = item
                 self._store_contained(oid, contained)
                 if kind == "shm":
-                    self.store.mark_shm_sealed(oid, data)
+                    self._record_sealed(wid, oid, data)
                 else:
                     self._put_packed(oid, data)
                 ready_ids.append(oid)
@@ -1521,6 +1642,8 @@ class Runtime:
             if err is not None:
                 raise err
             obj = self.store.get_sealed(oid)
+            if obj is None and self._fetch_remote(oid):
+                obj = self.store.get_sealed(oid)
             if obj is not None:
                 return obj.deserialize()
             with self.lock:
@@ -1532,6 +1655,20 @@ class Runtime:
             if not self.store.wait([oid], 1, remaining):
                 raise GetTimeoutError(f"reconstruction of {oid} timed out")
         raise ObjectLostError(oid)
+
+    def _fetch_remote(self, oid: str) -> bool:
+        """Pull an object whose bytes live only on other nodes into the
+        head store (driver-side consumption of remote results —
+        ray: PullManager on the requesting raylet)."""
+        from ray_tpu._private import object_plane
+
+        eps = self._pull_endpoints(oid, exclude_head=True)
+        if not eps:
+            return False
+        n = object_plane.pull_from_any(
+            eps, self._authkey, oid, self.store.ingest_packed
+        )
+        return n is not None
 
     async def get_async(self, ref: ObjectRef):
         import asyncio

@@ -98,8 +98,16 @@ class ShmStore:
         session_name: str,
         root: Optional[str] = None,
         capacity: Optional[int] = None,
+        dir_path: Optional[str] = None,
     ):
-        self.dir = os.path.join(root or _default_shm_root(), f"raytpu-{session_name}")
+        """dir_path overrides the derived location — each NODE owns a
+        distinct store directory (daemons pass their node-scoped dir to
+        their workers via RAY_TPU_STORE_DIR), so nothing resolves an object
+        through a path shared across nodes; cross-node reads go through the
+        object transfer plane (object_plane.py)."""
+        self.dir = dir_path or os.path.join(
+            root or _default_shm_root(), f"raytpu-{session_name}"
+        )
         os.makedirs(self.dir, exist_ok=True)
         self.arena = None
         arena_path = os.path.join(self.dir, "arena")
@@ -180,6 +188,69 @@ class ShmStore:
             f.close()
         payload, buffers = ser.unpack(memoryview(m))
         return SealedObject(payload, buffers, keepalive=m)
+
+    def get_raw(self, object_id: str) -> Optional[Tuple[Any, Any]]:
+        """(buffer, keepalive) of the PACKED segment bytes, or None.
+
+        The transfer plane ships segments verbatim — the receiver seals the
+        identical packed image, so no serialize/deserialize on either side.
+        """
+        if self._use_arena(object_id):
+            pinned = self.arena.get(object_id)
+            if pinned is not None:
+                return pinned.view, pinned
+        path = self._path(object_id)
+        try:
+            f = open(path, "rb")
+        except FileNotFoundError:
+            return None
+        try:
+            size = os.fstat(f.fileno()).st_size
+            m = mmap.mmap(f.fileno(), size, prot=mmap.PROT_READ)
+        finally:
+            f.close()
+        return memoryview(m), m
+
+    def create_from_chunks(self, object_id: str, total: int, chunks) -> None:
+        """Allocate-then-fill from an iterator of byte chunks (the pull
+        receive path): the arena view (or tmpfs mmap) is the receive buffer
+        — chunks land directly in shared memory, one copy total."""
+        view = None
+        if self._use_arena(object_id):
+            try:
+                try:
+                    view = self.arena.allocate(object_id, total)
+                except FileExistsError:
+                    if self.arena.is_pending(object_id):
+                        # stale PENDING slot from a dead puller: reclaim
+                        self.arena.delete(object_id)
+                        view = self.arena.allocate(object_id, total)
+                    else:
+                        for _ in chunks:
+                            pass  # already sealed locally: drain politely
+                        return
+            except (MemoryError, RuntimeError):
+                view = None  # fragmentation/poison: file fallback
+        if view is not None:
+            try:
+                off = 0
+                for b in chunks:
+                    view[off : off + len(b)] = b
+                    off += len(b)
+            finally:
+                del view
+            self.arena.seal(object_id)
+            return
+        path = self._path(object_id)
+        tmp = path + ".tmp"
+        with open(tmp, "wb+") as f:
+            f.truncate(total)
+            with mmap.mmap(f.fileno(), total) as m:
+                off = 0
+                for b in chunks:
+                    m[off : off + len(b)] = b
+                    off += len(b)
+        os.rename(tmp, path)
 
     def delete(self, object_id: str) -> None:
         if self._use_arena(object_id) and self.arena.delete(object_id):
@@ -409,6 +480,12 @@ class OwnerStore:
             self._reclaim_event.set()
         self._mark_ready(object_id)
 
+    def mark_remote_sealed(self, object_id: str) -> None:
+        """A worker on ANOTHER node sealed this object: publish readiness
+        (gets/waits unblock) without local byte accounting — the bytes live
+        in that node's store and arrive here only via the transfer plane."""
+        self._mark_ready(object_id)
+
     def _mark_ready(self, object_id: str) -> None:
         with self._available:
             self._ready[object_id] = True
@@ -474,6 +551,52 @@ class OwnerStore:
             self._restore(object_id, p)
             return self.shm.get(object_id)
         return None
+
+    # -- transfer plane hooks (object_plane.py) ------------------------------
+
+    def get_raw_packed(self, object_id: str) -> Optional[Tuple[Any, Any]]:
+        """(buffer, keepalive) of the packed bytes for serving a remote
+        pull; restores from spill transparently.  None when this store has
+        no copy (the object may live only on other nodes)."""
+        with self._lock:
+            obj = self._mem.get(object_id)
+            if obj is not None:
+                data = bytes(
+                    ser.pack(
+                        bytes(obj.payload),
+                        [pickle.PickleBuffer(b) for b in obj.buffers],
+                    )
+                )
+                return memoryview(data), data
+            if object_id in self._in_shm:
+                self._touch(object_id)
+                return self.shm.get_raw(object_id)
+            p = self._spilled.get(object_id)
+        if p:
+            self._restore(object_id, p)
+            return self.shm.get_raw(object_id)
+        return None
+
+    def ingest_packed(self, object_id: str, total: int, chunks) -> None:
+        """Land a pulled object in this store (packed image, chunked) and
+        account it like any other sealed segment.  Non-strict admission:
+        the object exists in the cluster and the driver asked for it — LRU
+        spill makes room rather than refusing."""
+        self._make_room(total, strict=False)
+        self.shm.create_from_chunks(object_id, total, chunks)
+        with self._lock:
+            self._account_shm(object_id, total)
+            self._touch(object_id)
+        self._mark_ready(object_id)
+
+    def has_local(self, object_id: str) -> bool:
+        """Any byte-bearing copy here (mem / shm / spill)?"""
+        with self._lock:
+            return (
+                object_id in self._mem
+                or object_id in self._in_shm
+                or object_id in self._spilled
+            )
 
     # -- spilling (ray: local_object_manager.h:110 SpillObjects) -------------
 

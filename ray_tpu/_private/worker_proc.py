@@ -40,12 +40,21 @@ class WorkerRuntime:
     which go straight to tmpfs.
     """
 
-    def __init__(self, conn, conn_lock, session_name: str, worker_id: str):
+    def __init__(self, conn, conn_lock, session_name: str, worker_id: str,
+                 authkey: bytes = b""):
         self.conn = conn
         self.conn_lock = conn_lock
         self.worker_id = worker_id
-        self.shm = ShmStore(session_name)
+        self.authkey = authkey
+        # RAY_TPU_STORE_DIR scopes the store to THIS worker's node (set by
+        # its node daemon); without it (head-node workers) the session
+        # default resolves to the head store.  Objects on other nodes are
+        # never path-reachable — they arrive via the transfer plane.
+        self.shm = ShmStore(
+            session_name, dir_path=os.environ.get("RAY_TPU_STORE_DIR")
+        )
         self.session_name = session_name
+        self._pull_lock = threading.Lock()
         self._req_counter = 0
         self._req_lock = threading.Lock()
         self._pending: Dict[int, queue.Queue] = {}
@@ -88,35 +97,76 @@ class WorkerRuntime:
 
         return ObjectRef(id, owner)  # hooks installed in worker_main count it
 
-    def get_value(self, object_id: str) -> Any:
-        # Fast path: sealed segment already on this host's tmpfs.
+    def get_value(self, object_id: str, timeout: Optional[float] = None) -> Any:
+        # Fast path: sealed segment already in this NODE's store.
         obj = self.shm.get(object_id)
-        if obj is None:
-            # The owner may spill the segment between its ("shm", None)
-            # reply and our mmap; re-requesting makes the owner restore it
-            # from the spill file (or reconstruct via lineage) — so a miss
-            # here is a retry, not a loss.
-            for _ in range(3):
-                kind, data = self.request("get_object", object_id)
-                if kind != "shm":
-                    payload, bufs = ser.unpack(memoryview(data))
-                    return ser.deserialize(payload, bufs, self.ref_factory)
-                obj = self.shm.get(object_id)
-                if obj is not None:
-                    break
-            else:
-                from ray_tpu.exceptions import ObjectLostError
+        if obj is not None:
+            return obj.deserialize(self.ref_factory)
+        # The owner may spill the segment between its ("shm", None) reply
+        # and our mmap; re-requesting makes the owner restore it from the
+        # spill file (or reconstruct via lineage) — so a miss here is a
+        # retry, not a loss.  One deadline covers all retries.
+        import time as _time
 
-                raise ObjectLostError(object_id)
-        return obj.deserialize(self.ref_factory)
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        for _ in range(3):
+            remaining = (
+                None if deadline is None else max(deadline - _time.monotonic(), 0.0)
+            )
+            import queue as _q
+
+            try:
+                kind, data = self.request("get_object", object_id, timeout=remaining)
+            except _q.Empty:
+                from ray_tpu.exceptions import GetTimeoutError
+
+                raise GetTimeoutError(f"get({object_id}) timed out")
+            if kind == "inline":
+                payload, bufs = ser.unpack(memoryview(data))
+                return ser.deserialize(payload, bufs, self.ref_factory)
+            if kind == "pull":
+                obj = self._pull(object_id, data)
+                if obj is not None:
+                    return obj.deserialize(self.ref_factory)
+                continue  # every endpoint failed: re-ask the owner
+            # kind == "shm": on this node's store
+            obj = self.shm.get(object_id)
+            if obj is not None:
+                return obj.deserialize(self.ref_factory)
+        from ray_tpu.exceptions import ObjectLostError
+
+        raise ObjectLostError(object_id)
+
+    def _pull(self, object_id: str, endpoints):
+        """Fetch a remote copy into this node's store via the transfer
+        plane; one pull at a time per worker (pull-manager-style admission
+        — concurrent arg resolutions of the same object would race the
+        allocate anyway)."""
+        from ray_tpu._private.object_plane import pull_from_any
+
+        with self._pull_lock:
+            obj = self.shm.get(object_id)  # a sibling pull may have landed it
+            if obj is not None:
+                return obj
+            n = pull_from_any(
+                endpoints, self.authkey, object_id, self.shm.create_from_chunks
+            )
+            if n is None:
+                return None
+            # Report the new copy (with its packed size) so the directory
+            # serves this node locally from now on, deletes the copy when
+            # the object is freed, and — for head-node workers — enters it
+            # in the owner store's capacity accounting.
+            self.oneway(("object_copied", object_id, n))
+            return self.shm.get(object_id)
 
     def put_value(self, value: Any) -> str:
         payload, buffers, contained = ser.serialize(value)
         size = len(payload) + sum(len(b.raw()) for b in buffers)
         oid = self.request("alloc_object_id", None)
         if size >= inline_threshold():
-            self.shm.create(oid, payload, buffers)
-            self.request("seal_object", (oid, size, contained))
+            packed = self.shm.create(oid, payload, buffers)
+            self.request("seal_object", (oid, packed, contained))
         else:
             self.request("put_object", (oid, bytes(ser.pack(payload, buffers)), contained))
         return oid
@@ -187,8 +237,8 @@ def _store_results(rt: WorkerRuntime, spec: TaskSpec, out) -> list:
         payload, buffers, contained = ser.serialize(value)
         size = len(payload) + sum(len(b.raw()) for b in buffers)
         if size >= inline_threshold():
-            rt.shm.create(oid, payload, buffers)
-            results.append((oid, "shm", size, contained))
+            packed = rt.shm.create(oid, payload, buffers)
+            results.append((oid, "shm", packed, contained))
         else:
             results.append((oid, "inline", bytes(ser.pack(payload, buffers)), contained))
     return results
@@ -312,7 +362,7 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
     conn = Client(address, authkey=authkey)
     watchdog.cancel()
     conn_lock = threading.Lock()
-    rt = WorkerRuntime(conn, conn_lock, session_name, worker_id)
+    rt = WorkerRuntime(conn, conn_lock, session_name, worker_id, authkey=authkey)
     _runtime = rt
 
     # Install ObjectRef refcount hooks: proxy to owner (oneway, FIFO with the

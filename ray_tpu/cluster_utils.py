@@ -30,16 +30,20 @@ class Cluster:
         resources: Optional[Dict] = None,
         labels: Optional[Dict[str, str]] = None,
         daemon: bool = False,
+        store_root: Optional[str] = None,
     ) -> str:
         """labels: node metadata; "mesh_coord" (e.g. "0,1") marks the host's
         ICI torus coordinate, consumed by the MESH placement strategy.
 
         daemon=True starts a REAL node-daemon process owning the node's
-        worker pool (the reference's extra-raylet Cluster mode,
-        ray: cluster_utils.py:99) — killing it is a node failure."""
+        worker pool AND node object store (the reference's extra-raylet
+        Cluster mode, ray: cluster_utils.py:99) — killing it is a node
+        failure.  store_root places that node's isolated object-store
+        directory (tests use distinct roots to prove no path sharing)."""
         if daemon:
             nid = self._rt.add_daemon_node(
-                num_cpus=num_cpus, resources=resources, labels=labels
+                num_cpus=num_cpus, resources=resources, labels=labels,
+                store_root=store_root,
             )
         else:
             nid = self._rt.add_node(
