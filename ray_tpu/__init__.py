@@ -52,9 +52,18 @@ def init(
     namespace: str = "default",
     ignore_reinit_error: bool = False,
     _system_config: Optional[Dict[str, Any]] = None,
+    address: Optional[str] = None,
+    _authkey: Optional[str] = None,
     **_unused,
 ):
-    """Start the per-host runtime (driver mode).
+    """Start the per-host runtime (driver mode), or ATTACH to a standalone
+    head process when `address` is given (head-split mode — the analogue of
+    ray.init(address=...) / the Ray Client ray:// attach).
+
+    address: path to a head.json / its session dir (written by
+    `python -m ray_tpu._private.head`), or "host:port" with `_authkey`.
+    An attached driver can die (even kill -9) without taking the cluster
+    down; detached actors keep serving and a new driver can re-attach.
 
     Inside a worker process this is a no-op (the worker is already connected),
     matching the reference's behavior for nested init.
@@ -77,12 +86,21 @@ def init(
         from ray_tpu._private import config as _cfg
 
         _cfg.set_system_config(_system_config)
+    if address is not None:
+        from ray_tpu._private import driver_client
+
+        driver_client.attach(address, authkey=_authkey, namespace=namespace)
+        return
     rt.init_runtime(num_cpus=num_cpus, resources=resources, namespace=namespace)
 
 
 def shutdown():
+    from ray_tpu._private import driver_client
     from ray_tpu._private import runtime as rt
 
+    if driver_client.is_attached():
+        driver_client.detach()
+        return
     rt.shutdown_runtime()
 
 

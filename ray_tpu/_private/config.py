@@ -84,6 +84,19 @@ _DEFS: Dict[str, tuple] = {
         "address this node's object server advertises to other nodes "
         "(set RAY_TPU_NODE_IP per host in real multi-host deployments)",
     ),
+    "reconnect_window_s": (
+        0.0, float,
+        "how long daemons/workers retry connecting after losing the head "
+        "conn before giving up and exiting; 0 = die on EOF (classic mode). "
+        "The standalone head sets this for its cluster so a head restart "
+        "is survivable (ray: gcs_rpc_server_reconnect_timeout_s)",
+    ),
+    "actor_adopt_grace_s": (
+        5.0, float,
+        "after a head restart, how long restored detached/named actors "
+        "wait for their live worker to reconnect (state preserved) before "
+        "being respawned from their creation spec (state reset)",
+    ),
 }
 
 # Back-compat env names from before the knob table existed.

@@ -1,0 +1,164 @@
+"""Driver attach: connect this process to a standalone head as a client.
+
+The attached driver reuses the worker-side machinery (WorkerRuntime's
+request/reply mux, the object resolution paths, ref hooks) — a driver is a
+worker that never executes tasks, exactly how the reference's Ray Client
+server funnels a remote driver through the core-worker surface
+(ray: python/ray/util/client/ARCHITECTURE.md, util/client/server/).
+
+Two store modes, negotiated at attach:
+  * co-located (same host as the head): the driver maps the HEAD store
+    directory for zero-copy reads, like any head-node worker;
+  * remote: the driver keeps a private store dir and every large object
+    rides the control conn (puts) or the transfer plane (gets via pull
+    endpoints) — no filesystem assumptions, i.e. the ray:// case.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from ray_tpu._private import ids
+
+
+_attached = None  # the attached WorkerRuntime, if any
+
+
+def is_attached() -> bool:
+    return _attached is not None
+
+
+def attach(
+    address,
+    authkey: Optional[str] = None,
+    namespace: str = "default",
+    shared_store: Optional[bool] = None,
+):
+    """Connect to a head.  `address` is a path to head.json (or its session
+    dir), or a "host:port" string with `authkey` passed explicitly."""
+    global _attached
+    from multiprocessing.connection import Client
+
+    from ray_tpu._private import worker_proc
+    from ray_tpu._private.head import read_head_info
+
+    if _attached is not None:
+        return _attached
+    if isinstance(address, str) and (os.path.exists(address) or os.path.isdir(address)):
+        info = read_head_info(address)
+        host, port, key = info["host"], int(info["port"]), bytes.fromhex(info["authkey"])
+    else:
+        host, port = str(address).rsplit(":", 1)
+        key = bytes.fromhex(authkey)
+        port = int(port)
+
+    conn = Client((host, port), authkey=key)
+    did = ids._fresh("drv")
+    conn.send(("driver", did, os.getpid()))
+    ack = conn.recv()
+    if not (isinstance(ack, tuple) and ack[0] == "driver_ack"):
+        conn.close()
+        raise ConnectionError(f"unexpected head handshake reply: {ack!r}")
+    meta = ack[1]
+    session = meta["session"]
+    head_store_dir = meta.get("store_dir")
+    if shared_store is None:
+        shared_store = (
+            host in ("127.0.0.1", "localhost")
+            and head_store_dir is not None
+            and os.path.isdir(head_store_dir)
+        )
+    conn.send(("driver_store", did, bool(shared_store)))
+
+    conn_lock = threading.Lock()
+    store_dir = (
+        head_store_dir
+        if shared_store
+        else os.path.join("/tmp", f"raytpu-drv-{session}-{did}")
+    )
+    rt = worker_proc.WorkerRuntime(
+        conn, conn_lock, session, did, authkey=key, store_dir=store_dir
+    )
+    rt.owns_store_dir = not shared_store
+    rt.force_inline_puts = not shared_store
+    worker_proc._runtime = rt
+
+    from ray_tpu._private import refs as refs_mod
+    from ray_tpu._private import runtime as runtime_mod
+
+    refs_mod.set_ref_hooks(
+        lambda oid: rt.oneway(("refop", "add", oid)),
+        lambda oid: rt.oneway(("refop", "del", oid)),
+    )
+    runtime_mod._worker_mode = True
+
+    t = threading.Thread(
+        target=_recv_loop, args=(rt,), daemon=True, name="raytpu-driver-recv"
+    )
+    t.start()
+    rt._recv_thread = t
+    _attached = rt
+    return rt
+
+
+def _recv_loop(rt) -> None:
+    while True:
+        try:
+            msg = rt.conn.recv()
+        except (EOFError, OSError):
+            # Head gone: fail every in-flight request instead of hanging.
+            err = ConnectionError("lost connection to ray_tpu head")
+            for req_id, q in list(rt._pending.items()):
+                rt._pending.pop(req_id, None)
+                try:
+                    q.put((False, err))
+                except Exception:
+                    pass
+            return
+        if msg[0] == "reply":
+            rt._on_reply(msg[1], msg[2], msg[3])
+        # tasks are never pushed to a driver client
+
+
+def detach() -> None:
+    """Disconnect from the head and restore in-process driver ability."""
+    global _attached
+    rt = _attached
+    if rt is None:
+        return
+    _attached = None
+    from ray_tpu._private import refs as refs_mod
+    from ray_tpu._private import runtime as runtime_mod
+    from ray_tpu._private import worker_proc
+
+    worker_proc._runtime = None
+    runtime_mod._worker_mode = False
+    refs_mod.set_ref_hooks(None, None)
+    # The recv thread is blocked in conn.recv(); closing the fd under it
+    # would free the fd number for reuse by a subsequent attach, letting
+    # the old thread steal the new connection's bytes.  shutdown() the
+    # socket instead (EOFs the blocked read without releasing the fd),
+    # join the thread, THEN close.
+    import socket as _socket
+
+    try:
+        s = _socket.socket(fileno=os.dup(rt.conn.fileno()))
+        try:
+            s.shutdown(_socket.SHUT_RDWR)
+        finally:
+            s.close()
+    except OSError:
+        pass
+    t = getattr(rt, "_recv_thread", None)
+    if t is not None:
+        t.join(timeout=5)
+    try:
+        rt.conn.close()
+    except OSError:
+        pass
+    if getattr(rt, "owns_store_dir", False):
+        import shutil
+
+        shutil.rmtree(rt.shm.dir, ignore_errors=True)

@@ -49,6 +49,11 @@ class ActorInfo:
     creation_spec: Any = None  # TaskSpec, kept for restarts
     death_cause: Optional[str] = None
     namespace: str = "default"
+    # Head-split mode (ray: gcs_actor_manager detached actors + job owner):
+    # owner_did names the attached driver that created the actor (None for
+    # the in-process driver); non-detached actors die with their owner.
+    owner_did: Optional[str] = None
+    detached: bool = False
 
 
 @dataclass

@@ -1,0 +1,177 @@
+"""Standalone head process: the control plane split out of the driver.
+
+ray: src/ray/gcs/gcs_server/gcs_server_main.cc + gcs_server.h:77 — the
+reference runs GCS as its own process so driver death never takes down the
+cluster.  Here the head process hosts the full Runtime (GlobalState tables,
+scheduler, ownership, head object store, worker pools), and DRIVERS become
+clients: they attach over TCP with a "driver" hello and speak the same op
+protocol workers do (ray: the Ray Client server reuses the core worker
+surface the same way, python/ray/util/client/ARCHITECTURE.md).
+
+Consequences, mirroring the reference:
+  * kill -9 a driver → the head lives on; the dead driver's refs are
+    dropped and its non-detached actors are killed, while
+    lifetime="detached" actors keep serving (ray: gcs_actor_manager
+    OnJobFinished semantics);
+  * a new driver can attach and reach named/detached actors;
+  * drivers on OTHER machines attach the same way (no shared store path —
+    objects ride the control conn or the transfer plane), which is this
+    framework's ray://-client equivalent.
+
+Launch:
+    python -m ray_tpu._private.head     (env RAY_TPU_HEAD_CONFIG json)
+or programmatically via launch_head_subprocess() (tests/CLI).
+
+The head writes `head.json` ({host, port, authkey, session}) into its
+session dir; `ray_tpu.init(address=<path-to-head.json>)` attaches to it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+from typing import Dict, Optional, Tuple
+
+
+def head_info_path(session_dir: str) -> str:
+    return os.path.join(session_dir, "head.json")
+
+
+def write_head_info(session_dir: str, rt) -> str:
+    os.makedirs(session_dir, exist_ok=True)
+    host, port = rt.address
+    path = head_info_path(session_dir)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(
+            {
+                "host": host,
+                "port": port,
+                "authkey": rt._authkey.hex(),
+                "session": rt.session_name,
+            },
+            f,
+        )
+    os.replace(tmp, path)
+    return path
+
+
+def read_head_info(path_or_dir: str) -> Dict:
+    p = path_or_dir
+    if os.path.isdir(p):
+        p = head_info_path(p)
+    with open(p) as f:
+        return json.load(f)
+
+
+def main() -> None:
+    cfg = json.loads(os.environ.get("RAY_TPU_HEAD_CONFIG", "{}"))
+    session_dir = cfg.get("session_dir") or "/tmp/raytpu-head"
+    # The head's cluster is restart-survivable: daemons/workers retry the
+    # head's FIXED address for this window instead of dying on conn EOF.
+    os.environ.setdefault("RAY_TPU_RECONNECT_WINDOW_S", "30")
+
+    # Reuse the previous incarnation's port + authkey (same session) so
+    # surviving daemons/workers can find and authenticate to the restarted
+    # head — the GCS-address-stability premise of ray's FT story.
+    listen_port = int(cfg.get("listen_port") or 0)
+    authkey = bytes.fromhex(cfg["authkey"]) if cfg.get("authkey") else None
+    if not listen_port:
+        try:
+            prior = read_head_info(session_dir)
+            if cfg.get("session") and prior.get("session") == cfg["session"]:
+                listen_port = int(prior["port"])
+                authkey = bytes.fromhex(prior["authkey"])
+        except (OSError, ValueError, KeyError):
+            pass
+
+    from ray_tpu._private.runtime import Runtime
+
+    rt = Runtime(
+        num_cpus=cfg.get("num_cpus"),
+        resources=cfg.get("resources"),
+        namespace=cfg.get("namespace", "default"),
+        session_name=cfg.get("session"),
+        snapshot_path=os.path.join(session_dir, "gcs_snapshot.pkl")
+        if cfg.get("persist", True)
+        else None,
+        listen_port=listen_port,
+        authkey=authkey,
+    )
+    write_head_info(session_dir, rt)
+
+    stop = {"flag": False}
+
+    def _term(*_a):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    while not stop["flag"]:
+        time.sleep(0.2)
+    rt.shutdown()
+    sys.exit(0)
+
+
+def launch_head_subprocess(
+    session_dir: str,
+    num_cpus: int = 4,
+    resources: Optional[Dict] = None,
+    session: Optional[str] = None,
+    persist: bool = True,
+    wait_timeout: float = 60.0,
+) -> Tuple[object, str]:
+    """Start a head process and wait for its head.json (test/CLI helper).
+    Returns (Popen, head_json_path)."""
+    import subprocess
+
+    env = os.environ.copy()
+    # A restarted head must come back at the SAME address: carry the prior
+    # incarnation's port + authkey (if any) into the new process before
+    # clearing the stale head.json.
+    listen_port, authkey = 0, None
+    path = head_info_path(session_dir)
+    try:
+        prior = read_head_info(path)
+        if session and prior.get("session") == session:
+            listen_port = int(prior["port"])
+            authkey = prior["authkey"]
+    except (OSError, ValueError, KeyError):
+        pass
+    env["RAY_TPU_HEAD_CONFIG"] = json.dumps(
+        {
+            "session_dir": session_dir,
+            "num_cpus": num_cpus,
+            "resources": resources or {},
+            "session": session,
+            "persist": persist,
+            "listen_port": listen_port,
+            "authkey": authkey,
+        }
+    )
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    paths = [pkg_root] + [p for p in sys.path if p]
+    env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(paths))
+    try:
+        os.unlink(path)  # a stale file would ack before the head is up
+    except OSError:
+        pass
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.head"], env=env, close_fds=True
+    )
+    deadline = time.monotonic() + wait_timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            return proc, path
+        if proc.poll() is not None:
+            raise RuntimeError(f"head process exited rc={proc.returncode}")
+        time.sleep(0.02)
+    proc.terminate()
+    raise TimeoutError("head did not write head.json in time")
+
+
+if __name__ == "__main__":
+    main()

@@ -30,7 +30,7 @@ from typing import Dict
 
 def _build_worker_env(
     wid: str, host: str, port: int, authkey_hex: str, session: str, renv,
-    store_dir: str,
+    store_dir: str, node_id: str,
 ) -> Dict[str, str]:
     from ray_tpu._private.runtime_env import worker_env_entries
 
@@ -47,6 +47,9 @@ def _build_worker_env(
             # This node's store, NOT the session default: workers seal into
             # and read from their own node's directory only.
             "RAY_TPU_STORE_DIR": store_dir,
+            # Node identity rides the worker's "ready" handshake so a
+            # restarted head can adopt the worker back onto this node.
+            "RAY_TPU_NODE_ID": node_id,
             **worker_env_entries(renv),
         }
     )
@@ -90,20 +93,41 @@ def main() -> None:
         store.get_raw, authkey, advertise_host=_config.get("node_ip")
     )
 
-    conn = Client((host, port), authkey=authkey)
-    conn.send(
-        (
-            "daemon",
-            node_id,
-            {
-                "num_cpus": cfg.get("num_cpus", 1.0),
-                "resources": cfg.get("resources") or {},
-                "labels": cfg.get("labels") or {},
-                "object_endpoint": obj_server.endpoint,
-            },
-            os.getpid(),
+    def connect():
+        c = Client((host, port), authkey=authkey)
+        c.send(
+            (
+                "daemon",
+                node_id,
+                {
+                    "num_cpus": cfg.get("num_cpus", 1.0),
+                    "resources": cfg.get("resources") or {},
+                    "labels": cfg.get("labels") or {},
+                    "object_endpoint": obj_server.endpoint,
+                },
+                os.getpid(),
+            )
         )
-    )
+        return c
+
+    def reconnect():
+        """Head conn lost: in head-split mode, retry the head's fixed
+        address for the window (a restarted head re-registers this node);
+        None = give up (classic mode or window expired) -> node death."""
+        import time as _time
+
+        window = _config.get("reconnect_window_s")
+        if window <= 0:
+            return None
+        deadline = _time.monotonic() + window
+        while _time.monotonic() < deadline:
+            try:
+                return connect()
+            except Exception:
+                _time.sleep(0.5)
+        return None
+
+    conn = connect()
 
     children: Dict[str, subprocess.Popen] = {}
 
@@ -145,22 +169,29 @@ def main() -> None:
         try:
             has_msg = conn.poll(0.5)
         except (EOFError, OSError):
-            shutdown()
-            return
+            conn = reconnect()
+            if conn is None:
+                shutdown()
+                return
+            continue
         reap()
         if not has_msg:
             continue
         try:
             msg = conn.recv()
         except (EOFError, OSError):
-            # Driver gone: this host's pool dies with it.
-            shutdown()
-            return
+            # Head gone: reconnect in head-split mode, else this host's
+            # pool dies with it.
+            conn = reconnect()
+            if conn is None:
+                shutdown()
+                return
+            continue
         kind = msg[0]
         if kind == "spawn_worker":
             _, wid, renv = msg
             env = _build_worker_env(
-                wid, host, port, authkey_hex, session, renv, store_dir
+                wid, host, port, authkey_hex, session, renv, store_dir, node_id
             )
             children[wid] = subprocess.Popen(
                 [sys.executable, "-m", "ray_tpu._private.worker_proc"],

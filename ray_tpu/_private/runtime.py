@@ -149,6 +149,36 @@ class _RemoteProcHandle:
         return None
 
 
+class _AdoptedHandle:
+    """Process facade for a worker adopted after a head restart: the new
+    head never spawned it, so liveness is purely connection state and
+    terminate can only ask the worker itself to exit."""
+
+    __slots__ = ("_rt", "_wid", "dead")
+
+    def __init__(self, rt, wid):
+        self._rt = rt
+        self._wid = wid
+        self.dead = False
+
+    def terminate(self):
+        h = self._rt.workers.get(self._wid)
+        if h is not None and h.conn is not None:
+            try:
+                h.conn.send(("kill",))
+            except OSError:
+                pass
+
+    def kill(self):
+        self.terminate()
+
+    def join(self, timeout=None):
+        pass
+
+    def is_alive(self):
+        return not self.dead
+
+
 class WorkerHandle:
     __slots__ = (
         "worker_id",
@@ -229,6 +259,9 @@ class Runtime:
         resources: Optional[Dict[str, float]] = None,
         namespace: str = "default",
         session_name: Optional[str] = None,
+        snapshot_path: Optional[str] = None,
+        listen_port: int = 0,
+        authkey: Optional[bytes] = None,
     ):
         self.session_name = session_name or f"{os.getpid()}-{os.urandom(3).hex()}"
         self.namespace = namespace
@@ -311,14 +344,20 @@ class Runtime:
 
         from multiprocessing.connection import Listener
 
-        self._authkey = os.urandom(16)
+        # listen_port/authkey are fixed (not ephemeral/random) in head-split
+        # mode so a restarted head comes back at the SAME address and its
+        # daemons/workers can reconnect (ray: the GCS address is stable
+        # across gcs_server restarts).
+        self._authkey = authkey or os.urandom(16)
         # backlog: many workers connect at once on startup; the default
         # backlog of 1 silently drops simultaneous handshakes (the dropped
         # worker then blocks forever in its auth recv).
         # Loopback by default; RAY_TPU_BIND_HOST=0.0.0.0 exposes the driver
         # to daemons on OTHER machines (required for cloud node providers).
         bind_host = _config.get("bind_host")
-        self.listener = Listener((bind_host, 0), backlog=128, authkey=self._authkey)
+        self.listener = Listener(
+            (bind_host, listen_port), backlog=128, authkey=self._authkey
+        )
         self.address = self.listener.address
         self._shutdown = False
         self._conn_to_worker: Dict[Any, str] = {}
@@ -328,6 +367,24 @@ class Runtime:
         self.node_daemons: Dict[str, Any] = {}
         self._conn_to_daemon: Dict[Any, str] = {}
         self._daemon_procs: Dict[str, Any] = {}  # node_id -> Popen (local launch)
+        # Attached driver clients (head-split mode, head.py): did -> conn,
+        # plus the pseudo-node each non-co-located driver reads objects as,
+        # and per-driver ref borrows dropped on driver death
+        # (ray: gcs_job_manager OnJobFinished cleanup).
+        self.drivers: Dict[str, Any] = {}
+        self.driver_nodes: Dict[str, str] = {}
+        self._conn_to_driver: Dict[Any, str] = {}
+        self.driver_refs: Dict[str, Dict[str, int]] = {}
+        # Control-plane persistence (ray: gcs storage,
+        # gcs/store_client/redis_store_client.h — ours is a snapshot file):
+        # named/detached actors, KV, functions, PGs, object directory.
+        self.snapshot_path = snapshot_path
+        self._restored_actors: Set[str] = set()
+        if snapshot_path:
+            self._restore_snapshot()
+            threading.Thread(
+                target=self._snapshot_loop, daemon=True, name="raytpu-snapshot"
+            ).start()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="raytpu-accept"
         )
@@ -349,6 +406,141 @@ class Runtime:
                 )
             ):
                 self._spawn_worker(self.head_node_id, None, None, prestart=True)
+
+    # ------------------------------------------------------------------
+    # control-plane persistence (ray: gcs storage + gcs_actor_manager
+    # recovery; ours snapshots the metadata tables to one file)
+
+    def _snapshot_loop(self) -> None:
+        while not self._shutdown:
+            time.sleep(0.5)
+            try:
+                self._write_snapshot()
+            except Exception:
+                pass  # next tick retries; persistence is best-effort
+
+    def _write_snapshot(self) -> None:
+        import pickle
+
+        # Lock order everywhere else is self.lock -> state.lock (handshake
+        # and io threads take self.lock then call into GlobalState); taking
+        # them in the opposite order here would be an ABBA deadlock.
+        with self.lock, self.state.lock:
+            actors = []
+            for aid, info in self.state.actors.items():
+                if not (info.detached or info.name):
+                    continue  # anonymous non-detached actors die with drivers
+                actors.append(
+                    {
+                        "actor_id": aid,
+                        "name": info.name,
+                        "namespace": info.namespace,
+                        "state": info.state,
+                        "worker_id": info.worker_id,
+                        "node_id": info.node_id,
+                        "max_restarts": info.max_restarts,
+                        "detached": info.detached,
+                        "creation_spec": info.creation_spec,
+                    }
+                )
+            snap = {
+                "session": self.session_name,
+                "kv": {ns: dict(d) for ns, d in self.state.kv.items()},
+                "functions": dict(self.state.functions),
+                "actors": actors,
+                "placement_groups": {
+                    pid: (pg.bundles, pg.strategy, pg.name, pg.state)
+                    for pid, pg in self.state.placement_groups.items()
+                    if pg.state != "REMOVED"
+                },
+                "object_locations": {
+                    k: set(v) for k, v in self.object_locations.items()
+                },
+            }
+        tmp = self.snapshot_path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(snap, f)
+        os.replace(tmp, self.snapshot_path)
+
+    def _restore_snapshot(self) -> None:
+        """Replay persisted control state on head restart: KV, exported
+        functions, the object directory, PGs (re-reserved as nodes return),
+        and named/detached actors (recreated from their creation specs;
+        live-worker adoption upgrades this when the worker reconnects)."""
+        import pickle
+
+        try:
+            with open(self.snapshot_path, "rb") as f:
+                snap = pickle.load(f)
+        except (OSError, EOFError, pickle.UnpicklingError):
+            return
+        from ray_tpu._private import config as _config
+
+        if snap.get("session") != self.session_name:
+            return  # someone else's session dir: never replay foreign state
+        for ns, d in snap.get("kv", {}).items():
+            self.state.kv.setdefault(ns, {}).update(d)
+        self.state.functions.update(snap.get("functions", {}))
+        for oid, locs in snap.get("object_locations", {}).items():
+            self.object_locations.setdefault(oid, set()).update(locs)
+        for pid, (bundles, strategy, name, pstate) in snap.get(
+            "placement_groups", {}
+        ).items():
+            if pid in self.state.placement_groups:
+                continue
+            pg = PlacementGroupInfo(pid, bundles, strategy, name=name)
+            self.state.placement_groups[pid] = pg
+            self.pending_pgs.append(pid)  # re-reserve once nodes register
+        for a in snap.get("actors", []):
+            if a["state"] == DEAD or a["actor_id"] in self.state.actors:
+                continue
+            spec = a["creation_spec"]
+            info = ActorInfo(
+                actor_id=a["actor_id"],
+                name=a["name"],
+                namespace=a["namespace"],
+                max_restarts=a["max_restarts"],
+                creation_spec=spec,
+                detached=a["detached"],
+                state=RESTARTING,
+                worker_id=a.get("worker_id"),
+                node_id=a.get("node_id"),
+            )
+            try:
+                self.state.register_actor(info)
+            except ValueError:
+                continue
+            self.actors[spec.actor_id] = ActorRuntime(info)
+            self._restored_actors.add(spec.actor_id)
+        if self._restored_actors:
+            # Give live workers the adoption grace to reconnect and re-bind
+            # (actor memory state PRESERVED); whatever stays unbound is then
+            # respawned from its creation spec (state reset) — ray:
+            # gcs_actor_manager reconstruction after GCS restart.
+            t = threading.Timer(
+                _config.get("actor_adopt_grace_s"), self._respawn_unbound_actors
+            )
+            t.daemon = True
+            t.start()
+
+    def _respawn_unbound_actors(self) -> None:
+        """Adoption grace expired: recreate restored actors whose worker
+        never came back."""
+        with self.lock:
+            specs = []
+            for aid in list(self._restored_actors):
+                ar = self.actors.get(aid)
+                self._restored_actors.discard(aid)
+                if (
+                    ar is not None
+                    and ar.info.state == RESTARTING
+                    and ar.worker_id is None
+                    and ar.info.creation_spec is not None
+                ):
+                    ar.info.worker_id = None
+                    specs.append(ar.info.creation_spec)
+        for spec in specs:
+            self.submit_task(spec)
 
     # ------------------------------------------------------------------
     # refcounting (owner side)
@@ -400,6 +592,28 @@ class Runtime:
             conn.send(msg)
         except OSError:
             pass
+
+    def _on_driver_death(self, did: str) -> None:
+        """An attached driver's conn EOF'ed (exit or kill -9): the head
+        lives on.  Drop the driver's ref borrows, kill its non-detached
+        actors; lifetime="detached" actors keep serving
+        (ray: gcs_actor_manager OnJobFinished + gcs_job_manager)."""
+        with self.lock:
+            self.drivers.pop(did, None)
+            self.driver_nodes.pop(did, None)
+            refs = self.driver_refs.pop(did, {})
+            doomed = [
+                aid
+                for aid, ar in self.actors.items()
+                if ar.info.owner_did == did
+                and not ar.info.detached
+                and ar.info.state != DEAD
+            ]
+        for oid, count in refs.items():
+            for _ in range(count):
+                self._decref_local(oid)
+        for aid in doomed:
+            self.kill_actor(aid, no_restart=True)
 
     def _on_daemon_death(self, node_id: str) -> None:
         """Caller holds self.lock.  Node failure: the daemon's whole worker
@@ -655,6 +869,36 @@ class Runtime:
             with self._transfer_sem:
                 object_plane.stream_object(conn, self.store.get_raw_packed, first[1])
             return
+        if first[0] == "driver":
+            # Attached driver client (head-split mode): ("driver", did, pid).
+            # Reply with session metadata, then a second message declares
+            # whether the driver co-locates with the head store (zero-copy
+            # reads) or stays remote (ray://-style: conn + transfer plane).
+            _, did, _pid = first
+            try:
+                conn.send(
+                    (
+                        "driver_ack",
+                        {
+                            "session": self.session_name,
+                            "namespace": self.namespace,
+                            "store_dir": self.store.shm.dir,
+                        },
+                    )
+                )
+                second = conn.recv()
+            except (OSError, EOFError):
+                conn.close()
+                return
+            shared = bool(second[2]) if second[0] == "driver_store" else False
+            with self.lock:
+                self.drivers[did] = conn
+                self.driver_nodes[did] = (
+                    self.head_node_id if shared else f"drvnode-{did}"
+                )
+                self.driver_refs[did] = {}
+                self._conn_to_driver[conn] = did
+            return
         if first[0] == "daemon":
             # Node daemon registration: ("daemon", node_id, cfg, pid).
             _, node_id, cfg, _pid = first
@@ -681,7 +925,9 @@ class Runtime:
         with self.lock:
             h = self.workers.get(wid)
             if h is None:
-                conn.close()
+                h = self._adopt_worker(conn, first)
+                if h is None:
+                    conn.close()
                 return
             h.conn = conn
             h.pid = first[2]
@@ -700,6 +946,49 @@ class Runtime:
             self._conn_to_worker[conn] = wid
         with self.lock:
             self._dispatch()
+
+    def _adopt_worker(self, conn, first) -> Optional[WorkerHandle]:
+        """Caller holds self.lock.  A worker this head never spawned says
+        "ready": after a head restart, surviving workers reconnect within
+        the window and are adopted — a restored actor bound to the worker
+        resumes ALIVE with its memory state intact (ray: workers
+        re-registering with a restarted GCS via raylet resubscription).
+        Note: adopted actors occupy node resources the fresh scheduler has
+        not reserved; transient overcommit until they exit is accepted."""
+        from ray_tpu._private import config as _config
+
+        if _config.get("reconnect_window_s") <= 0:
+            return None  # classic mode: unknown workers are rejected
+        wid, pid = first[1], first[2]
+        node_id = first[3] if len(first) > 3 else None
+        nid = node_id or self.head_node_id
+        if nid in self.node_daemons:
+            proc: Any = _RemoteProcHandle(self, nid, wid)
+        else:
+            proc = _AdoptedHandle(self, wid)
+        h = WorkerHandle(wid, nid, None, None, proc)
+        h.conn = conn
+        h.pid = pid
+        self.workers[wid] = h
+        self._conn_to_worker[conn] = wid
+        bound = None
+        for aid, ar in self.actors.items():
+            if ar.info.worker_id == wid and ar.info.state == RESTARTING:
+                bound = aid
+                break
+        if bound is not None:
+            ar = self.actors[bound]
+            ar.worker_id = wid
+            h.state = "actor"
+            h.actor_id = bound
+            self._restored_actors.discard(bound)
+            self.state.set_actor_state(bound, ALIVE, worker_id=wid, node_id=nid)
+            self._on_actor_alive(bound)
+        else:
+            h.state = "idle"
+            self.idle_pool.setdefault((nid, None), []).append(wid)
+        self._dispatch()
+        return h
 
     def _io_loop(self):
         from multiprocessing.connection import wait as conn_wait
@@ -721,8 +1010,10 @@ class Runtime:
                         ):
                             self._on_worker_crash(wid)
             with self.lock:
-                conns = list(self._conn_to_worker.keys()) + list(
-                    self._conn_to_daemon.keys()
+                conns = (
+                    list(self._conn_to_worker.keys())
+                    + list(self._conn_to_daemon.keys())
+                    + list(self._conn_to_driver.keys())
                 )
             if not conns:
                 time.sleep(0.02)
@@ -751,6 +1042,22 @@ class Runtime:
                             if h is not None and h.state != "dead":
                                 self._on_worker_crash(dmsg[1])
                     continue
+                did = self._conn_to_driver.get(conn)
+                if did is not None:
+                    try:
+                        msg = conn.recv()
+                    except (EOFError, OSError):
+                        with self.lock:
+                            self._conn_to_driver.pop(conn, None)
+                        self._on_driver_death(did)
+                        continue
+                    try:
+                        self._handle_msg(did, msg)
+                    except Exception:
+                        import traceback
+
+                        traceback.print_exc()
+                    continue
                 wid = self._conn_to_worker.get(conn)
                 if wid is None:
                     continue
@@ -778,10 +1085,19 @@ class Runtime:
                 self._on_task_done(wid, msg[1], msg[2], msg[3])
         elif kind == "refop":
             with self.lock:
+                tracked = self.driver_refs.get(wid)
                 if msg[1] == "add":
                     self.store.add_ref(msg[2])
+                    if tracked is not None:
+                        tracked[msg[2]] = tracked.get(msg[2], 0) + 1
                 else:
                     self._decref_local(msg[2])
+                    if tracked is not None:
+                        c = tracked.get(msg[2], 0) - 1
+                        if c > 0:
+                            tracked[msg[2]] = c
+                        else:
+                            tracked.pop(msg[2], None)
         elif kind == "object_copied":
             # A worker pulled a copy into its node's store: record it so
             # siblings on that node read locally — unless the object was
@@ -789,6 +1105,8 @@ class Runtime:
             oid, size = msg[1], msg[2]
             with self.lock:
                 node = self._worker_node(wid)
+                if wid in self.drivers and node != self.head_node_id:
+                    return  # remote driver's private store: nobody else reads it
                 if node == self.head_node_id:
                     # The worker wrote straight into the HEAD store's shm:
                     # without accounting, _free would never delete the
@@ -822,6 +1140,13 @@ class Runtime:
             h = self.workers.get(wid)
             if h is not None:
                 self._send(h, ("reply", req_id, ok, value))
+                return
+            conn = self.drivers.get(wid)
+        if conn is not None:
+            try:
+                conn.send(("reply", req_id, ok, value))
+            except OSError:
+                pass  # driver died; its EOF cleanup is in flight
 
     def _handle_req(self, wid: str, req_id: int, op: str, payload: Any) -> Any:
         if op == "get_object":
@@ -854,7 +1179,9 @@ class Runtime:
         if op == "actor_call":
             return self.submit_actor_task(payload)
         if op == "create_actor":
-            return self.create_actor(payload)
+            return self.create_actor(
+                payload, owner_did=wid if wid in self.drivers else None
+            )
         if op == "get_actor_named":
             name, nsp = payload
             info = self.state.get_named_actor(name, nsp or self.namespace)
@@ -1006,7 +1333,12 @@ class Runtime:
 
     def _worker_node(self, wid: str) -> str:
         h = self.workers.get(wid)
-        return h.node_id if h is not None else self.head_node_id
+        if h is not None:
+            return h.node_id
+        # Attached drivers read objects as their negotiated pseudo-node:
+        # the head node when co-located (zero-copy), a store-less node id
+        # when remote (forces inline/pull replies).
+        return self.driver_nodes.get(wid, self.head_node_id)
 
     def _record_sealed(self, wid: str, oid: str, size: int) -> None:
         """A worker sealed a large result into ITS node's store: head-node
@@ -1020,12 +1352,23 @@ class Runtime:
             self.object_locations.setdefault(oid, set()).add(node)
         self.store.mark_remote_sealed(oid)
 
+    def _head_transfer_endpoint(self) -> Tuple[str, int]:
+        """The address other nodes pull head-store objects from.  The
+        listener may bind a wildcard (RAY_TPU_BIND_HOST=0.0.0.0), which is
+        not routable — advertise the node_ip knob instead."""
+        host, port = self.address
+        if host in ("0.0.0.0", "", "::"):
+            from ray_tpu._private import config as _config
+
+            host = _config.get("node_ip")
+        return (host, port)
+
     def _pull_endpoints(self, oid: str, exclude_head: bool = False) -> list:
         """Endpoints currently holding a copy, head store first (its
         listener serves object_fetch one-shots)."""
         eps = []
         if not exclude_head and self.store.has_local(oid):
-            eps.append(tuple(self.address))
+            eps.append(self._head_transfer_endpoint())
         with self.lock:
             for n in self.object_locations.get(oid, ()):  # remote copies
                 ep = self.node_object_endpoints.get(n)
@@ -1148,13 +1491,15 @@ class Runtime:
             self._dispatch()
         return return_ids
 
-    def create_actor(self, spec: TaskSpec) -> str:
+    def create_actor(self, spec: TaskSpec, owner_did: Optional[str] = None) -> str:
         info = ActorInfo(
             actor_id=spec.actor_id,
             name=spec.actor_name,
             max_restarts=spec.max_restarts,
             creation_spec=spec,
             namespace=spec.actor_namespace or self.namespace,
+            owner_did=owner_did,
+            detached=spec.lifetime == "detached",
         )
         self.state.register_actor(info)
         with self.lock:

@@ -189,6 +189,34 @@ class ShmStore:
         payload, buffers = ser.unpack(memoryview(m))
         return SealedObject(payload, buffers, keepalive=m)
 
+    def _allocate_for_pull(self, object_id: str, total: int):
+        """Arena slot for an incoming pull, or None when the object is (or
+        becomes) sealed.  A PENDING slot usually means ANOTHER LIVE PULLER
+        (workers of one node can race on the same arg ref — each process
+        only serializes its own pulls): deleting it would yank memory out
+        from under its writer, so wait for its seal and only reclaim a slot
+        that stays pending past the transfer deadline (dead puller)."""
+        import time
+
+        try:
+            return self.arena.allocate(object_id, total)
+        except FileExistsError:
+            pass
+        deadline = time.monotonic() + _config.get("object_transfer_timeout_s")
+        while time.monotonic() < deadline:
+            if self.arena.contains(object_id):
+                return None  # concurrent puller sealed it
+            if not self.arena.is_pending(object_id):
+                # slot vanished (freed): take it
+                try:
+                    return self.arena.allocate(object_id, total)
+                except FileExistsError:
+                    continue
+            time.sleep(0.05)
+        # stale PENDING past the transfer deadline: the writer is dead
+        self.arena.delete(object_id)
+        return self.arena.allocate(object_id, total)
+
     def get_raw(self, object_id: str) -> Optional[Tuple[Any, Any]]:
         """(buffer, keepalive) of the PACKED segment bytes, or None.
 
@@ -218,17 +246,11 @@ class ShmStore:
         view = None
         if self._use_arena(object_id):
             try:
-                try:
-                    view = self.arena.allocate(object_id, total)
-                except FileExistsError:
-                    if self.arena.is_pending(object_id):
-                        # stale PENDING slot from a dead puller: reclaim
-                        self.arena.delete(object_id)
-                        view = self.arena.allocate(object_id, total)
-                    else:
-                        for _ in chunks:
-                            pass  # already sealed locally: drain politely
-                        return
+                view = self._allocate_for_pull(object_id, total)
+                if view is None and self.arena.contains(object_id):
+                    for _ in chunks:
+                        pass  # already sealed locally: drain politely
+                    return
             except (MemoryError, RuntimeError):
                 view = None  # fragmentation/poison: file fallback
         if view is not None:

@@ -41,6 +41,9 @@ class TaskSpec:
     max_concurrency: int = 1
     max_restarts: int = 0
     is_async_actor: bool = False
+    # "detached": the actor outlives its creating driver (ray: actor
+    # lifetime option, gcs_actor_manager detached registry).
+    lifetime: Optional[str] = None
     # Retries / recovery (ray: src/ray/core_worker/task_manager.h:90)
     max_retries: int = 0
     retry_exceptions: bool = False

@@ -41,7 +41,7 @@ class WorkerRuntime:
     """
 
     def __init__(self, conn, conn_lock, session_name: str, worker_id: str,
-                 authkey: bytes = b""):
+                 authkey: bytes = b"", store_dir: Optional[str] = None):
         self.conn = conn
         self.conn_lock = conn_lock
         self.worker_id = worker_id
@@ -51,10 +51,14 @@ class WorkerRuntime:
         # default resolves to the head store.  Objects on other nodes are
         # never path-reachable — they arrive via the transfer plane.
         self.shm = ShmStore(
-            session_name, dir_path=os.environ.get("RAY_TPU_STORE_DIR")
+            session_name,
+            dir_path=store_dir or os.environ.get("RAY_TPU_STORE_DIR"),
         )
         self.session_name = session_name
         self._pull_lock = threading.Lock()
+        # Remote (non-co-located) drivers cannot seal into any node store
+        # the cluster can read: their puts always ride the control conn.
+        self.force_inline_puts = False
         self._req_counter = 0
         self._req_lock = threading.Lock()
         self._pending: Dict[int, queue.Queue] = {}
@@ -125,7 +129,12 @@ class WorkerRuntime:
                 payload, bufs = ser.unpack(memoryview(data))
                 return ser.deserialize(payload, bufs, self.ref_factory)
             if kind == "pull":
-                obj = self._pull(object_id, data)
+                remaining = (
+                    None
+                    if deadline is None
+                    else max(deadline - _time.monotonic(), 0.01)
+                )
+                obj = self._pull(object_id, data, remaining)
                 if obj is not None:
                     return obj.deserialize(self.ref_factory)
                 continue  # every endpoint failed: re-ask the owner
@@ -137,19 +146,24 @@ class WorkerRuntime:
 
         raise ObjectLostError(object_id)
 
-    def _pull(self, object_id: str, endpoints):
+    def _pull(self, object_id: str, endpoints, timeout: Optional[float] = None):
         """Fetch a remote copy into this node's store via the transfer
         plane; one pull at a time per worker (pull-manager-style admission
         — concurrent arg resolutions of the same object would race the
-        allocate anyway)."""
+        allocate anyway).  `timeout` carries the caller's remaining get()
+        budget so a user timeout is honored over the transfer default."""
+        from ray_tpu._private import config as _cfg
         from ray_tpu._private.object_plane import pull_from_any
 
+        cap = _cfg.get("object_transfer_timeout_s")
+        timeout = cap if timeout is None else min(timeout, cap)
         with self._pull_lock:
             obj = self.shm.get(object_id)  # a sibling pull may have landed it
             if obj is not None:
                 return obj
             n = pull_from_any(
-                endpoints, self.authkey, object_id, self.shm.create_from_chunks
+                endpoints, self.authkey, object_id, self.shm.create_from_chunks,
+                timeout=timeout,
             )
             if n is None:
                 return None
@@ -164,7 +178,7 @@ class WorkerRuntime:
         payload, buffers, contained = ser.serialize(value)
         size = len(payload) + sum(len(b.raw()) for b in buffers)
         oid = self.request("alloc_object_id", None)
-        if size >= inline_threshold():
+        if size >= inline_threshold() and not self.force_inline_puts:
             packed = self.shm.create(oid, payload, buffers)
             self.request("seal_object", (oid, packed, contained))
         else:
@@ -383,13 +397,61 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
     task_q: "queue.Queue[tuple]" = queue.Queue()
     pool = None  # ThreadPoolExecutor for max_concurrency > 1
 
+    node_id = os.environ.get("RAY_TPU_NODE_ID")
+
+    def try_reconnect() -> bool:
+        """Head conn lost: in head-split mode (reconnect window > 0) retry
+        the head's FIXED address and re-handshake; a restarted head adopts
+        this worker (ray: workers surviving a GCS restart re-register)."""
+        from ray_tpu._private import config as _cfg
+
+        window = _cfg.get("reconnect_window_s")
+        if window <= 0:
+            return False
+        import time as _time
+
+        deadline = _time.monotonic() + window
+        newconn = None
+        while _time.monotonic() < deadline:
+            try:
+                newconn = Client(address, authkey=authkey)
+                break
+            except Exception:
+                _time.sleep(0.5)
+        if newconn is None:
+            return False
+        # Swap AND send the hello under ONE conn_lock hold: a concurrent
+        # oneway/done send slipping between them would become the new
+        # conn's first message and the head's handshake (which expects
+        # "ready") would drop the conn.
+        with conn_lock:
+            try:
+                rt.conn.close()
+            except OSError:
+                pass
+            rt.conn = newconn
+            try:
+                rt.conn.send(("ready", worker_id, os.getpid(), node_id))
+            except OSError:
+                return False  # head bounced again; outer loop re-enters
+        # In-flight request replies died with the old conn: fail them so
+        # blocked callers raise instead of hanging forever.
+        err = ConnectionError("head connection was reset (head restart)")
+        for req_id in list(rt._pending):
+            q = rt._pending.pop(req_id, None)
+            if q is not None:
+                q.put((False, err))
+        return True
+
     def recv_loop():
         nonlocal pool
         while True:
             try:
-                msg = conn.recv()
+                msg = rt.conn.recv()
             except (EOFError, OSError):
-                os._exit(0)
+                if not try_reconnect():
+                    os._exit(0)
+                continue
             kind = msg[0]
             if kind == "reply":
                 rt._on_reply(msg[1], msg[2], msg[3])
@@ -418,8 +480,11 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
             # leaving the caller hanging — exit the process here (the
             # actor_exit oneway was already sent by exit_actor()).
             os._exit(0)
-        with conn_lock:
-            conn.send(done)
+        try:
+            with conn_lock:
+                rt.conn.send(done)
+        except OSError:
+            pass  # head restarting: this result is lost; recv_loop reconnects
 
     threading.Thread(target=recv_loop, daemon=True, name="worker-recv").start()
 
@@ -446,7 +511,7 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
         apply_worker_runtime_env(_json.loads(renv_json), kv_get=_fetch)
 
     with conn_lock:
-        conn.send(("ready", worker_id, os.getpid()))
+        conn.send(("ready", worker_id, os.getpid(), node_id))
 
     while True:
         msg = task_q.get()

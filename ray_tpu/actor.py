@@ -162,6 +162,7 @@ class ActorClass:
             max_concurrency=1,  # creation itself is ordered
             scheduling_strategy=o.get("scheduling_strategy"),
             runtime_env=o.get("runtime_env"),
+            lifetime=o.get("lifetime"),
         )
         client.create_actor(spec)
         return ActorHandle(spec.actor_id, spec.actor_method_names, max_concurrency)
