@@ -91,6 +91,17 @@ _DEFS: Dict[str, tuple] = {
         "The standalone head sets this for its cluster so a head restart "
         "is survivable (ray: gcs_rpc_server_reconnect_timeout_s)",
     ),
+    "log_to_driver": (
+        1, int,
+        "1 = echo worker stdout/stderr lines (prefixed) to the driver/head "
+        "process stdout as they arrive; 0 = files + ring buffers only "
+        "(ray: ray.init(log_to_driver=...))",
+    ),
+    "worker_log_ring_lines": (
+        2000, int,
+        "per-worker ring buffer of recent log lines kept for the logs "
+        "CLI / dashboard endpoint",
+    ),
     "actor_adopt_grace_s": (
         5.0, float,
         "after a head restart, how long restored detached/named actors "

@@ -1,0 +1,146 @@
+"""Log pipeline: per-process log files + tail-to-driver streaming.
+
+ray: python/ray/_private/log_monitor.py:104 — each node runs a monitor
+that tails the session's worker log files and publishes new lines; the
+driver subscribes and prints them prefixed.  Same shape here:
+
+  * every worker's stdout/stderr is redirected AT SPAWN into
+    `<log_dir>/worker-<wid>.out|.err` on its own node (the file outlives
+    the worker — crash output is never lost);
+  * a LogMonitor thread on each node (driver process for head workers,
+    node daemon for its pool) tails those files and forwards fresh lines;
+  * daemon monitors forward over the daemon conn as ("log_lines", wid,
+    stream, lines); the driver prints every line as
+    `(worker-<wid> .err) line` and keeps a bounded ring buffer per worker
+    backing `ray_tpu logs` / the dashboard's /api/logs.
+
+Rate limiting: at most `max_lines_per_poll` lines per file per tick ride
+the wire; a flood is truncated with a marker line rather than stalling the
+control conn (ray: log_monitor's RATE_LIMIT semantics).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+def worker_log_paths(log_dir: str, wid: str) -> Tuple[str, str]:
+    return (
+        os.path.join(log_dir, f"worker-{wid}.out"),
+        os.path.join(log_dir, f"worker-{wid}.err"),
+    )
+
+
+def open_worker_logs(log_dir: str, wid: str):
+    """(stdout_file, stderr_file) ready to hand to Popen."""
+    os.makedirs(log_dir, exist_ok=True)
+    out_path, err_path = worker_log_paths(log_dir, wid)
+    return open(out_path, "ab", buffering=0), open(err_path, "ab", buffering=0)
+
+
+class LogMonitor:
+    """Tails worker-*.out/.err files in one directory.
+
+    sink(wid, stream, lines) is called with decoded, newline-stripped
+    fresh lines; `stream` is "out" or "err".
+    """
+
+    MAX_LINES_PER_POLL = 200
+
+    def __init__(
+        self,
+        log_dir: str,
+        sink: Callable[[str, str, List[str]], None],
+        poll_interval: float = 0.15,
+    ):
+        self.log_dir = log_dir
+        self.sink = sink
+        self.poll_interval = poll_interval
+        self._offsets: Dict[str, int] = {}  # path -> bytes consumed
+        self._partial: Dict[str, bytes] = {}  # path -> trailing unterminated bytes
+        self._stop = threading.Event()
+        # flush() may run from the shutdown thread while the monitor thread
+        # is mid-poll: serialize, or both deliver the same bytes twice.
+        self._poll_lock = threading.Lock()
+        # Files that predate this monitor belong to a PREVIOUS incarnation
+        # (head restart over the same session log dir): start them at EOF —
+        # replaying the whole history to stdout is noise, and the bytes are
+        # still in the files for `ray_tpu logs`.
+        if os.path.isdir(log_dir):
+            for name in os.listdir(log_dir):
+                path = os.path.join(log_dir, name)
+                try:
+                    self._offsets[path] = os.path.getsize(path)
+                except OSError:
+                    pass
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="raytpu-logmon"
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            try:
+                self.poll_once()
+            except Exception:
+                pass  # a vanished file mid-scan is routine
+
+    def poll_once(self) -> None:
+        with self._poll_lock:
+            self._poll_once_locked()
+
+    def _poll_once_locked(self) -> None:
+        if not os.path.isdir(self.log_dir):
+            return
+        for name in sorted(os.listdir(self.log_dir)):
+            if not name.startswith("worker-"):
+                continue
+            stem, _, ext = name.rpartition(".")
+            if ext not in ("out", "err"):
+                continue
+            wid = stem[len("worker-") :]
+            path = os.path.join(self.log_dir, name)
+            self._drain_file(path, wid, ext)
+
+    def _drain_file(self, path: str, wid: str, stream: str) -> None:
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return
+        offset = self._offsets.get(path, 0)
+        if size <= offset:
+            return
+        try:
+            with open(path, "rb") as f:
+                f.seek(offset)
+                data = f.read(size - offset)
+        except OSError:
+            return
+        self._offsets[path] = size
+        data = self._partial.pop(path, b"") + data
+        lines = data.split(b"\n")
+        if lines and lines[-1]:
+            self._partial[path] = lines[-1]  # unterminated tail: hold it
+        lines = lines[:-1]
+        if not lines:
+            return
+        dropped = 0
+        if len(lines) > self.MAX_LINES_PER_POLL:
+            dropped = len(lines) - self.MAX_LINES_PER_POLL
+            lines = lines[: self.MAX_LINES_PER_POLL]
+        decoded = [ln.decode("utf-8", "replace") for ln in lines]
+        if dropped:
+            decoded.append(f"... {dropped} lines rate-limited by log monitor ...")
+        self.sink(wid, stream, decoded)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def flush(self) -> None:
+        """One synchronous drain (shutdown path: don't lose final lines)."""
+        try:
+            self.poll_once()
+        except Exception:
+            pass

@@ -25,6 +25,7 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 from typing import Dict
 
 
@@ -44,6 +45,9 @@ def _build_worker_env(
             "RAY_TPU_AUTHKEY": authkey_hex,
             "RAY_TPU_WORKER_ID": wid,
             "RAY_TPU_SESSION": session,
+            # Log files are block-buffered without this: prints must land
+            # promptly for the monitor to forward them.
+            "PYTHONUNBUFFERED": "1",
             # This node's store, NOT the session default: workers seal into
             # and read from their own node's directory only.
             "RAY_TPU_STORE_DIR": store_dir,
@@ -92,6 +96,11 @@ def main() -> None:
     obj_server = ObjectServer(
         store.get_raw, authkey, advertise_host=_config.get("node_ip")
     )
+    # This node's log dir: workers' stdout/stderr land here; the monitor
+    # below tails the files and forwards fresh lines to the head
+    # (ray: per-node log_monitor.py publishing to the driver).
+    log_dir = f"/tmp/raytpu-logs-{session}-{node_id}"
+    send_lock = threading.Lock()
 
     def connect():
         c = Client((host, port), authkey=authkey)
@@ -129,6 +138,17 @@ def main() -> None:
 
     conn = connect()
 
+    def forward_logs(wid, stream, lines):
+        try:
+            with send_lock:
+                conn.send(("log_lines", wid, stream, lines))
+        except OSError:
+            pass  # head away (restart window); lines stay in the files
+
+    from ray_tpu._private.log_monitor import LogMonitor, open_worker_logs
+
+    log_monitor = LogMonitor(log_dir, forward_logs)
+
     children: Dict[str, subprocess.Popen] = {}
 
     def shutdown(*_a):
@@ -145,12 +165,25 @@ def main() -> None:
                     p.kill()
                 except OSError:
                     pass
+        try:
+            log_monitor.flush()  # last lines (incl. crash output) reach head
+            log_monitor.stop()
+        except Exception:
+            pass
         obj_server.close()
         store.destroy()
         sys.exit(0)
 
-    signal.signal(signal.SIGTERM, shutdown)
-    signal.signal(signal.SIGINT, shutdown)
+    # Signal handlers only set a flag: shutdown() flushes logs through
+    # send_lock, and a handler interrupting a frame that already holds it
+    # (reap's send) would self-deadlock on the non-reentrant lock.
+    stop_flag = {"stop": False}
+
+    def _request_stop(*_a):
+        stop_flag["stop"] = True
+
+    signal.signal(signal.SIGTERM, _request_stop)
+    signal.signal(signal.SIGINT, _request_stop)
 
     def reap() -> None:
         """Collect exited children (no zombies) and report them — the
@@ -161,11 +194,15 @@ def main() -> None:
             if rc is not None:
                 children.pop(wid, None)
                 try:
-                    conn.send(("worker_exited", wid, rc))
+                    with send_lock:
+                        conn.send(("worker_exited", wid, rc))
                 except OSError:
                     pass
 
     while True:
+        if stop_flag["stop"]:
+            shutdown()
+            return
         try:
             has_msg = conn.poll(0.5)
         except (EOFError, OSError):
@@ -193,11 +230,18 @@ def main() -> None:
             env = _build_worker_env(
                 wid, host, port, authkey_hex, session, renv, store_dir, node_id
             )
-            children[wid] = subprocess.Popen(
-                [sys.executable, "-m", "ray_tpu._private.worker_proc"],
-                env=env,
-                close_fds=True,
-            )
+            outf, errf = open_worker_logs(log_dir, wid)
+            try:
+                children[wid] = subprocess.Popen(
+                    [sys.executable, "-m", "ray_tpu._private.worker_proc"],
+                    env=env,
+                    close_fds=True,
+                    stdout=outf,
+                    stderr=errf,
+                )
+            finally:
+                outf.close()
+                errf.close()
         elif kind == "kill_worker":
             p = children.get(msg[1])
             if p is not None:

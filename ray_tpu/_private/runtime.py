@@ -380,6 +380,17 @@ class Runtime:
         # named/detached actors, KV, functions, PGs, object directory.
         self.snapshot_path = snapshot_path
         self._restored_actors: Set[str] = set()
+        # Log pipeline (ray: log_monitor.py + driver print subscriber):
+        # head workers' stdout/stderr redirect into per-worker files under
+        # log_dir; a LogMonitor tails them (daemons tail their own nodes
+        # and forward over their conns); every line lands in a per-worker
+        # ring buffer (CLI/dashboard) and echoes to this process's stdout.
+        self.log_dir = f"/tmp/raytpu-logs-{self.session_name}"
+        self.worker_logs: Dict[str, deque] = {}
+        self.log_to_driver = _config.get("log_to_driver") != 0
+        from ray_tpu._private.log_monitor import LogMonitor
+
+        self._log_monitor = LogMonitor(self.log_dir, self._on_log_lines)
         if snapshot_path:
             self._restore_snapshot()
             threading.Thread(
@@ -406,6 +417,36 @@ class Runtime:
                 )
             ):
                 self._spawn_worker(self.head_node_id, None, None, prestart=True)
+
+    # ------------------------------------------------------------------
+    # log pipeline (ray: log_monitor.py + worker print redirection)
+
+    def _on_log_lines(self, wid: str, stream: str, lines: List[str]) -> None:
+        from ray_tpu._private import config as _config
+
+        buf = self.worker_logs.get(wid)
+        if buf is None:
+            buf = self.worker_logs.setdefault(
+                wid, deque(maxlen=_config.get("worker_log_ring_lines"))
+            )
+        buf.extend(lines)
+        if self.log_to_driver:
+            prefix = f"({wid}" + (" .err) " if stream == "err" else ") ")
+            out = "".join(prefix + ln + "\n" for ln in lines)
+            try:
+                import sys
+
+                sys.stdout.write(out)
+                sys.stdout.flush()
+            except (OSError, ValueError):
+                pass  # driver stdout closed (interpreter teardown)
+
+    def get_logs(self, wid: str, n: Optional[int] = None) -> List[str]:
+        buf = self.worker_logs.get(wid)
+        if buf is None:
+            return []
+        lines = list(buf)
+        return lines[-n:] if n else lines
 
     # ------------------------------------------------------------------
     # control-plane persistence (ray: gcs storage + gcs_actor_manager
@@ -749,6 +790,9 @@ class Runtime:
         extra = {
             "RAY_TPU_WORKER_ID": wid,
             "RAY_TPU_SESSION": self.session_name,
+            # stdout redirects to a log file (block-buffered by default):
+            # unbuffered, or prints sit invisible until the worker exits.
+            "PYTHONUNBUFFERED": "1",
             # Head-node workers share the HEAD store (explicit, so a
             # RAY_TPU_STORE_DIR inherited from any outer environment can
             # never leak a foreign node's store into these workers).
@@ -759,11 +803,20 @@ class Runtime:
         # runtime_env vars must exist at interpreter start (sitecustomize may
         # import jax before worker_main applies them).
         env.update({k: str(v) for k, v in env_vars.items()})
-        popen = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker_proc"],
-            env=env,
-            close_fds=True,
-        )
+        from ray_tpu._private.log_monitor import open_worker_logs
+
+        outf, errf = open_worker_logs(self.log_dir, wid)
+        try:
+            popen = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.worker_proc"],
+                env=env,
+                close_fds=True,
+                stdout=outf,
+                stderr=errf,
+            )
+        finally:
+            outf.close()  # the child holds its own dups; files outlive it
+            errf.close()
         proc = _PopenHandle(popen)
         handle = WorkerHandle(wid, node_id, env_key, renv, proc)
         self.workers[wid] = handle
@@ -1032,6 +1085,11 @@ class Runtime:
                             self._conn_to_daemon.pop(conn, None)
                             self._on_daemon_death(nid)
                         continue
+                    if isinstance(dmsg, tuple) and dmsg and dmsg[0] == "log_lines":
+                        # A remote node's monitor forwarded fresh worker
+                        # output: same sink as head-local files.
+                        self._on_log_lines(dmsg[1], dmsg[2], dmsg[3])
+                        continue
                     if isinstance(dmsg, tuple) and dmsg and dmsg[0] == "worker_exited":
                         # A remote child died (possibly before connecting):
                         # the driver-side reaper can't see it, the daemon can.
@@ -1224,6 +1282,8 @@ class Runtime:
             return self.cluster_resources()
         if op == "available_resources":
             return self.available_resources()
+        if op == "get_logs":
+            return self.get_logs(*payload)
         raise ValueError(f"unknown op {op}")
 
     def _req_get_object(self, wid: str, req_id: int, oid: str):
@@ -2167,6 +2227,13 @@ class Runtime:
         self._shutdown = True
         atexit.unregister(self.shutdown)
         set_ref_hooks(None, None)
+        # Final log drain: crash output written moments ago must reach the
+        # ring buffers/stdout before the session dies.
+        try:
+            self._log_monitor.flush()
+            self._log_monitor.stop()
+        except Exception:
+            pass
         for nid in list(self.node_daemons):
             self._daemon_send(nid, ("shutdown",))
         for proc in self._daemon_procs.values():

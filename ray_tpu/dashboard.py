@@ -51,6 +51,20 @@ def timeline() -> list:
     return out
 
 
+def _logs_endpoint(worker=None, tail: int = 0, query=None):
+    """Per-worker captured output (ray: dashboard log index + `ray logs`).
+    Without ?worker=, lists workers that have log lines."""
+    from ray_tpu._private.runtime import get_runtime
+
+    if query:
+        worker = query.get("worker", [worker])[0]
+        tail = int(query.get("tail", [tail])[0])
+    rt = get_runtime()
+    if worker is None:
+        return {"workers": sorted(rt.worker_logs)}
+    return {"worker": worker, "lines": rt.get_logs(worker, tail or None)}
+
+
 class Dashboard:
     """Embeddable dashboard server (one per driver)."""
 
@@ -67,6 +81,7 @@ class Dashboard:
             "/api/metrics": state_api.cluster_metrics,
             "/api/summary": state_api.summarize_tasks,
             "/api/timeline": timeline,
+            "/api/logs": _logs_endpoint,
         }
 
         class Handler(BaseHTTPRequestHandler):
@@ -74,7 +89,10 @@ class Dashboard:
                 pass
 
             def do_GET(self):
-                fn = routes.get(self.path.split("?")[0])
+                from urllib.parse import parse_qs, urlparse
+
+                parsed = urlparse(self.path)
+                fn = routes.get(parsed.path)
                 if fn is None:
                     body = json.dumps(
                         {"error": "unknown route", "routes": sorted(routes)}
@@ -82,7 +100,15 @@ class Dashboard:
                     code = 404
                 else:
                     try:
-                        body = json.dumps(fn(), default=str).encode()
+                        # Query-aware endpoints declare a `query` kwarg;
+                        # the rest are called bare — ONE response tail.
+                        import inspect
+
+                        if "query" in inspect.signature(fn).parameters:
+                            out = fn(query=parse_qs(parsed.query))
+                        else:
+                            out = fn()
+                        body = json.dumps(out, default=str).encode()
                         code = 200
                     except Exception as e:  # noqa: BLE001 — HTTP boundary
                         body = json.dumps({"error": repr(e)}).encode()

@@ -62,6 +62,39 @@ def cmd_job_submit(args) -> int:
     return 0 if status == "SUCCEEDED" else 1
 
 
+def cmd_logs(args) -> int:
+    """Dump a worker's captured stdout/stderr lines (ray: `ray logs`).
+    With --actor, resolve the named actor's current worker first."""
+    import ray_tpu
+    from ray_tpu._private.worker_proc import get_worker_runtime
+
+    ray_tpu.init(
+        ignore_reinit_error=True,
+        address=args.address if getattr(args, "address", None) else None,
+    )
+    wid = args.worker
+    if args.actor:
+        from ray_tpu._private.runtime import get_runtime
+
+        wr = get_worker_runtime()
+        if wr is not None:
+            raise SystemExit("--actor lookup requires a head-local driver")
+        rt = get_runtime()
+        info = rt.state.get_named_actor(args.actor, rt.namespace)
+        if info is None or not info.worker_id:
+            raise SystemExit(f"no live worker for actor {args.actor!r}")
+        wid = info.worker_id
+    wr = get_worker_runtime()
+    if wr is not None:  # attached driver: ask the head
+        lines = wr.request("get_logs", (wid, args.tail))
+    else:
+        from ray_tpu._private.runtime import get_runtime
+
+        lines = get_runtime().get_logs(wid, args.tail)
+    sys.stdout.write("\n".join(lines) + ("\n" if lines else ""))
+    return 0
+
+
 def cmd_bench(args) -> int:
     import os
     import subprocess
@@ -94,6 +127,13 @@ def main(argv=None) -> int:
     js.add_argument("entrypoint", nargs="+")
     js.add_argument("--timeout", type=float, default=3600.0)
     js.set_defaults(fn=cmd_job_submit)
+
+    lg = sub.add_parser("logs", help="dump a worker's captured output")
+    lg.add_argument("worker", nargs="?", help="worker id")
+    lg.add_argument("--actor", help="named actor: dump its worker's logs")
+    lg.add_argument("--tail", type=int, default=0, help="last N lines only")
+    lg.add_argument("--address", help="head.json path (attached mode)")
+    lg.set_defaults(fn=cmd_logs)
 
     be = sub.add_parser("bench", help="run the train benchmark (bench.py)")
     be.set_defaults(fn=cmd_bench)
