@@ -1,12 +1,27 @@
 """Dataset: lazy, distributed, block-based data pipelines.
 
 ray: python/ray/data/dataset.py:163 (Dataset; map_batches :373, repartition
-:969, random_shuffle :1008, split :1144, iter_batches :2875) with the plan/
-executor split of _internal/plan.py + streaming_executor.py:34, collapsed to
-one pull-based engine: one-to-one stages run as one task per block
-(pipelined, submitted all at once — the object store is the inter-stage
-buffer); all-to-all stages (repartition/shuffle/sort/groupby) are barrier
-points implemented as two-phase task graphs (partition map + reduce).
+:969, random_shuffle :1008, split :1144, iter_batches :2875) with the
+execution model of _internal/plan.py + streaming_executor.py:34:
+
+  * transforms are LAZY — each one-to-one stage (map/flat_map/filter/
+    map_batches) only appends an op to the dataset's pending chain;
+    nothing runs until a consumer asks;
+  * at execution the whole pending chain FUSES into ONE task per block
+    (ray: _internal/planner's MapOperator fusion) — a .map().filter()
+    .map_batches() pipeline over N blocks launches exactly N tasks;
+  * all-to-all stages (repartition/shuffle/sort/groupby) are barrier
+    points built as two-phase task graphs (partition map + reduce); for
+    shuffle/sort/groupby the pending map chain fuses INTO the partition
+    map phase — one task per input block, no intermediate block between
+    map chain and shuffle (ray: _internal/push_based_shuffle.py).
+    repartition/split(equal=True)/union need global row counts first, so
+    they materialize the fused chain before slicing (a barrier, like the
+    reference's count-based repartition);
+  * consumption streams: iter_batches/iter_rows submit fused block tasks
+    through a bounded in-flight window (backpressure — the driver holds at
+    most `prefetch_blocks` unconsumed blocks), overlapping production with
+    training-side consumption (ray: streaming_executor backpressure).
 
 TPU-relevant: iter_batches yields numpy-dict batches sized for the training
 step, and split() hands each SPMD host-worker an equal set of blocks
@@ -42,8 +57,8 @@ def _batch_output_to_block(out) -> Block:
     return batch_to_rows(out)
 
 
-@ray_tpu.remote
-def _map_block(block: Block, fn_kind: str, fn: Callable, batch_format: str, batch_size):
+def _apply_op(block: Block, op: tuple) -> Block:
+    fn_kind, fn, batch_format, batch_size = op
     if fn_kind == "rows":
         return [fn(r) for r in block]
     if fn_kind == "flat":
@@ -66,11 +81,25 @@ def _map_block(block: Block, fn_kind: str, fn: Callable, batch_format: str, batc
 
 
 @ray_tpu.remote
-def _partition_block(block: Block, n: int, key_fn, seed) -> List[Block]:
-    """Map phase of all-to-all ops: split one block into n shards.
+def _fused_map_block(block: Block, ops: List[tuple]) -> Block:
+    """The fused stage executor: the WHOLE pending one-to-one chain runs
+    in one task, block stays in this worker's memory between ops — no
+    inter-stage object-store round trips (ray: fused MapOperator)."""
+    for op in ops:
+        block = _apply_op(block, op)
+    return block
+
+
+@ray_tpu.remote
+def _partition_block(block: Block, ops: List[tuple], n: int, key_fn, seed) -> List[Block]:
+    """Map phase of all-to-all ops: apply the fused upstream chain, then
+    split the block into n shards — the pre-shuffle map pipeline never
+    materializes separately (ray: push_based_shuffle map stage).
 
     key_fn=None randomly scatters rows — used ONLY by random_shuffle;
     repartition/split use order-preserving contiguous ranges instead."""
+    for op in ops:
+        block = _apply_op(block, op)
     shards: List[Block] = [[] for _ in range(n)]
     if key_fn is None:
         rng = random.Random(seed)
@@ -107,7 +136,9 @@ def _merge_shuffle(seed, *shards: Block) -> Block:
 
 
 @ray_tpu.remote
-def _sort_block(block: Block, key, descending: bool) -> Block:
+def _sort_block(block: Block, ops: List[tuple], key, descending: bool) -> Block:
+    for op in ops:
+        block = _apply_op(block, op)
     return sorted(block_rows(block), key=key, reverse=descending)
 
 
@@ -122,21 +153,79 @@ def _merge_sorted(key, descending: bool, *blocks: Block) -> Block:
 
 
 class Dataset:
-    """A list of block object-refs + lazily applied stages."""
+    """Base block refs + a pending (unsubmitted) one-to-one op chain."""
 
-    def __init__(self, block_refs: List[Any]):
-        self._block_refs = list(block_refs)
+    def __init__(self, block_refs: List[Any], _ops: Optional[List[tuple]] = None):
+        self._base_refs = list(block_refs)
+        self._ops: List[tuple] = list(_ops or [])
+        self._executed: Optional[List[Any]] = None  # memoized fused refs
+        # Per-block memo of already-submitted fused tasks: repeated /
+        # partial consumption (multi-epoch iter_batches, take then
+        # take_all) reuses each block's result instead of re-running the
+        # chain — also keeps nondeterministic fns consistent across reads.
+        self._submitted: Dict[int, Any] = {}
 
     # -- constructors (see read_api.py) -----------------------------------
 
-    # -- transforms (one-to-one, lazy-ish: submitted immediately, results
-    #    are refs so nothing blocks until consumed) ------------------------
+    # -- plan execution ----------------------------------------------------
+
+    @property
+    def _block_refs(self) -> List[Any]:
+        """Executed block refs (kept as a property: lots of internal and
+        library code consumes `ds._block_refs`)."""
+        return self._execute()
+
+    def _submit_block(self, i: int, ops: List[tuple]) -> Any:
+        ref = self._submitted.get(i)
+        if ref is None:
+            ref = _fused_map_block.remote(self._base_refs[i], ops)
+            self._submitted[i] = ref
+        return ref
+
+    def _execute(self) -> List[Any]:
+        """Submit the fused chain — ONE task per block — and memoize."""
+        if self._executed is None:
+            if not self._ops:
+                self._executed = list(self._base_refs)
+            else:
+                ops = list(self._ops)
+                self._executed = [
+                    self._submit_block(i, ops) for i in range(len(self._base_refs))
+                ]
+        return self._executed
+
+    def _stream_refs(self, window: int) -> Iterator[Any]:
+        """Streaming execution with backpressure: at most `window` fused
+        block tasks are submitted-but-unconsumed at any moment, so a huge
+        dataset never floods the store ahead of the consumer
+        (ray: streaming_executor.py:34 bounded-resource semantics)."""
+        if self._executed is not None or not self._ops:
+            yield from self._execute()
+            return
+        from collections import deque as _deque
+
+        ops = list(self._ops)
+        inflight: "_deque[Any]" = _deque()
+        for i in range(len(self._base_refs)):
+            if len(inflight) >= window:
+                yield inflight.popleft()
+            inflight.append(self._submit_block(i, ops))
+        while inflight:
+            yield inflight.popleft()
+        if len(self._submitted) == len(self._base_refs):
+            self._executed = [
+                self._submitted[i] for i in range(len(self._base_refs))
+            ]
+
+    # -- transforms (one-to-one, LAZY: recorded, fused at execution) -------
     def _map_stage(self, fn_kind: str, fn: Callable, batch_format="numpy", batch_size=None) -> "Dataset":
-        refs = [
-            _map_block.remote(b, fn_kind, fn, batch_format, batch_size)
-            for b in self._block_refs
-        ]
-        return Dataset(refs)
+        return Dataset(
+            self._executed if self._executed is not None else self._base_refs,
+            _ops=(
+                ([] if self._executed is not None else self._ops)
+                + [(fn_kind, fn, batch_format, batch_size)]
+            ),
+        )
 
     def map(self, fn: Callable) -> "Dataset":
         return self._map_stage("rows", fn)
@@ -191,14 +280,23 @@ class Dataset:
         new_refs = [_merge_shards.remote(*g) if g else ray_tpu.put([]) for g in groups]
         return Dataset(new_refs)
 
+    def _fusable_inputs(self) -> Tuple[List[Any], List[tuple]]:
+        """(input refs, pending op chain) for fusing into an all-to-all
+        map phase without a separate materialization."""
+        if self._executed is not None:
+            return self._executed, []
+        return self._base_refs, list(self._ops)
+
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
         """ray: dataset.py:1008; two-phase push-based shuffle
-        (ray: _internal/push_based_shuffle.py)."""
-        n = max(len(self._block_refs), 1)
+        (ray: _internal/push_based_shuffle.py).  The pending map chain
+        fuses into the partition phase: one task per input block total."""
+        refs, ops = self._fusable_inputs()
+        n = max(len(refs), 1)
         base = seed if seed is not None else random.randrange(2**31)
         parts = [
-            _partition_block.options(num_returns=n).remote(b, n, None, base + i)
-            for i, b in enumerate(self._block_refs)
+            _partition_block.options(num_returns=n).remote(b, ops, n, None, base + i)
+            for i, b in enumerate(refs)
         ]
         new_refs = [
             _merge_shuffle.remote(base + 7919 + i, *[parts[j][i] for j in range(len(parts))])
@@ -207,7 +305,8 @@ class Dataset:
         return Dataset(new_refs)
 
     def sort(self, key: Optional[Callable] = None, descending: bool = False) -> "Dataset":
-        sorted_refs = [_sort_block.remote(b, key, descending) for b in self._block_refs]
+        refs, ops = self._fusable_inputs()
+        sorted_refs = [_sort_block.remote(b, ops, key, descending) for b in refs]
         return Dataset([_merge_sorted.remote(key, descending, *sorted_refs)])
 
     def groupby_aggregate(
@@ -216,9 +315,10 @@ class Dataset:
         """Hash-partition by key, then aggregate per partition (simplified
         GroupedData — ray: python/ray/data/grouped_data.py)."""
         n = num_partitions
+        refs, ops = self._fusable_inputs()
         parts = [
-            _partition_block.options(num_returns=n).remote(b, n, key_fn, None)
-            for b in self._block_refs
+            _partition_block.options(num_returns=n).remote(b, ops, n, key_fn, None)
+            for b in refs
         ]
         merged = [
             _merge_shards.remote(*[parts[j][i] for j in range(len(parts))])
@@ -234,6 +334,8 @@ class Dataset:
         return Dataset(merged)._map_stage("block", agg)
 
     def union(self, *others: "Dataset") -> "Dataset":
+        """Execution barrier: operands' fused chains are submitted here
+        (their op chains differ, so they cannot share one pending chain)."""
         refs = list(self._block_refs)
         for o in others:
             refs.extend(o._block_refs)
@@ -263,7 +365,9 @@ class Dataset:
 
     def take(self, limit: int = 20) -> List[Any]:
         out: List[Any] = []
-        for b in self._block_refs:
+        # Streamed with a small window: taking 20 rows of a huge lazy
+        # pipeline runs a handful of block tasks, not all of them.
+        for b in self._stream_refs(window=2):
             rows = block_rows(ray_tpu.get(b))
             out.extend(rows[: limit - len(out)])
             if len(out) >= limit:
@@ -287,14 +391,15 @@ class Dataset:
         return None
 
     def num_blocks(self) -> int:
-        return len(self._block_refs)
+        return len(self._base_refs)
 
     def materialize(self) -> "Dataset":
-        ray_tpu.wait(self._block_refs, num_returns=len(self._block_refs), timeout=None)
+        refs = self._execute()
+        ray_tpu.wait(refs, num_returns=len(refs), timeout=None)
         return self
 
     def iter_rows(self) -> Iterator[Any]:
-        for b in self._block_refs:
+        for b in self._stream_refs(window=4):
             yield from block_rows(ray_tpu.get(b))
 
     def iter_batches(
@@ -303,8 +408,11 @@ class Dataset:
         batch_size: int = 256,
         batch_format: str = "numpy",
         drop_last: bool = False,
+        prefetch_blocks: int = 4,
     ) -> Iterator[Any]:
-        """Streaming consumption: blocks are fetched as needed, carry-over
+        """Streaming consumption: fused block tasks are submitted through a
+        bounded window of `prefetch_blocks` (backpressure — production
+        overlaps consumption without flooding the store), carry-over
         stitches batch boundaries across blocks (ray: dataset.py:2875 /
         streaming_executor.py:34).  Columnar blocks slice without row
         materialization — the batches handed to device_put are the stored
@@ -313,7 +421,7 @@ class Dataset:
         mmap, no driver round-trip)."""
         carry: List[Block] = []
         carry_len = 0
-        for b in self._block_refs:
+        for b in self._stream_refs(window=max(prefetch_blocks, 1)):
             blk = ray_tpu.get(b)
             if block_len(blk) == 0:
                 continue
@@ -335,7 +443,10 @@ class Dataset:
         return BlockAccessor(self.take_all()).to_batch("pandas")
 
     def stats(self) -> str:
-        return f"Dataset(num_blocks={self.num_blocks()})"
+        return self.__repr__()
 
     def __repr__(self):
-        return f"Dataset(num_blocks={len(self._block_refs)})"
+        # repr must not trigger execution (a lazy pipeline printed in a
+        # debugger should stay lazy).
+        extra = f", pending_ops={len(self._ops)}" if self._ops else ""
+        return f"Dataset(num_blocks={len(self._base_refs)}{extra})"

@@ -173,3 +173,92 @@ def test_from_pandas_to_pandas(rt):
     ds = rd.from_pandas(df, parallelism=2)
     out = ds.to_pandas()
     assert sorted(out["a"].tolist()) == [1, 2, 3]
+
+
+# -- engine v2: lazy plan + fusion + streaming (ray: _internal/plan.py
+# fusion, streaming_executor.py backpressure) --------------------------------
+
+
+def _tasks_submitted():
+    from ray_tpu._private.runtime import get_runtime
+
+    return get_runtime().metrics["tasks_submitted"]
+
+
+def test_transforms_are_lazy(rt):
+    ds = rd.range(64, parallelism=8)
+    before = _tasks_submitted()
+    ds2 = ds.map(lambda x: x + 1).filter(lambda x: x % 2 == 0).map(lambda x: x * 3)
+    assert _tasks_submitted() == before, "transform recording submitted tasks"
+    assert "pending_ops=3" in repr(ds2)
+
+
+def test_map_chain_fuses_to_one_task_per_block(rt):
+    ds = rd.range(64, parallelism=8)
+    chain = (
+        ds.map(lambda x: x + 1)
+        .map_batches(lambda b: {"v": b["value"] * 2} if isinstance(b, dict) else b)
+        .filter(lambda r: True)
+        .map(lambda r: r)
+    )
+    before = _tasks_submitted()
+    chain._execute()
+    assert _tasks_submitted() - before == 8, "4-stage chain must fuse to 8 tasks"
+    # result correctness through the fused path
+    vals = sorted(v["v"] if isinstance(v, dict) else v for v in chain.take_all())
+    assert vals == sorted((x + 1) * 2 for x in range(64))
+
+
+def test_map_chain_fuses_into_shuffle_map_phase(rt):
+    ds = rd.range(40, parallelism=4)
+    before = _tasks_submitted()
+    out = ds.map(lambda x: x * 10).random_shuffle(seed=7)
+    submitted = _tasks_submitted() - before
+    # 4 fused map+partition tasks + 4 merge tasks — no separate map stage.
+    assert submitted == 8, f"expected 8 tasks (4 part + 4 merge), got {submitted}"
+    assert sorted(out.take_all()) == [x * 10 for x in range(40)]
+
+
+def test_streaming_backpressure_bounds_inflight(rt):
+    ds = rd.range(120, parallelism=12).map(lambda x: x + 1)
+    before = _tasks_submitted()
+    it = ds.iter_batches(batch_size=10, prefetch_blocks=2)
+    first = next(it)
+    submitted = _tasks_submitted() - before
+    assert submitted <= 4, (
+        f"window=2 should have submitted <=4 block tasks before the first "
+        f"batch, saw {submitted}"
+    )
+    n = len(first["value"]) if isinstance(first, dict) else len(first)
+    total = n + sum(
+        len(b["value"]) if isinstance(b, dict) else len(b) for b in it
+    )
+    assert total == 120
+
+
+def test_streaming_overlaps_production_with_consumption(rt):
+    import time as _t
+
+    def slow(x):
+        _t.sleep(0.25)
+        return x
+
+    ds = rd.range(8, parallelism=8).map(slow)
+    t0 = _t.monotonic()
+    it = ds.iter_batches(batch_size=1, prefetch_blocks=3)
+    next(it)
+    first_latency = _t.monotonic() - t0
+    list(it)
+    total = _t.monotonic() - t0
+    # With 4 CPUs and window 3 the first batch cannot be gated on all 8
+    # slow blocks (which serially would be ~2s).
+    assert first_latency < total, "no overlap: first batch waited for everything"
+    assert first_latency < 1.5, f"first batch took {first_latency:.2f}s"
+
+
+def test_take_executes_few_blocks(rt):
+    ds = rd.range(1000, parallelism=100).map(lambda x: x)
+    before = _tasks_submitted()
+    rows = ds.take(5)
+    assert rows == [0, 1, 2, 3, 4]
+    assert _tasks_submitted() - before <= 4, "take(5) should not run 100 tasks"
