@@ -180,7 +180,10 @@ class CoreClient:
         else:
             self._rt().kill_actor(actor_id, no_restart)
 
-    def get_named_actor(self, name: str, namespace: Optional[str]) -> Tuple[str, List[str]]:
+    def get_named_actor(
+        self, name: str, namespace: Optional[str]
+    ) -> Tuple[str, List[str], int]:
+        """(actor_id, method_names, actor_max_concurrency)."""
         wr = self._wr()
         if wr is not None:
             return wr.request("get_actor_named", (name, namespace))

@@ -55,6 +55,9 @@ def attach(
         port = int(port)
 
     conn = Client((host, port), authkey=key)
+    from ray_tpu._private.netutil import set_nodelay
+
+    set_nodelay(conn)
     did = ids._fresh("drv")
     conn.send(("driver", did, os.getpid()))
     ack = conn.recv()

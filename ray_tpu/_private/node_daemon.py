@@ -102,8 +102,11 @@ def main() -> None:
     log_dir = f"/tmp/raytpu-logs-{session}-{node_id}"
     send_lock = threading.Lock()
 
+    from ray_tpu._private.netutil import set_nodelay
+
     def connect():
         c = Client((host, port), authkey=authkey)
+        set_nodelay(c)
         c.send(
             (
                 "daemon",

@@ -115,6 +115,8 @@ class ObjectServer:
         self._thread.start()
 
     def _accept_loop(self) -> None:
+        from ray_tpu._private.netutil import set_nodelay
+
         while not self._shutdown:
             try:
                 conn = self.listener.accept()
@@ -122,6 +124,7 @@ class ObjectServer:
                 if self._shutdown:
                     return
                 continue
+            set_nodelay(conn)
             threading.Thread(
                 target=self._serve_one, args=(conn,), daemon=True,
                 name="raytpu-objserve-conn",
@@ -152,6 +155,7 @@ def _connect_with_deadline(endpoint: Tuple[str, int], authkey: bytes, timeout: f
 
     s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     try:
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         s.settimeout(max(timeout, 0.01))
         s.connect(tuple(endpoint))
     except BaseException:

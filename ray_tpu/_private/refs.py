@@ -66,9 +66,13 @@ class ObjectRef:
         # ObjectRefs before pickle ever sees them (see serialization.py).
         return (ObjectRef, (self._id, self._owner))
 
-    # Allow `await ref` when used inside async actors / serve replicas.
+    # Allow `await ref` anywhere async code runs — the driver, async
+    # actors, serve replicas, attached drivers (the async handle API rides
+    # on this: `await handle.remote(...)`).
     def __await__(self):
-        from ray_tpu._private.runtime import get_runtime
+        import asyncio
 
-        rt = get_runtime()
-        return rt.get_async(self).__await__()
+        from ray_tpu._private.client import client
+
+        loop = asyncio.get_running_loop()
+        return loop.run_in_executor(None, client.get, self).__await__()

@@ -870,6 +870,8 @@ class Runtime:
         # "ready" on its main conn, so a serial accept loop would deadlock
         # (blocked recv'ing the main conn's handshake while the fetch conn
         # waits for service).
+        from ray_tpu._private.netutil import set_nodelay
+
         while not self._shutdown:
             try:
                 conn = self.listener.accept()
@@ -877,6 +879,7 @@ class Runtime:
                 if self._shutdown:
                     return
                 continue
+            set_nodelay(conn)
             threading.Thread(
                 target=self._handshake, args=(conn,), daemon=True,
                 name="raytpu-handshake",
@@ -1245,7 +1248,12 @@ class Runtime:
             info = self.state.get_named_actor(name, nsp or self.namespace)
             if info is None or info.state == DEAD:
                 raise ValueError(f"no actor named {name!r}")
-            return (info.actor_id, info.creation_spec.actor_method_names or [])
+            spec = info.creation_spec
+            return (
+                info.actor_id,
+                spec.actor_method_names or [],
+                getattr(spec, "actor_max_concurrency", 1),
+            )
         if op == "actor_state":
             info = self.state.get_actor(payload)
             return info.state if info else None
@@ -2074,12 +2082,6 @@ class Runtime:
             eps, self._authkey, oid, self.store.ingest_packed
         )
         return n is not None
-
-    async def get_async(self, ref: ObjectRef):
-        import asyncio
-
-        loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(None, self.get, ref)
 
     def wait_refs(self, refs, num_returns=1, timeout=None):
         oids = [r.id for r in refs]

@@ -39,6 +39,11 @@ class TaskSpec:
     parent_task_id: Optional[str] = None
     actor_method_names: Optional[List[str]] = None
     max_concurrency: int = 1
+    # The ACTOR's method concurrency (creation tasks run ordered with
+    # max_concurrency=1, so the actor-wide setting needs its own field —
+    # named-actor lookups return it so a get_actor() handle schedules onto
+    # the same executor as the creator's handle).
+    actor_max_concurrency: int = 1
     max_restarts: int = 0
     is_async_actor: bool = False
     # "detached": the actor outlives its creating driver (ray: actor

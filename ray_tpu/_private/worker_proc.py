@@ -375,6 +375,9 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
     watchdog.start()
     conn = Client(address, authkey=authkey)
     watchdog.cancel()
+    from ray_tpu._private.netutil import set_nodelay
+
+    set_nodelay(conn)
     conn_lock = threading.Lock()
     rt = WorkerRuntime(conn, conn_lock, session_name, worker_id, authkey=authkey)
     _runtime = rt
@@ -415,6 +418,7 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
         while _time.monotonic() < deadline:
             try:
                 newconn = Client(address, authkey=authkey)
+                set_nodelay(newconn)
                 break
             except Exception:
                 _time.sleep(0.5)

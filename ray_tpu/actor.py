@@ -125,8 +125,8 @@ class ActorClass:
         name = o.get("name")
         if name and o.get("get_if_exists"):
             try:
-                aid, methods = client.get_named_actor(name, o.get("namespace"))
-                return ActorHandle(aid, methods, o.get("max_concurrency", 1))
+                aid, methods, mc = client.get_named_actor(name, o.get("namespace"))
+                return ActorHandle(aid, methods, mc)
             except Exception:
                 pass
         cls_id = self._ensure_exported()
@@ -160,6 +160,7 @@ class ActorClass:
             actor_method_names=_public_methods(self._cls),
             max_restarts=int(o.get("max_restarts", 0)),
             max_concurrency=1,  # creation itself is ordered
+            actor_max_concurrency=max_concurrency,
             scheduling_strategy=o.get("scheduling_strategy"),
             runtime_env=o.get("runtime_env"),
             lifetime=o.get("lifetime"),
@@ -181,5 +182,8 @@ def exit_actor():
 
 
 def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
-    aid, methods = client.get_named_actor(name, namespace)
-    return ActorHandle(aid, methods)
+    aid, methods, mc = client.get_named_actor(name, namespace)
+    # Carry the actor's real concurrency: calls through a looked-up handle
+    # must land on the same executor as the creator's (a long-poll parked
+    # on a 1-slot FIFO would serialize every other caller behind it).
+    return ActorHandle(aid, methods, mc)

@@ -46,7 +46,10 @@ def start(
                     name=SERVE_CONTROLLER_NAME,
                     namespace=SERVE_NAMESPACE,
                     get_if_exists=True,
-                    max_concurrency=16,
+                    # Every router (driver, proxy, each graph replica)
+                    # parks one long-poll listener on a concurrency slot;
+                    # leave generous headroom for control calls.
+                    max_concurrency=64,
                 )
                 .remote()
             )

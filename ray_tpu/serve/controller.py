@@ -63,12 +63,37 @@ class ServeController:
         self._deployments: Dict[str, _DeploymentState] = {}
         self._lock = threading.RLock()
         self._version = 0
+        # Long-poll push (ray: _private/long_poll.py:185 LongPollHost):
+        # routers park a listen_for_change call on this condition; every
+        # version bump notifies them, so membership/config changes reach
+        # the data plane in push latency, not poll-interval latency.
+        self._version_changed = threading.Condition(self._lock)
         self._stop = threading.Event()
         self._period = reconcile_period_s
         self._thread = threading.Thread(
             target=self._reconcile_loop, daemon=True, name="serve-reconciler"
         )
         self._thread.start()
+
+    def _bump_version_locked(self) -> None:
+        self._version += 1
+        self._version_changed.notify_all()
+
+    def listen_for_change(
+        self, known_version: int, timeout_s: float = 30.0
+    ) -> Optional[Dict[str, Any]]:
+        """Park until the routing table moves past known_version (or the
+        chunk timeout lapses — caller immediately re-listens).  Runs on one
+        of the controller actor's concurrency slots
+        (ray: LongPollHost.listen_for_change)."""
+        deadline = time.time() + timeout_s
+        with self._version_changed:
+            while self._version <= known_version and not self._stop.is_set():
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return None
+                self._version_changed.wait(remaining)
+        return self.get_routing_table(known_version)
 
     # -- public control API (called by serve.api / routers) ----------------
     def deploy(
@@ -104,7 +129,7 @@ class ServeController:
                 elif user_config_changed and config.user_config is not None:
                     for h in existing.replicas.values():
                         h.reconfigure.remote(config.user_config)
-            self._version += 1
+            self._bump_version_locked()
         # Reconcile synchronously once so deploy() returning means "replicas
         # are starting" (tests and users can then poll wait_for_ready).
         self._reconcile_once()
@@ -115,7 +140,7 @@ class ServeController:
             if st is not None:
                 for rid, h in list(st.replicas.items()):
                     self._drain_and_kill(st, rid, h)
-                self._version += 1
+                self._bump_version_locked()
 
     def list_deployments(self) -> Dict[str, Dict[str, Any]]:
         with self._lock:
@@ -165,7 +190,7 @@ class ServeController:
                 for rid, h in list(st.replicas.items()):
                     self._drain_and_kill(st, rid, h)
             self._deployments.clear()
-            self._version += 1
+            self._bump_version_locked()
 
     def ping(self) -> str:
         return "pong"
@@ -228,7 +253,7 @@ class ServeController:
                     changed = True
         if changed:
             with self._lock:
-                self._version += 1
+                self._bump_version_locked()
 
     def _check_health(self, st: _DeploymentState) -> bool:
         """Pull-based health check (ray: gcs_health_check_manager.h:39 at the

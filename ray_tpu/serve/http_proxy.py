@@ -30,6 +30,10 @@ class HTTPProxy:
         proxy = self
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1: required for chunked transfer (streaming responses);
+            # non-streaming replies all carry Content-Length.
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, *a):  # quiet
                 pass
 
@@ -39,11 +43,15 @@ class HTTPProxy:
                 if not deployment:
                     self._reply(404, {"error": "no deployment in path"})
                     return
-                if body is None and path.query:
-                    q = {k: v[0] for k, v in parse_qs(path.query).items()}
-                    body = q or None
+                q = {k: v[0] for k, v in parse_qs(path.query).items()}
+                stream = q.pop("stream", "0") in ("1", "true")
+                if body is None and q:
+                    body = q
                 try:
                     args = (body,) if body is not None else ()
+                    if stream:
+                        self._stream_reply(deployment, args)
+                        return
                     ref = proxy._router.assign_request(
                         deployment, "__call__", args, {}
                     )
@@ -51,6 +59,42 @@ class HTTPProxy:
                     self._reply(200, {"result": out})
                 except Exception as e:  # noqa: BLE001 — HTTP boundary
                     self._reply(500, {"error": str(e)})
+
+            def _stream_reply(self, deployment: str, args: tuple):
+                """Chunked NDJSON: one line per generator item, flushed as
+                produced — the client reads tokens while the replica is
+                still decoding (ray: serve streaming responses /
+                StreamingResponse over ASGI).  Never raises: once headers
+                go out, an error MUST be framed as a final chunk — a second
+                HTTP response inside the chunked body would corrupt it."""
+                try:
+                    it = proxy._router.assign_request(
+                        deployment, "__call__", args, {}, stream=True
+                    )
+                except Exception as e:  # noqa: BLE001 — pre-headers: plain 500
+                    self._reply(500, {"error": str(e)})
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def _chunk(payload: dict) -> None:
+                    data = (json.dumps(payload) + "\n").encode()
+                    self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+                    self.wfile.flush()
+
+                try:
+                    try:
+                        for item in it:
+                            _chunk({"item": item})
+                    except (BrokenPipeError, ConnectionResetError):
+                        raise
+                    except Exception as e:  # noqa: BLE001 — mid-stream error
+                        _chunk({"error": str(e)})
+                    self.wfile.write(b"0\r\n\r\n")
+                except (BrokenPipeError, ConnectionResetError):
+                    it.close()  # client hung up: release the replica stream
 
             def _reply(self, code: int, payload):
                 try:

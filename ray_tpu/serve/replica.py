@@ -16,6 +16,8 @@ from typing import Any, Dict, Optional
 
 import cloudpickle
 
+_STREAM_END = object()
+
 
 class Replica:
     """Actor payload.  Instantiated by the controller via
@@ -43,6 +45,8 @@ class Replica:
         self._ongoing = 0
         self._processed = 0
         self._start_time = time.time()
+        self._streams: Dict[str, Any] = {}  # stream_id -> live generator
+        self._stream_counter = 0
         if user_config is not None:
             self.reconfigure(user_config)
 
@@ -65,6 +69,66 @@ class Replica:
             return out
         finally:
             with self._lock:
+                self._ongoing -= 1
+                self._processed += 1
+
+    # -- streaming data plane (ray: replica.py handle_request_streaming /
+    #    ObjectRefGenerator semantics, pulled replica-side) ----------------
+    def stream_start(self, method_name: str, args: tuple, kwargs: dict) -> str:
+        """Begin a streaming call: the user method must return a generator
+        (e.g. an LM decode loop yielding tokens).  Returns a stream id the
+        caller pulls with stream_next — sticky to THIS replica."""
+        import inspect as _inspect
+
+        if self._is_function:
+            fn = self._callable
+        else:
+            fn = getattr(self._callable, method_name or "__call__")
+        gen = fn(*args, **(kwargs or {}))
+        if not (_inspect.isgenerator(gen) or hasattr(gen, "__next__")):
+            gen = iter([gen])  # non-generator result: one-item stream
+        with self._lock:
+            self._stream_counter += 1
+            sid = f"s{self._stream_counter}"
+            self._streams[sid] = gen
+            self._ongoing += 1  # a live stream occupies queue capacity
+        return sid
+
+    def stream_next(self, sid: str, max_items: int = 1):
+        """Pull the next item from the stream.  Returns (items, done).
+        One item per call: a sync generator has no "ready" notion, so
+        pulling more would block on FUTURE items and destroy
+        time-to-first-token — the per-token RPC is the price of streaming
+        over a sync generator (the reference streams per-item over its
+        generator refs for the same reason)."""
+        gen = self._streams.get(sid)
+        if gen is None:
+            return [], True
+        try:
+            item = next(gen)
+        except StopIteration:
+            self._close_stream(sid)
+            return [], True
+        except Exception:
+            self._close_stream(sid)
+            raise
+        return [item], False
+
+    def stream_cancel(self, sid: str) -> None:
+        """Client abandoned the stream (disconnect / GC'd iterator): drop
+        the generator so its captured state frees and it stops counting as
+        an ongoing query."""
+        gen = self._streams.get(sid)
+        if gen is not None and hasattr(gen, "close"):
+            try:
+                gen.close()  # runs the generator's finally blocks
+            except Exception:
+                pass
+        self._close_stream(sid)
+
+    def _close_stream(self, sid: str) -> None:
+        with self._lock:
+            if self._streams.pop(sid, None) is not None:
                 self._ongoing -= 1
                 self._processed += 1
 

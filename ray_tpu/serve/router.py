@@ -19,44 +19,80 @@ from typing import Any, Dict, List, Optional, Tuple
 import ray_tpu
 
 
+class _StreamToken:
+    """In-flight marker for a LIVE stream: a stream's first ref resolves
+    immediately (stream_start returns a sid), so admission control tracks
+    this token instead — it stays in-flight until the stream closes."""
+
+    __slots__ = ("done",)
+
+    def __init__(self):
+        self.done = False
+
+
 class _ReplicaSet:
-    """Replica membership + local in-flight accounting for one deployment."""
+    """Replica membership + local in-flight accounting for one deployment.
+
+    Thread-safe on its OWN lock: assign() can block (backpressure) and
+    must not hold the Router's lock while doing so — the long-poll push
+    that adds capacity has to be able to land mid-wait."""
 
     def __init__(self, max_concurrent_queries: int):
+        self._lock = threading.Lock()
         self.max_concurrent = max_concurrent_queries
         self.replicas: List[Tuple[str, Any]] = []  # (replica_id, handle)
-        self.inflight: Dict[str, List[Any]] = {}  # replica_id -> outstanding refs
+        # replica_id -> outstanding refs + live-stream tokens
+        self.inflight: Dict[str, List[Any]] = {}
 
     def update(self, replicas: List[Tuple[str, Any]], max_concurrent: int):
-        self.replicas = list(replicas)
-        self.max_concurrent = max_concurrent
-        live = {rid for rid, _ in replicas}
-        self.inflight = {rid: refs for rid, refs in self.inflight.items() if rid in live}
+        with self._lock:
+            self.replicas = list(replicas)
+            self.max_concurrent = max_concurrent
+            live = {rid for rid, _ in replicas}
+            self.inflight = {
+                rid: refs for rid, refs in self.inflight.items() if rid in live
+            }
 
-    def _purge(self, rid: str):
-        refs = self.inflight.get(rid)
-        if not refs:
+    def _purge_locked(self, rid: str):
+        entries = self.inflight.get(rid)
+        if not entries:
             return
-        done, pending = ray_tpu.wait(refs, num_returns=len(refs), timeout=0)
-        self.inflight[rid] = pending
+        refs = [e for e in entries if not isinstance(e, _StreamToken)]
+        tokens = [e for e in entries if isinstance(e, _StreamToken) and not e.done]
+        if refs:
+            done, pending = ray_tpu.wait(refs, num_returns=len(refs), timeout=0)
+        else:
+            pending = []
+        self.inflight[rid] = pending + tokens
+
+    def record(self, rid: str, entry: Any) -> None:
+        with self._lock:
+            self.inflight.setdefault(rid, []).append(entry)
+
+    def has_replicas(self) -> bool:
+        with self._lock:
+            return bool(self.replicas)
 
     def assign(self) -> Tuple[str, Any]:
         """Pick a replica: power-of-two-choices on local in-flight count
         (ray: router.py:221).  Blocks (with purging) while every replica is
         at max_concurrent — that's the handle-side backpressure."""
-        if not self.replicas:
-            raise RuntimeError("no live replicas")
         deadline = time.time() + 60.0
         while True:
-            if len(self.replicas) == 1:
-                cands = [self.replicas[0]]
-            else:
-                cands = random.sample(self.replicas, 2)
-            for rid, _h in cands:
-                self._purge(rid)
-            rid, h = min(cands, key=lambda rh: len(self.inflight.get(rh[0], ())))
-            if len(self.inflight.get(rid, ())) < self.max_concurrent:
-                return rid, h
+            with self._lock:
+                if not self.replicas:
+                    raise RuntimeError("no live replicas")
+                if len(self.replicas) == 1:
+                    cands = [self.replicas[0]]
+                else:
+                    cands = random.sample(self.replicas, 2)
+                for rid, _h in cands:
+                    self._purge_locked(rid)
+                rid, h = min(
+                    cands, key=lambda rh: len(self.inflight.get(rh[0], ()))
+                )
+                if len(self.inflight.get(rid, ())) < self.max_concurrent:
+                    return rid, h
             if time.time() > deadline:
                 raise TimeoutError(
                     "all replicas at max_concurrent_queries for 60s"
@@ -65,79 +101,207 @@ class _ReplicaSet:
 
 
 class Router:
-    """Per-process router over all deployments (ray: router.py Router)."""
+    """Per-process router over all deployments (ray: router.py Router).
 
-    def __init__(self, controller_handle, refresh_interval_s: float = 0.25):
+    Membership arrives by PUSH: a background thread keeps one long-poll
+    parked on the controller (ray: long_poll.py:185 LongPollClient), so a
+    config/membership change reaches every router in push latency with
+    zero per-request controller traffic."""
+
+    def __init__(self, controller_handle, listen_chunk_s: float = 30.0):
         self._controller = controller_handle
-        self._interval = refresh_interval_s
+        self._chunk = listen_chunk_s
         self._lock = threading.Lock()
         self._version = -1
-        self._last_refresh = 0.0
         self._sets: Dict[str, _ReplicaSet] = {}
+        self._stopped = False
+        # Bootstrap table fetch is best-effort: a router built INSIDE a
+        # replica's __init__ (graph ingress unpickling a child handle) must
+        # not fail actor creation on a busy controller — the long-poll
+        # listener below delivers the table moments later, and
+        # assign_request force-pulls on a miss.
+        try:
+            self._refresh()
+        except Exception:
+            pass
+        self._listen_thread = threading.Thread(
+            target=self._listen_loop, daemon=True, name="serve-router-longpoll"
+        )
+        self._listen_thread.start()
 
-    def _refresh(self, force: bool = False):
-        now = time.time()
-        if not force and now - self._last_refresh < self._interval:
+    def _apply_table(self, out) -> None:
+        if out is None:
             return
-        self._last_refresh = now
+        with self._lock:
+            if out["version"] <= self._version:
+                return
+            self._version = out["version"]
+            live = set(out["table"].keys())
+            for name, info in out["table"].items():
+                rs = self._sets.get(name)
+                if rs is None:
+                    rs = self._sets[name] = _ReplicaSet(info["max_concurrent_queries"])
+                rs.update(info["replicas"], info["max_concurrent_queries"])
+            for name in list(self._sets.keys()):
+                if name not in live:
+                    del self._sets[name]
+
+    def _refresh(self):
         out = ray_tpu.get(
             self._controller.get_routing_table.remote(self._version), timeout=10
         )
-        if out is None:
-            return
-        self._version = out["version"]
-        live = set(out["table"].keys())
-        for name, info in out["table"].items():
-            rs = self._sets.get(name)
-            if rs is None:
-                rs = self._sets[name] = _ReplicaSet(info["max_concurrent_queries"])
-            rs.update(info["replicas"], info["max_concurrent_queries"])
-        for name in list(self._sets.keys()):
-            if name not in live:
-                del self._sets[name]
+        self._apply_table(out)
+
+    def _listen_loop(self) -> None:
+        while not self._stopped:
+            try:
+                out = ray_tpu.get(
+                    self._controller.listen_for_change.remote(
+                        self._version, self._chunk
+                    ),
+                    timeout=self._chunk + 15,
+                )
+            except Exception:
+                if self._stopped:
+                    return
+                time.sleep(0.5)  # controller restarting: retry
+                continue
+            self._apply_table(out)
 
     def assign_request(
-        self, deployment: str, method_name: str, args: tuple, kwargs: dict
+        self, deployment: str, method_name: str, args: tuple, kwargs: dict,
+        stream: bool = False,
     ):
-        """Pick a replica and submit; returns the result ObjectRef."""
+        """Pick a replica and submit; returns the result ObjectRef (or a
+        replica-sticky stream handle when stream=True).  Blocking
+        backpressure happens on the replica set's OWN lock — the router
+        lock is only held for map lookups, so the long-poll push can land
+        while callers wait for capacity."""
         with self._lock:
-            self._refresh()
             rs = self._sets.get(deployment)
-            if rs is None or not rs.replicas:
-                # Maybe stale: force one refresh before failing.
-                self._refresh(force=True)
+        if rs is None or not rs.has_replicas():
+            # Push may still be in flight for a just-deployed app: force
+            # one pull before failing.
+            self._refresh()
+            with self._lock:
                 rs = self._sets.get(deployment)
-                if rs is None or not rs.replicas:
-                    raise RuntimeError(f"deployment {deployment!r} has no replicas")
-            rid, handle = rs.assign()
-            ref = handle.handle_request.remote(method_name, args, kwargs)
-            rs.inflight.setdefault(rid, []).append(ref)
-            return ref
+            if rs is None or not rs.has_replicas():
+                raise RuntimeError(f"deployment {deployment!r} has no replicas")
+        rid, handle = rs.assign()
+        if stream:
+            token = _StreamToken()
+            sid_ref = handle.stream_start.remote(method_name, args, kwargs)
+            rs.record(rid, token)  # live stream counts as in-flight
+            return _StreamIterator(handle, sid_ref, token=token)
+        ref = handle.handle_request.remote(method_name, args, kwargs)
+        rs.record(rid, ref)
+        return ref
+
+
+class _StreamIterator:
+    """Client side of a streaming call (ray: DeploymentResponseGenerator).
+
+    Pulls item batches from the REPLICA that owns the generator (sticky —
+    a generator cannot move between replicas).  Lazy: each __next__ fetches
+    the next ready chunk, so the consumer sees early items while the
+    replica is still producing later ones (token streaming)."""
+
+    def __init__(self, replica_handle, sid_ref, batch: int = 1, token=None):
+        self._h = replica_handle
+        self._sid_ref = sid_ref
+        self._sid = None
+        self._batch = batch
+        self._buf: List[Any] = []
+        self._done = False
+        self._token = token
+
+    def __iter__(self):
+        return self
+
+    def _finish(self) -> None:
+        self._done = True
+        if self._token is not None:
+            self._token.done = True  # release the router's in-flight slot
+
+    def __next__(self):
+        while not self._buf:
+            if self._done:
+                raise StopIteration
+            if self._sid is None:
+                self._sid = ray_tpu.get(self._sid_ref, timeout=60)
+            try:
+                items, done = ray_tpu.get(
+                    self._h.stream_next.remote(self._sid, self._batch), timeout=300
+                )
+            except Exception:
+                self._finish()
+                raise
+            if done:
+                self._finish()
+            self._buf.extend(items)
+        return self._buf.pop(0)
+
+    def close(self) -> None:
+        """Abandon the stream: tell the replica to drop the generator so
+        it stops counting against its queue and frees captured state."""
+        if self._done:
+            return
+        self._finish()
+        try:
+            if self._sid is None:
+                self._sid = ray_tpu.get(self._sid_ref, timeout=10)
+            self._h.stream_cancel.remote(self._sid)
+        except Exception:
+            pass  # replica already dead: nothing to cancel
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class DeploymentHandle:
     """User-facing handle (ray: serve/handle.py DeploymentHandle).
 
     `h.remote(*a)` calls the deployment's __call__; `h.method.remote(*a)`
-    calls a named method.  Results are ObjectRefs: ray_tpu.get() them."""
+    calls a named method.  Results are ObjectRefs: ray_tpu.get() them, or
+    `await` them inside async code (async handle API).
+    `h.options(stream=True).remote(*a)` returns an iterator of the
+    deployment generator's items (streaming responses)."""
 
-    def __init__(self, deployment_name: str, router: Router, method_name: Optional[str] = None):
+    def __init__(
+        self,
+        deployment_name: str,
+        router: Router,
+        method_name: Optional[str] = None,
+        stream: bool = False,
+    ):
         self._name = deployment_name
         self._router = router
         self._method = method_name
+        self._stream = stream
 
-    def options(self, *, method_name: Optional[str] = None) -> "DeploymentHandle":
-        return DeploymentHandle(self._name, self._router, method_name)
+    def options(
+        self, *, method_name: Optional[str] = None, stream: Optional[bool] = None
+    ) -> "DeploymentHandle":
+        return DeploymentHandle(
+            self._name,
+            self._router,
+            method_name if method_name is not None else self._method,
+            self._stream if stream is None else stream,
+        )
 
     def remote(self, *args, **kwargs):
         return self._router.assign_request(
-            self._name, self._method or "__call__", args, kwargs
+            self._name, self._method or "__call__", args, kwargs,
+            stream=self._stream,
         )
 
     def __getattr__(self, name: str):
         if name.startswith("_"):
             raise AttributeError(name)
-        return DeploymentHandle(self._name, self._router, name)
+        return DeploymentHandle(self._name, self._router, name, self._stream)
 
     def __reduce__(self):
         # Handles ship into OTHER processes (deployment-graph ingress

@@ -1,0 +1,128 @@
+"""Serve data-plane micro-benchmark: QPS + p50/p99 latency.
+
+ray: release/serve_tests/workloads/serve_micro_benchmark.py — handle-path
+and HTTP-path throughput/latency on a trivial deployment (measures the
+runtime, not the model).  Writes one JSON line; CI/driver can redirect to
+BENCH_serve_r3.json.  Numbers are host-bound: record nproc with them.
+
+Run: python scripts/serve_bench.py [--requests 300] [--concurrency 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _percentile(xs, p):
+    xs = sorted(xs)
+    return xs[min(int(len(xs) * p), len(xs) - 1)]
+
+
+def bench_handle(handle, n: int, concurrency: int):
+    import ray_tpu
+
+    lat = []
+    lock = threading.Lock()
+
+    def worker(count):
+        for _ in range(count):
+            t0 = time.monotonic()
+            ray_tpu.get(handle.remote(1), timeout=60)
+            dt = time.monotonic() - t0
+            with lock:
+                lat.append(dt)
+
+    t0 = time.monotonic()
+    threads = [
+        threading.Thread(target=worker, args=(n // concurrency,))
+        for _ in range(concurrency)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    return len(lat) / wall, lat
+
+
+def bench_http(addr: str, n: int, concurrency: int):
+    import urllib.request
+
+    lat = []
+    lock = threading.Lock()
+
+    def worker(count):
+        for _ in range(count):
+            t0 = time.monotonic()
+            urllib.request.urlopen(f"{addr}/echo?x=1", timeout=60).read()
+            dt = time.monotonic() - t0
+            with lock:
+                lat.append(dt)
+
+    t0 = time.monotonic()
+    threads = [
+        threading.Thread(target=worker, args=(n // concurrency,))
+        for _ in range(concurrency)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    return len(lat) / wall, lat
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=300)
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--output", default=None)
+    args = ap.parse_args(argv)
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=4)
+    serve.start(http_options={"host": "127.0.0.1", "port": 0})
+
+    @serve.deployment(name="echo", num_replicas=2, max_concurrent_queries=32)
+    def echo(body=None):
+        return {"ok": True}
+
+    handle = serve.run(echo.bind())
+    ray_tpu.get(handle.remote(0), timeout=60)  # warm both paths
+    addr = serve.get_http_address()
+
+    hqps, hlat = bench_handle(handle, args.requests, args.concurrency)
+    wqps, wlat = bench_http(addr, args.requests, args.concurrency)
+
+    out = {
+        "nproc": os.cpu_count(),
+        "requests": args.requests,
+        "concurrency": args.concurrency,
+        "handle_qps": round(hqps, 1),
+        "handle_p50_ms": round(_percentile(hlat, 0.50) * 1e3, 2),
+        "handle_p99_ms": round(_percentile(hlat, 0.99) * 1e3, 2),
+        "http_qps": round(wqps, 1),
+        "http_p50_ms": round(_percentile(wlat, 0.50) * 1e3, 2),
+        "http_p99_ms": round(_percentile(wlat, 0.99) * 1e3, 2),
+    }
+    line = json.dumps(out)
+    print(line)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(line + "\n")
+    serve.shutdown()
+    ray_tpu.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -320,3 +320,99 @@ def test_deployment_graph_composition(serve_instance):
     assert {"doubler", "inc", "ingress"} <= set(st)
     direct = serve.get_deployment_handle("doubler")
     assert ray_tpu.get(direct.remote(5), timeout=30) == 10
+
+
+# -- serve v2: long-poll push, streaming, async handles ----------------------
+
+
+def test_config_push_reaches_router_without_requests(serve_instance):
+    """The router learns of membership changes by PUSH (long-poll), not by
+    per-request polling: its version advances with NO data-plane traffic
+    (ray: long_poll.py:185)."""
+    from ray_tpu.serve import api as serve_api
+
+    @serve.deployment
+    def first(x):
+        return x
+
+    serve.run(first.bind())
+    router = serve_api._router
+    v0 = router._version
+    assert v0 >= 0
+
+    @serve.deployment(name="second")
+    def second(x):
+        return x * 2
+
+    t0 = time.monotonic()
+    serve.run(second.bind(), name="second")
+    # No requests, no sleeps: the long-poll push must move the version.
+    deadline = time.monotonic() + 5
+    while router._version <= v0 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    elapsed = time.monotonic() - t0
+    assert router._version > v0, "router never saw the pushed table"
+    assert "second" in router._sets
+
+
+def test_streaming_handle_tokens(serve_instance):
+    """Generator deployments stream items; the consumer sees the first
+    token before the replica has produced the last one."""
+
+    @serve.deployment(name="lm")
+    class FakeLM:
+        def __call__(self, prompt):
+            for i, tok in enumerate(str(prompt).split()):
+                time.sleep(0.15)
+                yield {"i": i, "token": tok}
+
+    h = serve.run(FakeLM.bind(), name="lm")
+    t0 = time.monotonic()
+    it = h.options(stream=True).remote("the quick brown fox jumps")
+    first = next(it)
+    first_latency = time.monotonic() - t0
+    rest = list(it)
+    total = time.monotonic() - t0
+    assert first == {"i": 0, "token": "the"}
+    assert [r["token"] for r in rest] == ["quick", "brown", "fox", "jumps"]
+    assert first_latency < total * 0.6, (
+        f"first token at {first_latency:.2f}s of {total:.2f}s — not streamed"
+    )
+
+
+def test_streaming_http_chunked(serve_instance):
+    @serve.deployment(name="stream_http")
+    def gen(body=None):
+        for i in range(5):
+            time.sleep(0.05)
+            yield i * 11
+
+    serve.start(http_options={"host": "127.0.0.1", "port": 0})
+    serve.run(gen.bind(), name="stream_http")
+    addr = serve.get_http_address()
+    resp = urllib.request.urlopen(f"{addr}/stream_http?stream=1", timeout=60)
+    items = []
+    for line in resp:
+        line = line.strip()
+        if line:
+            items.append(json.loads(line)["item"])
+    assert items == [0, 11, 22, 33, 44]
+
+
+def test_async_handle_await(serve_instance):
+    """`await handle.remote(...)` works in async code — including inside
+    worker processes (the awaitable rides client.get, not the driver
+    runtime)."""
+    import asyncio
+
+    @serve.deployment
+    def double(x):
+        return x * 2
+
+    h = serve.run(double.bind())
+
+    async def drive():
+        a, b = await asyncio.gather(h.remote(3), h.remote(4))
+        return a, b
+
+    assert asyncio.run(drive()) == (6, 8)
