@@ -23,6 +23,7 @@ from ray_tpu.rllib.env import (
 from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.policy import JaxPolicy, apply_policy, init_policy_params
 from ray_tpu.rllib.dqn import DQN, DQNConfig
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, LearnerGroup, vtrace
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae
 
@@ -31,7 +32,10 @@ __all__ = [
     "DQN",
     "DQNConfig",
     "EnvRunner",
+    "IMPALA",
+    "IMPALAConfig",
     "JaxPolicy",
+    "LearnerGroup",
     "PPO",
     "PPOConfig",
     "SampleBatch",
@@ -41,4 +45,5 @@ __all__ = [
     "init_policy_params",
     "make_vector_env",
     "register_env",
+    "vtrace",
 ]

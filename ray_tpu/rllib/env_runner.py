@@ -112,5 +112,59 @@ class EnvRunner:
             "steps": T * N,
         }
 
+    def sample_trajectory(
+        self, weights: Optional[Any] = None, weights_version: int = 0
+    ) -> Dict[str, Any]:
+        """Collect a TIME-MAJOR raw trajectory for off-policy learners
+        (IMPALA — ray: rllib/algorithms/impala/impala.py:478).
+
+        Unlike `sample()` (which post-processes GAE runner-side for PPO),
+        this ships the behavior policy's raw experience: the learner computes
+        values under its OWN current params and applies V-trace importance
+        correction for the sampling lag.  `next_obs` is the pre-reset
+        observation of every step, so the learner can bootstrap through
+        time-limit truncations exactly (terminated cuts the return;
+        truncated bootstraps V(next_obs) but still cuts the trace).
+        """
+        if weights is not None:
+            self.policy.set_weights(weights)
+        T, N = self.rollout_length, self.env.num_envs
+        obs_buf = np.zeros((T, N, self.env.observation_size), dtype=np.float32)
+        next_obs_buf = np.zeros_like(obs_buf)
+        act_buf = np.zeros((T, N), dtype=np.int64)
+        logp_buf = np.zeros((T, N), dtype=np.float32)
+        rew_buf = np.zeros((T, N), dtype=np.float32)
+        term_buf = np.zeros((T, N), dtype=bool)
+        done_buf = np.zeros((T, N), dtype=bool)
+
+        obs = self._obs
+        for t in range(T):
+            actions, logps, _ = self.policy.compute_actions(obs)
+            obs_buf[t] = obs
+            act_buf[t] = actions
+            logp_buf[t] = logps
+            final_obs, rewards, terminated, truncated = self.env.step(actions)
+            next_obs_buf[t] = final_obs
+            rew_buf[t] = rewards
+            term_buf[t] = terminated
+            done_buf[t] = terminated | truncated
+            obs = self.env.current_obs()
+        self._obs = obs
+
+        return {
+            "batch": {
+                OBS: obs_buf,
+                "next_obs": next_obs_buf,
+                ACTIONS: act_buf,
+                LOGPS: logp_buf,
+                "rewards": rew_buf,
+                "terminateds": term_buf,
+                "dones": done_buf,
+            },
+            "episode_returns": self.env.drain_episode_returns(),
+            "steps": T * N,
+            "weights_version": weights_version,
+        }
+
     def ping(self) -> str:
         return "pong"

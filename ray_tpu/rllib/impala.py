@@ -1,0 +1,415 @@
+"""IMPALA: async actor-learner RL with V-trace off-policy correction.
+
+ray: rllib/algorithms/impala/impala.py:478,620 (async sample queues feeding
+a learner thread) + rllib/core/learner/learner_group.py:43 (multi-learner
+DDP update).  TPU-first redesign:
+
+- Env runners are plain actors that ALWAYS have a sample request in
+  flight: the driver harvests whichever trajectory finishes first
+  (`ray_tpu.wait`) and immediately resubmits to that runner, so sampling
+  and learning overlap without a dedicated learner thread — the runtime's
+  async task plane IS the sample queue.
+- The off-policy lag this creates is corrected with V-trace (Espeholt et
+  al. 2018, public algorithm) computed INSIDE the jitted update: one
+  reverse `lax.scan` over time, fused with the loss/grad/optimizer step
+  into a single XLA program.
+- LearnerGroup is not N DDP actors exchanging NCCL grads: it is ONE jitted
+  update pjit-sharded over a `learner` mesh axis (batch sharded on the env
+  dimension, params replicated) — XLA inserts the gradient psum on ICI.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import make_vector_env
+from ray_tpu.rllib.env_runner import EnvRunner
+from ray_tpu.rllib.sample_batch import ACTIONS, LOGPS, OBS
+
+
+def vtrace(
+    target_logps,
+    behavior_logps,
+    rewards,
+    values,
+    next_values,
+    terminateds,
+    dones,
+    *,
+    gamma: float,
+    rho_clip: float = 1.0,
+    c_clip: float = 1.0,
+):
+    """V-trace targets + policy-gradient advantages over a [T, N] rollout.
+
+    All inputs are [T, N] device arrays; `values`/`next_values` are the
+    CURRENT policy's value estimates of obs/next_obs.  `terminateds` zeroes
+    the bootstrap (true episode end); `dones` additionally cuts the
+    correction trace at time-limit truncations, whose next_values still
+    bootstrap.  Returns (vs [T, N], pg_advantages [T, N]).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    rhos = jnp.exp(target_logps - behavior_logps)
+    clipped_rho = jnp.minimum(rho_clip, rhos)
+    clipped_c = jnp.minimum(c_clip, rhos)
+    term_f = terminateds.astype(values.dtype)
+    done_f = dones.astype(values.dtype)
+    discount = gamma * (1.0 - term_f)  # per-step bootstrap discount
+    deltas = clipped_rho * (rewards + discount * next_values - values)
+
+    def backward(carry, inp):
+        vs_minus_v_next, vs_next = carry
+        delta, disc, c, cont, v, nv, r, rho = inp
+        vs_minus_v = delta + disc * cont * c * vs_minus_v_next
+        vs = v + vs_minus_v
+        # PG target: bootstrap through vs_{t+1} while the episode lives,
+        # through V(next_obs) across a truncation, through nothing at a
+        # true termination (disc already zero there).
+        q = r + disc * jnp.where(cont > 0.0, vs_next, nv)
+        adv = rho * (q - v)
+        return (vs_minus_v, vs), (vs, adv)
+
+    cont = 1.0 - done_f  # trace continues only when the episode does
+    init = (jnp.zeros_like(values[-1]), next_values[-1])
+    _, (vs, pg_adv) = lax.scan(
+        backward,
+        init,
+        (deltas, discount, clipped_c, cont, values, next_values, rewards,
+         clipped_rho),
+        reverse=True,
+    )
+    return vs, pg_adv
+
+
+class LearnerGroup:
+    """Shard one jitted update over a `learner` mesh axis.
+
+    ray: rllib/core/learner/learner_group.py:43,129 — the reference spawns
+    learner ACTORS and all-reduces torch grads between them.  On TPU the
+    idiomatic form is SPMD: the batch's env axis is sharded across the
+    learner submesh, params stay replicated, and jit/XLA insert the
+    gradient psum.  Semantics are bit-for-bit those of the unsharded
+    program (tested: 1-learner vs 2-learner parity).
+    """
+
+    def __init__(self, update_fn: Callable, num_learners: int = 1):
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        devices = jax.devices()
+        if num_learners > len(devices):
+            raise ValueError(
+                f"num_learners={num_learners} > available devices {len(devices)}"
+            )
+        self.num_learners = num_learners
+        self.mesh = Mesh(np.array(devices[:num_learners]), ("learner",))
+        self._replicated = NamedSharding(self.mesh, P())
+        # Batch leaves are [T, N, ...] — shard the env axis (dim 1).
+        self._batch_sharding = NamedSharding(self.mesh, P(None, "learner"))
+        self._update = jax.jit(update_fn, donate_argnums=(0,))
+        self._jax = jax
+
+    def _place(self, tree, sharding):
+        return self._jax.tree_util.tree_map(
+            lambda x: self._jax.device_put(x, sharding), tree
+        )
+
+    def update(self, state, batch):
+        for leaf in self._jax.tree_util.tree_leaves(batch):
+            if leaf.shape[1] % self.num_learners:
+                raise ValueError(
+                    f"env axis {leaf.shape[1]} not divisible by "
+                    f"num_learners={self.num_learners}"
+                )
+        state = self._place(state, self._replicated)
+        batch = self._place(batch, self._batch_sharding)
+        with self.mesh:
+            return self._update(state, batch)
+
+
+class IMPALAConfig:
+    """Builder-style config (ray: rllib/algorithms/impala/impala.py:60)."""
+
+    def __init__(self):
+        self.env: Optional[str | Callable] = None
+        self.num_env_runners = 2
+        self.num_envs_per_runner = 8
+        self.rollout_length = 16
+        self.gamma = 0.99
+        self.lr = 1e-3
+        self.entropy_coeff = 3e-3
+        # Small because pg advantages are standardized while V-trace value
+        # targets are raw returns: a large vf weight lets value gradients
+        # crush the shared torso (measured: vf_coeff 0.5 stalls CartPole at
+        # ~40 reward; 0.01 solves it).
+        self.vf_coeff = 0.01
+        self.rho_clip = 1.0
+        self.c_clip = 1.0
+        self.num_learners = 1
+        self.updates_per_iteration = 8
+        self.broadcast_interval = 1  # weight refresh every N updates
+        self.hidden = (64, 64)
+        self.seed = 0
+
+    def environment(self, env: str | Callable) -> "IMPALAConfig":
+        self.env = env
+        return self
+
+    def env_runners(
+        self, num_env_runners: int = 2, num_envs_per_runner: int = 8,
+        rollout_length: int = 16,
+    ) -> "IMPALAConfig":
+        self.num_env_runners = num_env_runners
+        self.num_envs_per_runner = num_envs_per_runner
+        self.rollout_length = rollout_length
+        return self
+
+    _TRAINING_KEYS = frozenset(
+        {
+            "gamma", "lr", "entropy_coeff", "vf_coeff", "rho_clip", "c_clip",
+            "num_learners", "updates_per_iteration", "broadcast_interval",
+            "hidden",
+        }
+    )
+
+    def training(self, **kw) -> "IMPALAConfig":
+        for k, v in kw.items():
+            if k not in self._TRAINING_KEYS:
+                raise TypeError(
+                    f"unknown IMPALA training option {k!r}; valid: "
+                    f"{sorted(self._TRAINING_KEYS)}"
+                )
+            setattr(self, k, v)
+        return self
+
+    def debugging(self, seed: int = 0) -> "IMPALAConfig":
+        self.seed = seed
+        return self
+
+    def build(self) -> "IMPALA":
+        if self.env is None:
+            raise ValueError("call .environment(env) first")
+        return IMPALA(self)
+
+
+def make_impala_learner(config: IMPALAConfig, obs_size: int, num_actions: int):
+    """(init_state, update_fn): V-trace actor-critic update as one pure fn.
+
+    ray: rllib/algorithms/impala/vtrace_torch_policy + learner.py:657 —
+    here loss, V-trace scan, grads and the optimizer step all fuse into a
+    single XLA program, shardable by LearnerGroup.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.rllib.policy import apply_policy, init_policy_params
+
+    opt = optax.adam(config.lr)
+    ent_c, vf_c = config.entropy_coeff, config.vf_coeff
+
+    def init_state(seed: int):
+        key = jax.random.PRNGKey(seed)
+        params = init_policy_params(key, obs_size, num_actions, config.hidden)
+        return {"params": params, "opt_state": opt.init(params)}
+
+    def loss_fn(params, batch):
+        T, N = batch[ACTIONS].shape
+        obs = batch[OBS].reshape(T * N, obs_size)
+        nobs = batch["next_obs"].reshape(T * N, obs_size)
+        logits, values = apply_policy(params, obs)
+        _, next_values = apply_policy(params, nobs)
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch[ACTIONS].reshape(-1)[:, None], axis=1
+        )[:, 0]
+
+        vs, pg_adv = vtrace(
+            jax.lax.stop_gradient(logp.reshape(T, N)),
+            batch[LOGPS],
+            batch["rewards"],
+            jax.lax.stop_gradient(values.reshape(T, N)),
+            jax.lax.stop_gradient(next_values.reshape(T, N)),
+            batch["terminateds"],
+            batch["dones"],
+            gamma=config.gamma,
+            rho_clip=config.rho_clip,
+            c_clip=config.c_clip,
+        )
+        adv = pg_adv.reshape(-1)
+        # Standardize advantages per batch: raw lambda=1 V-trace returns on
+        # a small rollout swing over orders of magnitude, drowning the
+        # entropy/value terms (same reasoning as PPO's normalization).
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        pg_loss = -jnp.mean(adv * logp)
+        vf_loss = 0.5 * jnp.mean((values - vs.reshape(-1)) ** 2)
+        entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=1))
+        total = pg_loss + vf_c * vf_loss - ent_c * entropy
+        return total, (pg_loss, vf_loss, entropy)
+
+    def update(state, batch):
+        (total, (pg, vf, ent)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state["params"], batch)
+        updates, opt_state = opt.update(grads, state["opt_state"], state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        metrics = {
+            "total_loss": total,
+            "policy_loss": pg,
+            "vf_loss": vf,
+            "entropy": ent,
+        }
+        return {"params": params, "opt_state": opt_state}, metrics
+
+    return init_state, update
+
+
+class IMPALA:
+    """Async actor-learner algorithm (ray: impala.py:620 training_step).
+
+    Every runner permanently has one `sample_trajectory` task in flight;
+    `train()` consumes whichever trajectories complete first, updates the
+    learner on each, and resubmits with the freshest weights.  Sampling for
+    update k+1 proceeds WHILE update k runs — the lag (tracked as
+    `avg_weights_lag`) is what V-trace corrects.
+    """
+
+    def __init__(self, config: IMPALAConfig):
+        self.config = config
+        ray_tpu.init(ignore_reinit_error=True)
+        probe = make_vector_env(config.env, 1, seed=0)
+        self._obs_size = probe.observation_size
+        self._num_actions = probe.num_actions
+        init_state, update_fn = make_impala_learner(
+            config, self._obs_size, self._num_actions
+        )
+        self._learners = LearnerGroup(update_fn, config.num_learners)
+        self._state = init_state(config.seed)
+        self._weights_version = 0
+        self._weights_ref = ray_tpu.put(self.get_weights())
+
+        RunnerActor = ray_tpu.remote(EnvRunner)
+        self.runners = [
+            RunnerActor.remote(
+                config.env,
+                config.num_envs_per_runner,
+                config.rollout_length,
+                gamma=config.gamma,
+                seed=config.seed + 1000 * (i + 1),
+                hidden=config.hidden,
+            )
+            for i in range(config.num_env_runners)
+        ]
+        ray_tpu.get([r.ping.remote() for r in self.runners], timeout=120)
+        # Prime the async pipeline: one request in flight per runner.
+        self._inflight: Dict[Any, Any] = {
+            r.sample_trajectory.remote(self._weights_ref, self._weights_version): r
+            for r in self.runners
+        }
+        self.iteration = 0
+        self._updates = 0
+        self._total_steps = 0
+        self._episode_returns: List[float] = []
+        self._lags: List[int] = []
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree_util.tree_map(np.asarray, self._state["params"])
+
+    def set_weights(self, weights) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self._state["params"] = jax.tree_util.tree_map(jnp.asarray, weights)
+
+    def _harvest_one(self, timeout: float = 120.0):
+        ready, _ = ray_tpu.wait(
+            list(self._inflight), num_returns=1, timeout=timeout
+        )
+        if not ready:
+            raise TimeoutError("no trajectory completed within timeout")
+        ref = ready[0]
+        runner = self._inflight.pop(ref)
+        result = ray_tpu.get(ref)
+        # Resubmit immediately — the runner samples the NEXT trajectory
+        # while we run this update (that concurrency is the whole point).
+        self._inflight[
+            runner.sample_trajectory.remote(self._weights_ref, self._weights_version)
+        ] = runner
+        return result
+
+    def train(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        t0 = time.time()
+        steps = 0
+        metrics = {}
+        for _ in range(self.config.updates_per_iteration):
+            result = self._harvest_one()
+            self._episode_returns.extend(result["episode_returns"])
+            self._total_steps += result["steps"]
+            steps += result["steps"]
+            self._lags.append(self._weights_version - result["weights_version"])
+
+            batch = {k: jnp.asarray(v) for k, v in result["batch"].items()}
+            self._state, metrics = self._learners.update(self._state, batch)
+            self._updates += 1
+            if self._updates % self.config.broadcast_interval == 0:
+                self._weights_version += 1
+                self._weights_ref = ray_tpu.put(self.get_weights())
+
+        self._episode_returns = self._episode_returns[-100:]
+        self._lags = self._lags[-200:]
+        self.iteration += 1
+        mean_ret = (
+            float(np.mean(self._episode_returns)) if self._episode_returns else 0.0
+        )
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": mean_ret,
+            "num_env_steps_sampled": self._total_steps,
+            "env_steps_per_sec": steps / max(time.time() - t0, 1e-9),
+            "avg_weights_lag": float(np.mean(self._lags)) if self._lags else 0.0,
+            "num_updates": self._updates,
+            **{k: float(v) for k, v in metrics.items()},
+        }
+
+    # -- checkpointing (ray: Algorithm.save/restore) ----------------------
+    def save(self, path: Optional[str] = None) -> str:
+        import jax
+
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        host_state = jax.tree_util.tree_map(np.asarray, self._state)
+        ckpt = Checkpoint.from_dict(
+            {"learner_state": host_state, "iteration": self.iteration}
+        )
+        return ckpt.to_directory(path)
+
+    def restore(self, path: str) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        d = Checkpoint.from_directory(path).to_dict()
+        self._state = jax.tree_util.tree_map(jnp.asarray, d["learner_state"])
+        self.iteration = d["iteration"]
+        self._weights_version += 1
+        self._weights_ref = ray_tpu.put(self.get_weights())
+
+    def stop(self) -> None:
+        self._inflight.clear()
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        self.runners = []
