@@ -337,17 +337,16 @@ class IMPALA:
             raise TimeoutError("no trajectory completed within timeout")
         ref = ready[0]
         runner = self._inflight.pop(ref)
-        result = ray_tpu.get(ref)
-        # Resubmit immediately — the runner samples the NEXT trajectory
-        # while we run this update (that concurrency is the whole point).
+        # Resubmit BEFORE the get: the completed ref's get can still raise
+        # (user env error) and the runner must stay in the pipeline either
+        # way — losing it would silently shrink the pool until train()
+        # times out with no runners left.
         self._inflight[
             runner.sample_trajectory.remote(self._weights_ref, self._weights_version)
         ] = runner
-        return result
+        return ray_tpu.get(ref)
 
     def train(self) -> Dict[str, Any]:
-        import jax.numpy as jnp
-
         t0 = time.time()
         steps = 0
         metrics = {}
@@ -358,8 +357,12 @@ class IMPALA:
             steps += result["steps"]
             self._lags.append(self._weights_version - result["weights_version"])
 
-            batch = {k: jnp.asarray(v) for k, v in result["batch"].items()}
-            self._state, metrics = self._learners.update(self._state, batch)
+            # Numpy batch goes straight to LearnerGroup: its device_put does
+            # the single host->sharded-devices transfer (a jnp.asarray here
+            # would commit to device 0 first and reshard — two copies).
+            self._state, metrics = self._learners.update(
+                self._state, result["batch"]
+            )
             self._updates += 1
             if self._updates % self.config.broadcast_interval == 0:
                 self._weights_version += 1

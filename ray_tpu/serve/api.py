@@ -228,6 +228,14 @@ def shutdown() -> None:
                 ray_tpu.kill(_proxy)
             except Exception:
                 pass
+        if _router is not None:
+            _router.stop()
+        from ray_tpu.serve import router as _router_mod
+
+        with _router_mod._process_router_lock:
+            if _router_mod._process_router is not None:
+                _router_mod._process_router.stop()
+                _router_mod._process_router = None
         _controller = None
         _proxy = None
         _router = None

@@ -152,6 +152,11 @@ class Router:
         )
         self._apply_table(out)
 
+    def stop(self) -> None:
+        """Stop the long-poll listener (serve.shutdown): without this the
+        daemon thread would hot-retry a dead controller forever."""
+        self._stopped = True
+
     def _listen_loop(self) -> None:
         while not self._stopped:
             try:
@@ -227,9 +232,13 @@ class _StreamIterator:
         while not self._buf:
             if self._done:
                 raise StopIteration
-            if self._sid is None:
-                self._sid = ray_tpu.get(self._sid_ref, timeout=60)
             try:
+                if self._sid is None:
+                    # Inside the try: a failed stream_start (bad method,
+                    # replica death) must release the router's in-flight
+                    # token, or the failed stream occupies a routing slot
+                    # forever.
+                    self._sid = ray_tpu.get(self._sid_ref, timeout=60)
                 items, done = ray_tpu.get(
                     self._h.stream_next.remote(self._sid, self._batch), timeout=300
                 )
@@ -308,7 +317,7 @@ class DeploymentHandle:
         # replicas hold child handles): rebuild there with a fresh Router
         # bound to the named controller — the local Router holds locks and
         # a live controller handle wrapper that don't pickle.
-        return (_rebuild_handle, (self._name, self._method))
+        return (_rebuild_handle, (self._name, self._method, self._stream))
 
     def __repr__(self):
         m = f".{self._method}" if self._method else ""
@@ -319,7 +328,9 @@ _process_router: Optional[Router] = None
 _process_router_lock = threading.Lock()
 
 
-def _rebuild_handle(name: str, method: Optional[str]) -> "DeploymentHandle":
+def _rebuild_handle(
+    name: str, method: Optional[str], stream: bool = False
+) -> "DeploymentHandle":
     """ONE Router per process, shared by every unpickled handle: per-handle
     routers would each get their own in-flight accounting (N handles could
     push N x max_concurrent to one replica) and each poll the controller."""
@@ -331,4 +342,4 @@ def _rebuild_handle(name: str, method: Optional[str]) -> "DeploymentHandle":
 
             controller = ray_tpu.get_actor(SERVE_CONTROLLER_NAME, SERVE_NAMESPACE)
             _process_router = Router(controller)
-    return DeploymentHandle(name, _process_router, method)
+    return DeploymentHandle(name, _process_router, method, stream)

@@ -303,6 +303,40 @@ def test_impala_cartpole_learns(rt):
         algo.stop()
 
 
+class _FlakyCartPole(CartPoleVectorEnv):
+    """Raises on the first step() of the process, then behaves."""
+
+    _raised = False
+
+    def step(self, actions):
+        if not _FlakyCartPole._raised:
+            _FlakyCartPole._raised = True
+            raise RuntimeError("transient env failure")
+        return super().step(actions)
+
+
+def test_impala_runner_survives_env_error(rt):
+    """A failing trajectory must surface the error but keep the runner in
+    the async pipeline (regression: the pool silently shrank to empty)."""
+    from ray_tpu.rllib import IMPALAConfig
+
+    algo = (
+        IMPALAConfig()
+        .environment(lambda num_envs, seed: _FlakyCartPole(num_envs, seed))
+        .env_runners(num_env_runners=1, num_envs_per_runner=4, rollout_length=8)
+        .training(updates_per_iteration=2)
+        .debugging(seed=1)
+        .build()
+    )
+    try:
+        with pytest.raises(Exception, match="transient env failure"):
+            algo.train()
+        r = algo.train()  # runner was resubmitted, pipeline intact
+        assert r["num_env_steps_sampled"] > 0
+    finally:
+        algo.stop()
+
+
 def test_dqn_cartpole_learns(rt):
     """Second algorithm on the Algorithm surface: double-DQN with replay
     + target net clearly learns CartPole (reference: rllib dqn suites)."""

@@ -380,6 +380,36 @@ def test_streaming_handle_tokens(serve_instance):
     )
 
 
+def test_stream_handle_survives_pickle_and_bad_method_releases_slot(
+    serve_instance,
+):
+    """Regressions: (a) __reduce__ must carry the stream flag — a pickled
+    stream=True handle silently became non-streaming; (b) a failed
+    stream_start must release the router's in-flight token, or failed
+    streams permanently eat routing slots."""
+    import pickle
+
+    @serve.deployment(name="pkl_lm", max_concurrent_queries=2)
+    class Gen:
+        def __call__(self, prompt):
+            yield from str(prompt).split()
+
+    h = serve.run(Gen.bind(), name="pkl_lm")
+    sh = h.options(stream=True)
+    # (a) real roundtrip: the rebuilt handle must still stream (exercises
+    # _rebuild_handle's stream arg, not just the reduce tuple).
+    sh2 = pickle.loads(pickle.dumps(sh))
+    assert list(sh2.remote("x y")) == ["x", "y"]
+
+    # (b) bad method: the call fails but must not leak its slot.
+    for _ in range(4):  # > max_concurrent_queries
+        it = sh.options(method_name="no_such_method").remote("x")
+        with pytest.raises(Exception):
+            next(it)
+    # All slots released: a healthy stream still gets through immediately.
+    assert list(sh.remote("a b c")) == ["a", "b", "c"]
+
+
 def test_streaming_http_chunked(serve_instance):
     @serve.deployment(name="stream_http")
     def gen(body=None):
