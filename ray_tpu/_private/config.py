@@ -102,6 +102,32 @@ _DEFS: Dict[str, tuple] = {
         "per-worker ring buffer of recent log lines kept for the logs "
         "CLI / dashboard endpoint",
     ),
+    "memory_monitor_refresh_ms": (
+        250, int,
+        "how often each node daemon checks memory pressure; 0 disables "
+        "the OOM monitor (ray: memory_monitor_refresh_ms)",
+    ),
+    "memory_usage_threshold": (
+        0.95, float,
+        "usage fraction above which the daemon kills a worker "
+        "(ray: memory_usage_threshold)",
+    ),
+    "memory_limit_bytes": (
+        0, int,
+        "per-node worker-group RSS budget; 0 = account whole-system "
+        "memory from /proc/meminfo instead (the deployment default)",
+    ),
+    "task_oom_retries": (
+        3, int,
+        "extra retry budget for tasks whose worker was OOM-killed, "
+        "separate from max_retries (ray: task_oom_retries)",
+    ),
+    "oom_worker_killing_policy": (
+        "largest", str,
+        "victim choice under memory pressure: 'largest' RSS (finds the "
+        "actual hog — prestarted idle workers are never bigger) or "
+        "'newest' spawned (ray: worker_killing_policy.h)",
+    ),
     "actor_adopt_grace_s": (
         5.0, float,
         "after a head restart, how long restored detached/named actors "

@@ -1,0 +1,143 @@
+"""Memory monitor + worker killing policy: OOM protection for a node.
+
+ray: src/ray/common/memory_monitor.h:52 (periodic usage check against a
+usage threshold) + src/ray/raylet/worker_killing_policy.h (pick a victim
+worker instead of letting the kernel OOM-kill the raylet).  Runs inside
+each node daemon: a runaway task gets ITS worker killed with a retriable
+out-of-memory error while the node (and every other worker) stays up.
+
+Two accounting modes:
+  * `limit_bytes` set (RAY_TPU_MEMORY_LIMIT_BYTES / _system_config):
+    the node's worker-group RSS is capped at limit_bytes * threshold —
+    this is also how tests drive the monitor deterministically on a
+    shared machine.
+  * `limit_bytes` 0: system mode — (MemTotal - MemAvailable) / MemTotal
+    from /proc/meminfo against the threshold, the reference's default.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+_PAGE = os.sysconf("SC_PAGE_SIZE")
+
+
+def process_rss_bytes(pid: int) -> int:
+    """Resident set of one process via /proc/<pid>/statm (no psutil)."""
+    try:
+        with open(f"/proc/{pid}/statm", "rb") as f:
+            return int(f.read().split()[1]) * _PAGE
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
+def system_memory() -> Tuple[int, int]:
+    """(used_bytes, total_bytes) from /proc/meminfo, kernel's own
+    MemAvailable estimate (ray: memory_monitor.cc GetLinuxMemoryBytes)."""
+    total = avail = 0
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    total = int(line.split()[1]) * 1024
+                elif line.startswith("MemAvailable:"):
+                    avail = int(line.split()[1]) * 1024
+                if total and avail:
+                    break
+    except OSError:
+        return 0, 0
+    return total - avail, total
+
+
+def choose_victim(
+    workers: Dict[str, Tuple[int, float]], policy: str = "largest"
+) -> Optional[str]:
+    """Worker killing policy. `workers`: wid -> (rss_bytes, spawn_ts).
+
+    "largest" (default): kill the biggest RSS — under group pressure that
+    is the actual hog, never an idle pool worker.
+    "newest": kill the most recently spawned worker — least sunk work
+    (ray: retriable-FIFO ordering in worker_killing_policy.cc).
+    """
+    if not workers:
+        return None
+    if policy == "newest":
+        return max(workers.items(), key=lambda kv: kv[1][1])[0]
+    return max(workers.items(), key=lambda kv: kv[1][0])[0]
+
+
+class MemoryMonitor:
+    """Background thread: check usage every `interval_s`, kill ONE victim
+    per breach via `kill_cb(wid, rss, used, limit)`, then hold a cooldown
+    (4x interval, >=1s) so the kernel reclaims the victim's pages before
+    the next verdict — without it a single pressure spike triggers a kill
+    per beat.
+
+    System-mode caveat: /proc/meminfo is HOST-wide, so the deployment
+    assumption is one monitoring daemon per host (the reference's shape —
+    one raylet per node).  Test clusters that co-host several daemons on
+    one machine should set memory_limit_bytes for per-group accounting,
+    where monitors are independent by construction."""
+
+    def __init__(
+        self,
+        get_workers: Callable[[], Dict[str, Tuple[int, float]]],
+        kill_cb: Callable[[str, int, int, int], None],
+        *,
+        limit_bytes: int = 0,
+        threshold: float = 0.95,
+        interval_s: float = 0.25,
+        policy: str = "largest",
+    ):
+        self._get_workers = get_workers
+        self._kill_cb = kill_cb
+        self.limit_bytes = limit_bytes
+        self.threshold = threshold
+        self.interval_s = interval_s
+        self.policy = policy
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="memory-monitor"
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _usage(self, workers) -> Tuple[int, int]:
+        """(used, limit) in the active accounting mode."""
+        if self.limit_bytes > 0:
+            used = sum(rss for rss, _ts in workers.values())
+            return used, int(self.limit_bytes * self.threshold)
+        used, total = system_memory()
+        return used, int(total * self.threshold) if total else (1 << 62)
+
+    def check_once(self) -> Optional[str]:
+        """One monitor beat; returns the killed wid (for tests)."""
+        workers = {
+            wid: (process_rss_bytes(pid), ts)
+            for wid, (pid, ts) in self._get_workers().items()
+        }
+        used, limit = self._usage(workers)
+        if used <= limit:
+            return None
+        victim = choose_victim(workers, self.policy)
+        if victim is None:
+            return None
+        self._kill_cb(victim, workers[victim][0], used, limit)
+        return victim
+
+    def _loop(self) -> None:
+        cooldown = max(1.0, 4 * self.interval_s)
+        while not self._stop.wait(self.interval_s):
+            try:
+                killed = self.check_once()
+            except Exception:
+                killed = None  # monitoring must never take the daemon down
+            if killed is not None and self._stop.wait(cooldown):
+                return

@@ -153,8 +153,58 @@ def main() -> None:
     log_monitor = LogMonitor(log_dir, forward_logs)
 
     children: Dict[str, subprocess.Popen] = {}
+    spawn_ts: Dict[str, float] = {}
+
+    # OOM protection (ray: memory_monitor.h:52 + worker_killing_policy.h):
+    # under memory pressure, kill ONE worker (retriable error head-side)
+    # instead of letting the kernel OOM-killer take the whole daemon.
+    from ray_tpu._private.memory_monitor import MemoryMonitor
+
+    def _oom_workers():
+        return {
+            wid: (p.pid, spawn_ts.get(wid, 0.0))
+            for wid, p in children.items()
+            if p.poll() is None
+        }
+
+    oom_killed: Dict[str, tuple] = {}
+
+    def _oom_kill(wid: str, rss: int, used: int, limit: int) -> None:
+        p = children.get(wid)
+        if p is None:
+            return
+        # Record + tell the head FIRST so the crash is classified as OOM,
+        # then SIGKILL — a graceful terminate could block on the very
+        # allocation that caused the pressure.  The info also rides the
+        # eventual worker_exited report (belt and braces: the worker's own
+        # conn EOF races this message on a different socket).
+        oom_killed[wid] = (rss, used, limit)
+        try:
+            with send_lock:
+                conn.send(("worker_oom_killed", wid, rss, used, limit))
+        except OSError:
+            pass
+        try:
+            p.kill()
+        except OSError:
+            pass
+
+    refresh_ms = _config.get("memory_monitor_refresh_ms")
+    mem_monitor = None
+    if refresh_ms > 0:
+        mem_monitor = MemoryMonitor(
+            _oom_workers,
+            _oom_kill,
+            limit_bytes=_config.get("memory_limit_bytes"),
+            threshold=_config.get("memory_usage_threshold"),
+            interval_s=refresh_ms / 1000.0,
+            policy=_config.get("oom_worker_killing_policy"),
+        )
+        mem_monitor.start()
 
     def shutdown(*_a):
+        if mem_monitor is not None:
+            mem_monitor.stop()
         for p in children.values():
             try:
                 p.terminate()
@@ -196,9 +246,10 @@ def main() -> None:
             rc = p.poll()
             if rc is not None:
                 children.pop(wid, None)
+                spawn_ts.pop(wid, None)
                 try:
                     with send_lock:
-                        conn.send(("worker_exited", wid, rc))
+                        conn.send(("worker_exited", wid, rc, oom_killed.pop(wid, None)))
                 except OSError:
                     pass
 
@@ -242,6 +293,9 @@ def main() -> None:
                     stdout=outf,
                     stderr=errf,
                 )
+                import time as _time
+
+                spawn_ts[wid] = _time.monotonic()
             finally:
                 outf.close()
                 errf.close()

@@ -50,6 +50,7 @@ from ray_tpu.exceptions import (
     ActorDiedError,
     GetTimeoutError,
     ObjectLostError,
+    OutOfMemoryError,
     TaskCancelledError,
     TaskError,
     WorkerCrashedError,
@@ -193,6 +194,7 @@ class WorkerHandle:
         "actor_id",
         "known_fns",
         "pid",
+        "spawn_ts",
     )
 
     def __init__(self, worker_id, node_id, env_key, env_vars, proc):
@@ -208,6 +210,7 @@ class WorkerHandle:
         self.actor_id: Optional[str] = None
         self.known_fns: Set[str] = set()
         self.pid = None
+        self.spawn_ts = time.monotonic()
 
 
 class TaskRecord:
@@ -367,6 +370,13 @@ class Runtime:
         self.node_daemons: Dict[str, Any] = {}
         self._conn_to_daemon: Dict[Any, str] = {}
         self._daemon_procs: Dict[str, Any] = {}  # node_id -> Popen (local launch)
+        # wid -> (rss, used, limit): daemons report OOM kills BEFORE the
+        # SIGKILL so the ensuing crash is classified as retriable OOM.
+        self._oom_kills: Dict[str, tuple] = {}
+        # wid -> deadline: a daemon-owned worker's conn EOF waits briefly
+        # for its daemon's authoritative worker_exited (which says WHY —
+        # the two arrive on different sockets and can reorder).
+        self._deferred_crashes: Dict[str, float] = {}
         # Attached driver clients (head-split mode, head.py): did -> conn,
         # plus the pseudo-node each non-co-located driver reads objects as,
         # and per-driver ref borrows dropped on driver death
@@ -402,6 +412,47 @@ class Runtime:
         self._io_thread = threading.Thread(target=self._io_loop, daemon=True, name="raytpu-io")
         self._accept_thread.start()
         self._io_thread.start()
+
+        # Head-node OOM protection: the head process doubles as this node's
+        # daemon for locally-spawned workers, so it runs the same memory
+        # monitor a node daemon does (ray: memory_monitor.h:52 — the raylet
+        # embeds the monitor; our daemon nodes run their own copy).
+        self._mem_monitor = None
+        refresh_ms = _config.get("memory_monitor_refresh_ms")
+        if refresh_ms > 0:
+            from ray_tpu._private.memory_monitor import MemoryMonitor
+
+            def _local_workers():
+                with self.lock:
+                    return {
+                        wid: (h.pid, h.spawn_ts)
+                        for wid, h in self.workers.items()
+                        if isinstance(h.proc, _PopenHandle)
+                        and h.pid
+                        and h.state != "dead"
+                    }
+
+            def _oom_kill(wid, rss, used, limit):
+                with self.lock:
+                    h = self.workers.get(wid)
+                    if h is None or h.state == "dead":
+                        return
+                    self._oom_kills[wid] = (rss, used, limit)
+                    try:
+                        h.proc.kill()
+                    except OSError:
+                        pass
+                # reaper/conn-EOF classifies the death as OOM via the flag
+
+            self._mem_monitor = MemoryMonitor(
+                _local_workers,
+                _oom_kill,
+                limit_bytes=_config.get("memory_limit_bytes"),
+                threshold=_config.get("memory_usage_threshold"),
+                interval_s=refresh_ms / 1000.0,
+                policy=_config.get("oom_worker_killing_policy"),
+            )
+            self._mem_monitor.start()
 
         set_ref_hooks(self._addref_local, self._decref_local)
         atexit.register(self.shutdown)
@@ -1065,6 +1116,14 @@ class Runtime:
                             and not h.proc.is_alive()
                         ):
                             self._on_worker_crash(wid)
+                    # Deferred daemon-worker EOFs whose daemon never
+                    # reported (hung daemon / lost message): classify now.
+                    for wid, deadline in list(self._deferred_crashes.items()):
+                        if now >= deadline:
+                            self._deferred_crashes.pop(wid, None)
+                            h = self.workers.get(wid)
+                            if h is not None and h.state != "dead":
+                                self._on_worker_crash(wid)
             with self.lock:
                 conns = (
                     list(self._conn_to_worker.keys())
@@ -1078,6 +1137,10 @@ class Runtime:
                 readable = conn_wait(conns, timeout=0.05)
             except OSError:
                 continue
+            # Daemon conns first: an OOM-kill report must be applied before
+            # the victim worker's own conn EOF (same select round) so the
+            # crash classifies as OOM, not a generic worker death.
+            readable.sort(key=lambda c: c not in self._conn_to_daemon)
             for conn in readable:
                 nid = self._conn_to_daemon.get(conn)
                 if nid is not None:
@@ -1093,6 +1156,10 @@ class Runtime:
                         # output: same sink as head-local files.
                         self._on_log_lines(dmsg[1], dmsg[2], dmsg[3])
                         continue
+                    if isinstance(dmsg, tuple) and dmsg and dmsg[0] == "worker_oom_killed":
+                        with self.lock:
+                            self._oom_kills[dmsg[1]] = dmsg[2:]
+                        continue
                     if isinstance(dmsg, tuple) and dmsg and dmsg[0] == "worker_exited":
                         # A remote child died (possibly before connecting):
                         # the driver-side reaper can't see it, the daemon can.
@@ -1100,6 +1167,12 @@ class Runtime:
                             h = self.workers.get(dmsg[1])
                             if h is not None and isinstance(h.proc, _RemoteProcHandle):
                                 h.proc.dead = True
+                            # The daemon's report is authoritative on WHY:
+                            # its OOM rider survives even when the victim's
+                            # own conn EOF won the message race.
+                            if len(dmsg) > 3 and dmsg[3] is not None:
+                                self._oom_kills.setdefault(dmsg[1], tuple(dmsg[3]))
+                            self._deferred_crashes.pop(dmsg[1], None)
                             if h is not None and h.state != "dead":
                                 self._on_worker_crash(dmsg[1])
                     continue
@@ -1127,7 +1200,19 @@ class Runtime:
                 except (EOFError, OSError):
                     with self.lock:
                         self._conn_to_worker.pop(conn, None)
-                        self._on_worker_crash(wid)
+                        h = self.workers.get(wid)
+                        if (
+                            h is not None
+                            and isinstance(h.proc, _RemoteProcHandle)
+                            and h.node_id in self.node_daemons
+                            and wid not in self._oom_kills
+                        ):
+                            # Daemon-owned worker: wait briefly for the
+                            # daemon's worker_exited (carries the OOM
+                            # rider) before classifying the crash.
+                            self._deferred_crashes[wid] = time.monotonic() + 2.0
+                        else:
+                            self._on_worker_crash(wid)
                     continue
                 try:
                     self._handle_msg(wid, msg)
@@ -1890,6 +1975,7 @@ class Runtime:
 
     def _on_worker_crash(self, wid: str) -> None:
         # caller holds self.lock
+        oom = self._oom_kills.pop(wid, None)
         h = self.workers.pop(wid, None)
         if h is None or h.state == "dead":
             return  # duplicate notification (daemon report + conn EOF)
@@ -1913,6 +1999,38 @@ class Runtime:
             self._release_for(rec)
             for oid in spec.return_ids():
                 self.store.put_error(oid, TaskCancelledError(spec.name))
+                self._object_ready(oid)
+            for c in spec.contained_refs:
+                self._decref_local(c)
+            return
+        if oom is not None:
+            from ray_tpu._private import config as _config
+
+            # OOM kills retry on their OWN budget (ray: task_oom_retries) —
+            # a memory-pressure victim is not a task bug, and max_retries=0
+            # tasks still deserve another placement.
+            oom_attempts = getattr(spec, "oom_attempts", 0)
+            if oom_attempts < _config.get("task_oom_retries"):
+                spec.oom_attempts = oom_attempts + 1
+                self.metrics["tasks_retried"] += 1
+                self._release_for(rec)
+                rec.state = "READY"
+                rec.worker_id = None
+                self.ready_queue.append(tid)
+                self._dispatch()
+                return
+            rss, used, limit = oom
+            self.tasks.pop(tid, None)
+            self._release_for(rec)
+            self._record_task_end(rec, wid, "FAILED")
+            err = OutOfMemoryError(
+                f"task {spec.name}'s worker was killed by the node memory "
+                f"monitor (rss={rss >> 20}MiB, node usage {used >> 20}MiB "
+                f"> limit {limit >> 20}MiB) after "
+                f"{oom_attempts} OOM retries"
+            )
+            for oid in spec.return_ids():
+                self.store.put_error(oid, err)
                 self._object_ready(oid)
             for c in spec.contained_refs:
                 self._decref_local(c)
@@ -2229,6 +2347,8 @@ class Runtime:
         self._shutdown = True
         atexit.unregister(self.shutdown)
         set_ref_hooks(None, None)
+        if getattr(self, "_mem_monitor", None) is not None:
+            self._mem_monitor.stop()
         # Final log drain: crash output written moments ago must reach the
         # ring buffers/stdout before the session dies.
         try:

@@ -59,6 +59,12 @@ class ObjectLostError(RayTpuError):
     pass
 
 
+class OutOfMemoryError(RayTpuError):
+    """The node's memory monitor killed this task's worker under memory
+    pressure (ray: ray.exceptions.OutOfMemoryError via memory_monitor.h:52).
+    Retriable with its own budget (task_oom_retries) before surfacing."""
+
+
 class ObjectStoreFullError(RayTpuError):
     """The shm store is at capacity and nothing can be evicted or spilled
     (ray: plasma CreateRequestQueue backpressure → ObjectStoreFullError)."""

@@ -315,6 +315,7 @@ class IMPALA:
         self.iteration = 0
         self._updates = 0
         self._total_steps = 0
+        self._dead_runners = 0
         self._episode_returns: List[float] = []
         self._lags: List[int] = []
 
@@ -330,21 +331,40 @@ class IMPALA:
         self._state["params"] = jax.tree_util.tree_map(jnp.asarray, weights)
 
     def _harvest_one(self, timeout: float = 120.0):
-        ready, _ = ray_tpu.wait(
-            list(self._inflight), num_returns=1, timeout=timeout
-        )
-        if not ready:
-            raise TimeoutError("no trajectory completed within timeout")
-        ref = ready[0]
-        runner = self._inflight.pop(ref)
-        # Resubmit BEFORE the get: the completed ref's get can still raise
-        # (user env error) and the runner must stay in the pipeline either
-        # way — losing it would silently shrink the pool until train()
-        # times out with no runners left.
-        self._inflight[
-            runner.sample_trajectory.remote(self._weights_ref, self._weights_version)
-        ] = runner
-        return ray_tpu.get(ref)
+        from ray_tpu.exceptions import ActorDiedError
+
+        while True:
+            if not self._inflight:
+                raise RuntimeError(
+                    f"all {self.config.num_env_runners} env runners have died"
+                )
+            ready, _ = ray_tpu.wait(
+                list(self._inflight), num_returns=1, timeout=timeout
+            )
+            if not ready:
+                raise TimeoutError("no trajectory completed within timeout")
+            ref = ready[0]
+            runner = self._inflight.pop(ref)
+            # Resubmit BEFORE the get: the completed ref's get can still
+            # raise (user env error) and the runner must stay in the
+            # pipeline either way — losing it would silently shrink the
+            # pool until train() times out with no runners left.
+            new_ref = runner.sample_trajectory.remote(
+                self._weights_ref, self._weights_version
+            )
+            self._inflight[new_ref] = runner
+            try:
+                return ray_tpu.get(ref)
+            except ActorDiedError:
+                # The runner ACTOR is gone (crash/OOM-kill): drop it — its
+                # resubmitted ref would error instantly and win every wait,
+                # starving healthy runners forever (livelock). Training
+                # degrades to the surviving pool (ray: the reference's
+                # ignore_env_runner_failures degradation).
+                self._inflight.pop(new_ref, None)
+                self.runners = [r for r in self.runners if r is not runner]
+                self._dead_runners += 1
+                continue
 
     def train(self) -> Dict[str, Any]:
         t0 = time.time()
@@ -381,6 +401,7 @@ class IMPALA:
             "env_steps_per_sec": steps / max(time.time() - t0, 1e-9),
             "avg_weights_lag": float(np.mean(self._lags)) if self._lags else 0.0,
             "num_updates": self._updates,
+            "num_dead_env_runners": self._dead_runners,
             **{k: float(v) for k, v in metrics.items()},
         }
 

@@ -337,6 +337,31 @@ def test_impala_runner_survives_env_error(rt):
         algo.stop()
 
 
+def test_impala_degrades_when_runner_actor_dies(rt):
+    """A dead runner ACTOR (not a task error) is dropped from the pipeline
+    and training continues on the survivors — a permanently erroring ref
+    must not starve healthy runners (livelock regression)."""
+    from ray_tpu.rllib import IMPALAConfig
+
+    algo = (
+        IMPALAConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=2, num_envs_per_runner=4, rollout_length=8)
+        .training(updates_per_iteration=4)
+        .debugging(seed=2)
+        .build()
+    )
+    try:
+        algo.train()
+        ray_tpu.kill(algo.runners[0])
+        r = algo.train()  # must not raise or hang
+        assert r["num_dead_env_runners"] == 1
+        assert len(algo.runners) == 1
+        assert r["num_env_steps_sampled"] > 0
+    finally:
+        algo.stop()
+
+
 def test_dqn_cartpole_learns(rt):
     """Second algorithm on the Algorithm surface: double-DQN with replay
     + target net clearly learns CartPole (reference: rllib dqn suites)."""
