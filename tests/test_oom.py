@@ -66,20 +66,27 @@ def test_memory_monitor_group_limit_kills_largest():
 
 @pytest.fixture
 def oom_cluster():
-    ray_tpu.init(
-        num_cpus=2,
-        ignore_reinit_error=True,
-        _system_config={
-            # Group-RSS budget small enough that one hog breaches it fast,
-            # big enough that the idle pool (2 jax-free workers) never does.
-            "memory_limit_bytes": 600 * 1024 * 1024,
-            "memory_monitor_refresh_ms": 100,
-            "memory_usage_threshold": 0.9,
-            "task_oom_retries": 1,
-        },
-    )
+    import os
+
+    overrides = {
+        # Group-RSS budget small enough that one hog breaches it fast,
+        # big enough that the idle pool (2 jax-free workers) never does.
+        "memory_limit_bytes": 600 * 1024 * 1024,
+        "memory_monitor_refresh_ms": 100,
+        "memory_usage_threshold": 0.9,
+        "task_oom_retries": 1,
+    }
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True, _system_config=overrides)
     yield
     ray_tpu.shutdown()
+    # set_system_config freezes values AND exports RAY_TPU_* env vars so
+    # children inherit them — both outlive this cluster and would OOM-kill
+    # later tests' jax-heavy workers against the tiny 600MB group budget.
+    from ray_tpu._private import config
+
+    for k in overrides:
+        os.environ.pop(f"RAY_TPU_{k.upper()}", None)
+    config._reset_for_tests()
 
 
 def test_oom_killed_task_raises_and_cluster_survives(oom_cluster):

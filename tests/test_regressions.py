@@ -88,3 +88,48 @@ def test_train_step_with_mask():
     batch = {"tokens": toks[:, :-1], "targets": toks[:, 1:], "mask": mask}
     state, metrics = ctx.train_step(state, batch)
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_dep_error_fails_task_queued_behind_blocked_bucket(ray_start_regular):
+    """Bucketed dispatch probes only bucket heads: a task whose dependency
+    errored must still fail fast even while queued behind an unplaceable
+    sibling of the same shape (regression: it hung until the head placed)."""
+    import time
+
+    import pytest
+
+    import ray_tpu
+    from ray_tpu.exceptions import TaskError
+
+    @ray_tpu.remote
+    class Hog:
+        def ping(self):
+            return "ok"
+
+    @ray_tpu.remote
+    def boom():
+        raise RuntimeError("producer failed")
+
+    @ray_tpu.remote
+    def consumer(x):
+        return x
+
+    @ray_tpu.remote
+    def sleeper():
+        time.sleep(30)
+
+    # Occupy every CPU with actors so plain tasks cannot place.
+    hogs = [Hog.options(num_cpus=1).remote() for _ in range(4)]
+    ray_tpu.get([h.ping.remote() for h in hogs], timeout=30)
+    # num_cpus=0: the producer must actually RUN (and fail) while the
+    # CPU-shaped bucket stays blocked by the sleepers.
+    bad = boom.options(num_cpus=0).remote()
+    blocked = [sleeper.remote() for _ in range(2)]  # bucket heads, unplaceable
+    dependent = consumer.remote(bad)
+    # The dependent must fail with the producer's error promptly, NOT wait
+    # for a CPU to free up.
+    with pytest.raises(TaskError, match="producer failed"):
+        ray_tpu.get(dependent, timeout=20)
+    for h in hogs:
+        ray_tpu.kill(h)
+    del blocked
