@@ -54,8 +54,10 @@ class TransformerConfig:
     remat_policy: Optional[str] = None
     attention_impl: Optional[str] = None  # None=auto, see ops.attention
     # Microbatches per pipeline-stage schedule when the rules shard the
-    # layer stack over the `pipeline` axis (strategy="pp").
-    pp_microbatches: int = 4
+    # layer stack over the `pipeline` axis (strategy="pp"/"pp_fsdp").
+    # None derives min(4 * n_stages, local batch) — ~20% GPipe bubble
+    # without slicing microbatches below MXU-efficient sizes.
+    pp_microbatches: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
@@ -288,11 +290,18 @@ def _run_layers_pipelined(
     config: TransformerConfig,
     mesh,
     axis: str,
+    rules: Optional[Dict] = None,
+    fsdp_axis: Optional[str] = None,
 ) -> jax.Array:
     """Run the [L, ...] layer stack as a GPipe pipeline: the stack reshapes
     to [P, L/P, ...] (stage-major), each pipeline-axis device scans its own
     L/P layers, and microbatches stream between stages with ppermute
-    (parallel/pipeline.py)."""
+    (parallel/pipeline.py).
+
+    With `fsdp_axis` (strategy "pp_fsdp"), each stage's params additionally
+    live SHARDED over that axis and are all-gathered once per step inside
+    the stage body — optimizer state and params-at-rest take 1/(P*F) of the
+    model per device instead of 1/P."""
     from ray_tpu.parallel.pipeline import pipeline_apply
 
     c = config
@@ -302,6 +311,23 @@ def _run_layers_pipelined(
     stacked = jax.tree_util.tree_map(
         lambda a: a.reshape(n_stages, per_stage, *a.shape[1:]), layer_params
     )
+
+    fsdp_dims = None
+    if fsdp_axis is not None and rules is not None:
+        layer_axes = param_axes(c)["layers"]
+
+        def dim_for(axes_tuple):
+            # stacked leaf dims: [P, L/P, *per-layer dims]; logical name i
+            # (after the leading "layers") lands at stacked dim i + 2.
+            for i, name in enumerate(axes_tuple[1:]):
+                if name is not None and rules.get(name) == fsdp_axis:
+                    return i + 2
+            return None
+
+        fsdp_dims = jax.tree_util.tree_map(
+            dim_for, layer_axes,
+            is_leaf=lambda t: isinstance(t, tuple),
+        )
 
     def stage_fn(stage_params, h):
         def body(carry, lp):
@@ -313,7 +339,9 @@ def _run_layers_pipelined(
     if c.remat:
         stage_fn = jax.checkpoint(stage_fn, policy=_remat_policy(c))
     return pipeline_apply(
-        stage_fn, stacked, x, mesh, n_microbatches=c.pp_microbatches, axis=axis
+        stage_fn, stacked, x, mesh,
+        n_microbatches=c.pp_microbatches, axis=axis,
+        fsdp_dims=fsdp_dims, fsdp_axis=fsdp_axis or "fsdp",
     )
 
 
@@ -355,17 +383,36 @@ def forward(
                             "vocab", "expert")
                 if rules.get(k) is not None
             ]
-            if sharded_params:
+            # fsdp-at-rest composes with pp (strategy "pp_fsdp"): the
+            # sharded param axes are all-gathered per stage per step inside
+            # the schedule.  TP-style axes (which also shard activations)
+            # do NOT — gathering them would silently undo the tensor split.
+            act_axes = set()
+            for k, v in rules.items():
+                if k.startswith("act_") and k != "act_batch" and v is not None:
+                    act_axes.update(v if isinstance(v, tuple) else (v,))
+            pp_fsdp_axes = set()
+            bad = []
+            for k in sharded_params:
+                v = rules[k]
+                if isinstance(v, tuple) or v == ax or v in act_axes:
+                    bad.append(k)
+                else:
+                    pp_fsdp_axes.add(v)
+            if bad or len(pp_fsdp_axes) > 1:
                 raise ValueError(
-                    "strategy 'pp' currently composes with data-sharded "
-                    f"batches only; param dims {sharded_params} are also "
-                    "sharded — the pipeline stage specs would silently "
-                    "all-gather them per stage device"
+                    "strategy 'pp' composes with data sharding and ONE "
+                    "fsdp-at-rest param axis (strategy 'pp_fsdp'); "
+                    f"param dims {bad or sharded_params} shard over "
+                    "activation/tensor axes the pipeline schedule cannot "
+                    "gather away"
                 )
             pp_axis = ax
+            pp_fsdp_axis = pp_fsdp_axes.pop() if pp_fsdp_axes else None
     if pp_axis is not None:
         x = _run_layers_pipelined(
-            params["layers"], x, positions, c, pp_mesh, pp_axis
+            params["layers"], x, positions, c, pp_mesh, pp_axis,
+            rules=rules, fsdp_axis=pp_fsdp_axis,
         )
     else:
         layer_fn = functools.partial(

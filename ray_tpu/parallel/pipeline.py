@@ -23,6 +23,18 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 
+def _gather_params(params, gather_dims):
+    """all_gather the fsdp-sharded leaves (see _pipeline_body docstring).
+    gather_dims leaves are (dim_index, mesh_axis) tuples or None."""
+    flat_p, treedef = jax.tree_util.tree_flatten(params)
+    flat_g = treedef.flatten_up_to(gather_dims)
+    gathered = [
+        p if gd is None else jax.lax.all_gather(p, gd[1], axis=gd[0], tiled=True)
+        for p, gd in zip(flat_p, flat_g)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, gathered)
+
+
 def _pipeline_body(
     stage_params,
     x: jax.Array,
@@ -30,17 +42,26 @@ def _pipeline_body(
     fn: Callable,
     n_microbatches: int,
     axis: str,
+    gather_dims=None,
 ):
     """Per-shard body (inside shard_map).
 
     stage_params: this stage's params with a leading length-1 stage dim.
     x: this data-shard's batch [B_local, ...]; only stage 0 consumes it,
     but every stage holds it (replicated over the pipeline axis).
+    gather_dims: optional pytree congruent with stage_params of
+    (dim, mesh_axis) or None per leaf — fsdp-at-rest composition: the leaf
+    arrives sharded on `dim` over `mesh_axis` and is all-gathered here
+    before the stage scan (its AD transpose is a reduce-scatter, so grads
+    land sharded again — ZeRO-style param/optimizer sharding with one
+    gather per stage per step).
     Returns y [B_local, ...] replicated over the pipeline axis.
     """
     n_stages = jax.lax.psum(1, axis)
     stage = jax.lax.axis_index(axis)
     params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+    if gather_dims is not None:
+        params = _gather_params(params, gather_dims)
 
     B = x.shape[0]
     if B < 1:
@@ -103,9 +124,11 @@ def pipeline_apply(
     x: jax.Array,
     mesh: Mesh,
     *,
-    n_microbatches: int,
+    n_microbatches: Optional[int] = None,
     axis: str = "pipeline",
     batch_axes: Sequence[str] = ("data", "fsdp"),
+    fsdp_dims=None,
+    fsdp_axis: str = "fsdp",
 ):
     """Apply `fn` (one stage's computation: fn(params, x) -> y, same shape)
     as a pipeline of P stages.
@@ -114,14 +137,60 @@ def pipeline_apply(
     mesh-axis size), e.g. stacked layer weights [P, ...].
     x: global batch [B, ...]; B shards over batch_axes; the microbatch
     schedule runs inside each data shard.
+    n_microbatches: None derives M = min(4 * P, local batch) — 4P keeps the
+    GPipe bubble (P-1)/(M+P-1) near 20% without shrinking microbatches
+    into MXU-starving slivers.
+    fsdp_dims: optional pytree congruent with stacked_params of per-leaf
+    dim index (into the STACKED leaf, so >= 1) to shard over `fsdp_axis`
+    at rest — pp x fsdp composition: params live sharded, are all-gathered
+    per stage per step, and their grads reduce-scatter back (ZeRO-style).
+    Leaves with None (or dims that don't divide) stay replicated.
     """
     from jax import shard_map
 
+    n_stages = mesh.shape[axis]
     batch_axes = tuple(a for a in batch_axes if a in mesh.axis_names and mesh.shape[a] > 1)
-    param_spec = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    if n_microbatches is None:
+        import math
+
+        local_b = x.shape[0] // max(
+            math.prod(mesh.shape[a] for a in batch_axes), 1
+        )
+        # Largest DIVISOR of the local batch <= 4P: the derived default
+        # must be exactly feasible (the body's truncation warning is for
+        # explicit user values, not for our own derivation).
+        n_microbatches = max(
+            (m for m in range(1, min(4 * n_stages, local_b) + 1)
+             if local_b % m == 0),
+            default=1,
+        )
+
+    fsdp_size = mesh.shape[fsdp_axis] if fsdp_axis in mesh.axis_names else 1
+
+    def leaf_plan(p, d):
+        """(in_spec, gather_dim) for one stacked leaf."""
+        if d is None or fsdp_size <= 1 or p.shape[d] % fsdp_size != 0:
+            return P(axis), None
+        entries = [axis] + [None] * (d - 1) + [fsdp_axis]
+        # gather dim is d-1 inside the body (stage dim dropped there)
+        return P(*entries), (d - 1, fsdp_axis)
+
+    if fsdp_dims is not None:
+        flat_p, treedef = jax.tree_util.tree_flatten(stacked_params)
+        flat_d = treedef.flatten_up_to(fsdp_dims)
+        plans = [leaf_plan(p, d) for p, d in zip(flat_p, flat_d)]
+        param_spec = jax.tree_util.tree_unflatten(treedef, [s for s, _ in plans])
+        gather_dims = jax.tree_util.tree_unflatten(treedef, [g for _, g in plans])
+    else:
+        param_spec = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+        gather_dims = None
     xspec = P(batch_axes if batch_axes else None)
     body = functools.partial(
-        _pipeline_body, fn=fn, n_microbatches=n_microbatches, axis=axis
+        _pipeline_body,
+        fn=fn,
+        n_microbatches=n_microbatches,
+        axis=axis,
+        gather_dims=gather_dims,
     )
     return shard_map(
         body,

@@ -108,6 +108,16 @@ def pp_rules() -> Rules:
     return r
 
 
+def pp_fsdp_rules() -> Rules:
+    """Pipeline x FSDP: layer stack over `pipeline`, params-at-rest sharded
+    over `fsdp` WITHIN each stage (all-gathered per stage per step, grads
+    reduce-scattered back — ZeRO-style optimizer-state sharding on top of
+    the GPipe schedule; parallel/pipeline.py fsdp_dims)."""
+    r = dict(_BASE)
+    r.update(layers="pipeline", embed="fsdp")
+    return r
+
+
 def ep_rules() -> Rules:
     """Expert parallel for MoE layers."""
     r = fsdp_tp_rules()
@@ -122,6 +132,7 @@ PRESETS = {
     "fsdp_tp": fsdp_tp_rules,
     "sp": sp_rules,
     "pp": pp_rules,
+    "pp_fsdp": pp_fsdp_rules,
     "ep": ep_rules,
 }
 
