@@ -46,11 +46,27 @@ def attach(
 
     if _attached is not None:
         return _attached
-    if isinstance(address, str) and (os.path.exists(address) or os.path.isdir(address)):
-        info = read_head_info(address)
+    addr = str(address)
+    for scheme in ("ray://", "ray_tpu://"):
+        if addr.startswith(scheme):
+            # The ray:// client scheme (ray: util/client/ARCHITECTURE.md):
+            # a remote driver by definition — never assume the head's
+            # filesystem is reachable, whatever the host looks like.
+            addr = addr[len(scheme):]
+            if shared_store is None:
+                shared_store = False
+            break
+    if os.path.exists(addr):
+        info = read_head_info(addr)
         host, port, key = info["host"], int(info["port"]), bytes.fromhex(info["authkey"])
     else:
-        host, port = str(address).rsplit(":", 1)
+        if authkey is None:
+            raise ValueError(
+                f"attaching to {address!r} by host:port requires the head's "
+                "authkey: pass ray_tpu.init(address=..., _authkey=...) — "
+                "`ray_tpu start --head` prints the full line"
+            )
+        host, port = addr.rsplit(":", 1)
         key = bytes.fromhex(authkey)
         port = int(port)
 

@@ -123,9 +123,15 @@ def launch_head_subprocess(
     session: Optional[str] = None,
     persist: bool = True,
     wait_timeout: float = 60.0,
+    detach: bool = False,
 ) -> Tuple[object, str]:
     """Start a head process and wait for its head.json (test/CLI helper).
-    Returns (Popen, head_json_path)."""
+    Returns (Popen, head_json_path).
+
+    detach=True: own session + stdio to files under session_dir, so the
+    head outlives the launcher and holds no inherited pipes open (`ray_tpu
+    start --head` — without this, a caller reading the CLI's stdout pipe
+    would block until the head itself exits)."""
     import subprocess
 
     env = os.environ.copy()
@@ -159,9 +165,20 @@ def launch_head_subprocess(
         os.unlink(path)  # a stale file would ack before the head is up
     except OSError:
         pass
+    popen_kw = {}
+    if detach:
+        out = open(os.path.join(session_dir, "head.out"), "ab")
+        err = open(os.path.join(session_dir, "head.err"), "ab")
+        popen_kw = {"stdout": out, "stderr": err, "start_new_session": True}
     proc = subprocess.Popen(
-        [sys.executable, "-m", "ray_tpu._private.head"], env=env, close_fds=True
+        [sys.executable, "-m", "ray_tpu._private.head"],
+        env=env,
+        close_fds=True,
+        **popen_kw,
     )
+    if detach:
+        out.close()
+        err.close()
     deadline = time.monotonic() + wait_timeout
     while time.monotonic() < deadline:
         if os.path.exists(path):

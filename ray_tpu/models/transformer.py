@@ -399,13 +399,18 @@ def forward(
                     bad.append(k)
                 else:
                     pp_fsdp_axes.add(v)
-            if bad or len(pp_fsdp_axes) > 1:
+            if bad:
                 raise ValueError(
                     "strategy 'pp' composes with data sharding and ONE "
-                    "fsdp-at-rest param axis (strategy 'pp_fsdp'); "
-                    f"param dims {bad or sharded_params} shard over "
-                    "activation/tensor axes the pipeline schedule cannot "
-                    "gather away"
+                    "fsdp-at-rest param axis (strategy 'pp_fsdp'); param "
+                    f"dims {bad} shard over activation/tensor axes the "
+                    "pipeline schedule cannot gather away"
+                )
+            if len(pp_fsdp_axes) > 1:
+                raise ValueError(
+                    "strategy 'pp' composes with at most ONE fsdp-at-rest "
+                    f"param axis, got {sorted(pp_fsdp_axes)} across "
+                    f"{sharded_params}"
                 )
             pp_axis = ax
             pp_fsdp_axis = pp_fsdp_axes.pop() if pp_fsdp_axes else None

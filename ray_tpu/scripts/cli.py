@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 
@@ -95,6 +96,79 @@ def cmd_logs(args) -> int:
     return 0
 
 
+def cmd_start(args) -> int:
+    """`ray_tpu start --head`: boot a standalone head process (ray: `ray
+    start --head`).  Prints the head.json path + the ray:// address a
+    remote driver passes to init()."""
+    from ray_tpu._private.head import launch_head_subprocess
+
+    if not args.head:
+        print(
+            "only --head is supported here; on worker hosts launch "
+            "`python -m ray_tpu._private.node_daemon` pointed at the head "
+            "(env RAY_TPU_DRIVER_HOST/PORT/AUTHKEY, RAY_TPU_NODE_CONFIG)",
+            file=sys.stderr,
+        )
+        return 2
+    session_dir = args.session_dir or os.path.join(
+        "/tmp", f"raytpu-session-{os.getpid()}"
+    )
+    os.makedirs(session_dir, exist_ok=True)
+    proc, head_json = launch_head_subprocess(
+        session_dir, num_cpus=args.num_cpus, session=args.session, detach=True
+    )
+    with open(head_json) as f:
+        info = json.load(f)
+    # Record the head pid so `ray_tpu stop` can find it.
+    with open(os.path.join(session_dir, "head.pid"), "w") as f:
+        f.write(str(proc.pid))
+    print(f"head started (pid {proc.pid})")
+    print(f"  head.json: {head_json}")
+    print(f"  attach:    ray_tpu.init(address={head_json!r})")
+    print(
+        f"  remote:    ray_tpu.init(address='ray://{info['host']}:"
+        f"{info['port']}', _authkey={info['authkey']!r})"
+    )
+    return 0
+
+
+def cmd_stop(args) -> int:
+    """`ray_tpu stop`: terminate the head started by `ray_tpu start`."""
+    import signal as _signal
+
+    pid_file = os.path.join(args.session_dir, "head.pid")
+    try:
+        with open(pid_file) as f:
+            pid = int(f.read().strip())
+    except (OSError, ValueError):
+        print(f"no head.pid under {args.session_dir}", file=sys.stderr)
+        return 1
+    # Stale-pid guard: after a crash/reboot the OS may have reused the pid
+    # for an unrelated process — only SIGTERM something that IS a head.
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            cmdline = f.read().decode(errors="replace")
+    except OSError:
+        cmdline = ""
+    if "ray_tpu._private.head" not in cmdline:
+        try:
+            os.unlink(pid_file)
+        except OSError:
+            pass
+        print(f"pid {pid} is not a ray_tpu head (stale head.pid removed)")
+        return 0
+    try:
+        os.kill(pid, _signal.SIGTERM)
+    except ProcessLookupError:
+        pass
+    try:
+        os.unlink(pid_file)
+    except OSError:
+        pass
+    print(f"sent SIGTERM to head pid {pid}")
+    return 0
+
+
 def cmd_bench(args) -> int:
     import os
     import subprocess
@@ -137,6 +211,17 @@ def main(argv=None) -> int:
 
     be = sub.add_parser("bench", help="run the train benchmark (bench.py)")
     be.set_defaults(fn=cmd_bench)
+
+    sta = sub.add_parser("start", help="start a standalone head process")
+    sta.add_argument("--head", action="store_true")
+    sta.add_argument("--num-cpus", type=int, default=4)
+    sta.add_argument("--session-dir")
+    sta.add_argument("--session")
+    sta.set_defaults(fn=cmd_start)
+
+    sto = sub.add_parser("stop", help="stop the head started by `start`")
+    sto.add_argument("--session-dir", required=True)
+    sto.set_defaults(fn=cmd_stop)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -219,3 +219,58 @@ def test_head_restart_replays_state(tmp_path):
     finally:
         if proc.poll() is None:
             proc.kill()
+
+
+def test_ray_scheme_remote_client_mode(head, tmp_path):
+    """ray:// attach = Ray Client equivalent (ray: util/client/
+    ARCHITECTURE.md): the driver must work WITHOUT mapping the head's
+    store directory — puts ride the control conn, large results arrive
+    via the transfer plane."""
+    import json
+
+    import numpy as np
+
+    head_proc, head_json, _dir = head
+    with open(head_json) as f:
+        info = json.load(f)
+
+    ray_tpu.init(
+        address=f"ray://{info['host']}:{info['port']}", _authkey=info["authkey"]
+    )
+    try:
+        from ray_tpu._private.driver_client import _attached
+
+        assert _attached is not None
+        # Remote mode: private store dir, inline puts forced.
+        assert _attached.force_inline_puts
+        assert _attached.owns_store_dir
+        head_store = info.get("store_dir")
+        if head_store:
+            assert _attached.shm.dir != head_store
+
+        # Tasks + actors + big objects all work across the "network".
+        @ray_tpu.remote
+        def double(x):
+            return x * 2
+
+        big = np.arange(1_000_000, dtype=np.float64)  # 8MB >> inline cutoff
+        ref = ray_tpu.put(big)
+        out = ray_tpu.get(double.remote(ref), timeout=120)
+        np.testing.assert_array_equal(out, big * 2)
+
+        @ray_tpu.remote
+        class Acc:
+            def __init__(self):
+                self.vals = []
+
+            def add(self, v):
+                self.vals.append(float(np.sum(v)))
+                return len(self.vals)
+
+        a = Acc.options(name="client_acc").remote()
+        assert ray_tpu.get(a.add.remote(big), timeout=120) == 1
+        assert ray_tpu.get(ray_tpu.get_actor("client_acc").add.remote(1.0), timeout=60) == 2
+        ready, _ = ray_tpu.wait([double.remote(2)], num_returns=1, timeout=60)
+        assert ray_tpu.get(ready[0], timeout=30) == 4
+    finally:
+        ray_tpu.shutdown()
