@@ -102,6 +102,17 @@ _DEFS: Dict[str, tuple] = {
         "per-worker ring buffer of recent log lines kept for the logs "
         "CLI / dashboard endpoint",
     ),
+    "health_check_period_ms": (
+        1000, int,
+        "how often node daemons send liveness heartbeats to the head "
+        "(ray: health_check_period_ms, gcs_health_check_manager.h:39)",
+    ),
+    "health_check_timeout_ms": (
+        10000, int,
+        "no heartbeat for this long => the node is declared dead even "
+        "with its TCP conn still open (hung daemon / half-open conn); "
+        "0 disables timeout-based death (EOF only)",
+    ),
     "memory_monitor_refresh_ms": (
         250, int,
         "how often each node daemon checks memory pressure; 0 disables "

@@ -256,10 +256,28 @@ def main() -> None:
                 except OSError:
                     pass
 
+    # Liveness heartbeats (ray: gcs_health_check_manager.h:28-37 — the
+    # reference PULLS health checks; a push on the existing conn gives the
+    # head the same signal without another listener): a hung daemon or a
+    # half-open TCP conn stops heartbeating and the head declares the node
+    # dead on timeout instead of trusting EOF alone.
+    import time as _time
+
+    hb_period = _config.get("health_check_period_ms") / 1000.0
+    last_hb = 0.0
+
     while True:
         if stop_flag["stop"]:
             shutdown()
             return
+        now = _time.monotonic()
+        if hb_period > 0 and now - last_hb >= hb_period:
+            last_hb = now
+            try:
+                with send_lock:
+                    conn.send(("heartbeat", node_id))
+            except OSError:
+                pass  # EOF path below handles reconnection
         try:
             has_msg = conn.poll(0.5)
         except (EOFError, OSError):

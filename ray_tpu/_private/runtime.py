@@ -412,6 +412,9 @@ class Runtime:
         # for its daemon's authoritative worker_exited (which says WHY —
         # the two arrive on different sockets and can reorder).
         self._deferred_crashes: Dict[str, float] = {}
+        # nid -> last heartbeat time: timeout-based node death detection
+        # on top of conn EOF (ray: gcs_health_check_manager.h:39).
+        self._daemon_heartbeats: Dict[str, float] = {}
         # Attached driver clients (head-split mode, head.py): did -> conn,
         # plus the pseudo-node each non-co-located driver reads objects as,
         # and per-driver ref borrows dropped on driver death
@@ -1135,6 +1138,8 @@ class Runtime:
     def _io_loop(self):
         from multiprocessing.connection import wait as conn_wait
 
+        from ray_tpu._private import config as _cfg
+
         last_reap = 0.0
         while not self._shutdown:
             # Reap workers that died before ever connecting (spawn failure,
@@ -1159,6 +1164,27 @@ class Runtime:
                             h = self.workers.get(wid)
                             if h is not None and h.state != "dead":
                                 self._on_worker_crash(wid)
+                    # Heartbeat timeouts: a hung (not dead) daemon or a
+                    # half-open conn keeps the socket alive but stops
+                    # heartbeating — declare the node dead so its leased
+                    # tasks retry elsewhere instead of wedging.
+                    hb_timeout = _cfg.get("health_check_timeout_ms") / 1000.0
+                    if hb_timeout > 0:
+                        for dconn, nid in list(self._conn_to_daemon.items()):
+                            last = self._daemon_heartbeats.get(nid)
+                            if last is None:
+                                # Pre-heartbeat daemons (or ones from an
+                                # older protocol) start their clock at
+                                # first sight, not at epoch.
+                                self._daemon_heartbeats[nid] = now
+                            elif now - last > hb_timeout:
+                                self._conn_to_daemon.pop(dconn, None)
+                                self._daemon_heartbeats.pop(nid, None)
+                                try:
+                                    dconn.close()
+                                except OSError:
+                                    pass
+                                self._on_daemon_death(nid)
             with self.lock:
                 conns = (
                     list(self._conn_to_worker.keys())
@@ -1190,6 +1216,9 @@ class Runtime:
                         # A remote node's monitor forwarded fresh worker
                         # output: same sink as head-local files.
                         self._on_log_lines(dmsg[1], dmsg[2], dmsg[3])
+                        continue
+                    if isinstance(dmsg, tuple) and dmsg and dmsg[0] == "heartbeat":
+                        self._daemon_heartbeats[nid] = time.monotonic()
                         continue
                     if isinstance(dmsg, tuple) and dmsg and dmsg[0] == "worker_oom_killed":
                         with self.lock:

@@ -84,6 +84,19 @@ class Dashboard:
             "/api/logs": _logs_endpoint,
         }
 
+        def _prometheus() -> str:
+            # Prometheus text exposition (ray: metrics_agent.py:375 →
+            # prometheus_exporter): user metrics from the registry +
+            # runtime gauges, served as text/plain for direct scraping.
+            from ray_tpu.util.metrics import prometheus_text
+
+            return prometheus_text(extra_gauges=state_api.cluster_metrics())
+
+        # Non-JSON routes share the same dispatch: (handler, content_type);
+        # a None content_type means JSON-serialize the handler's result.
+        content_types = {"/metrics": "text/plain; version=0.0.4"}
+        routes["/metrics"] = _prometheus
+
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):
                 pass
@@ -93,6 +106,7 @@ class Dashboard:
 
                 parsed = urlparse(self.path)
                 fn = routes.get(parsed.path)
+                ctype = content_types.get(parsed.path)
                 if fn is None:
                     body = json.dumps(
                         {"error": "unknown route", "routes": sorted(routes)}
@@ -108,13 +122,17 @@ class Dashboard:
                             out = fn(query=parse_qs(parsed.query))
                         else:
                             out = fn()
-                        body = json.dumps(out, default=str).encode()
+                        body = (
+                            out.encode() if ctype
+                            else json.dumps(out, default=str).encode()
+                        )
                         code = 200
                     except Exception as e:  # noqa: BLE001 — HTTP boundary
+                        ctype = None  # errors are always the JSON shape
                         body = json.dumps({"error": repr(e)}).encode()
                         code = 500
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype or "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)

@@ -96,6 +96,31 @@ def cmd_logs(args) -> int:
     return 0
 
 
+def _live_head_pid(session_dir: str):
+    """pid from head.pid if it plausibly IS a live head.  Returns
+    (pid, known): known=False when liveness can't be verified (no /proc,
+    e.g. macOS) — callers must then treat the pid as possibly-live rather
+    than stale."""
+    try:
+        with open(os.path.join(session_dir, "head.pid")) as f:
+            pid = int(f.read().strip())
+    except (OSError, ValueError):
+        return None, True
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return None, True
+    except PermissionError:
+        pass  # alive, owned by someone else
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            is_head = b"ray_tpu._private.head" in f.read()
+        return (pid if is_head else None), True
+    except OSError:
+        # /proc unavailable: the pid is alive but unverifiable.
+        return pid, False
+
+
 def cmd_start(args) -> int:
     """`ray_tpu start --head`: boot a standalone head process (ray: `ray
     start --head`).  Prints the head.json path + the ray:// address a
@@ -114,6 +139,16 @@ def cmd_start(args) -> int:
         "/tmp", f"raytpu-session-{os.getpid()}"
     )
     os.makedirs(session_dir, exist_ok=True)
+    pid, _known = _live_head_pid(session_dir)
+    if pid is not None:
+        # Matching `ray start`'s already-running refusal: a second head
+        # would overwrite head.pid/head.json and orphan the first.
+        print(
+            f"a head (pid {pid}) is already running for {session_dir}; "
+            "run `ray_tpu stop` first or pick another --session-dir",
+            file=sys.stderr,
+        )
+        return 1
     proc, head_json = launch_head_subprocess(
         session_dir, num_cpus=args.num_cpus, session=args.session, detach=True
     )
@@ -137,26 +172,23 @@ def cmd_stop(args) -> int:
     import signal as _signal
 
     pid_file = os.path.join(args.session_dir, "head.pid")
-    try:
-        with open(pid_file) as f:
-            pid = int(f.read().strip())
-    except (OSError, ValueError):
+    if not os.path.exists(pid_file):
         print(f"no head.pid under {args.session_dir}", file=sys.stderr)
         return 1
     # Stale-pid guard: after a crash/reboot the OS may have reused the pid
-    # for an unrelated process — only SIGTERM something that IS a head.
-    try:
-        with open(f"/proc/{pid}/cmdline", "rb") as f:
-            cmdline = f.read().decode(errors="replace")
-    except OSError:
-        cmdline = ""
-    if "ray_tpu._private.head" not in cmdline:
+    # for an unrelated process — only SIGTERM on a POSITIVE head match;
+    # when liveness can't be verified (no /proc) err toward killing the
+    # recorded pid rather than stranding a live head.
+    pid, known = _live_head_pid(args.session_dir)
+    if pid is None:
         try:
             os.unlink(pid_file)
         except OSError:
             pass
-        print(f"pid {pid} is not a ray_tpu head (stale head.pid removed)")
+        print("head already gone (stale head.pid removed)")
         return 0
+    if not known:
+        print(f"cannot verify pid {pid} is a head (no /proc); stopping it anyway")
     try:
         os.kill(pid, _signal.SIGTERM)
     except ProcessLookupError:

@@ -127,6 +127,75 @@ class Histogram(Metric):
             }
 
 
+def _prom_name(name: str) -> str:
+    out = "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+    return out if not out[:1].isdigit() else "_" + out
+
+
+def _prom_escape(v: str) -> str:
+    """Label-value escaping per the exposition format: one bad series
+    would otherwise make Prometheus reject the whole scrape body."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _prom_help(text: str) -> str:
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _prom_labels(tag_key: Tuple, extra: str = "") -> str:
+    parts = [f'{k}="{_prom_escape(v)}"' for k, v in tag_key]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def prometheus_text(extra_gauges: Optional[Dict[str, float]] = None) -> str:
+    """Render every registered metric in the Prometheus text exposition
+    format (ray: _private/metrics_agent.py:375 re-exports OpenCensus views
+    through prometheus_exporter; here the registry renders itself — no
+    agent process needed on a single-controller runtime).
+
+    extra_gauges: runtime-level numbers (task counts, store bytes, ...)
+    exported alongside the user metrics as plain gauges.
+    """
+    with _REGISTRY_LOCK:
+        metrics = dict(_REGISTRY)
+    lines: List[str] = []
+    for name, m in sorted(metrics.items()):
+        pname = _prom_name(name)
+        if isinstance(m, Counter):
+            lines.append(f"# HELP {pname}_total {_prom_help(m.description)}")
+            lines.append(f"# TYPE {pname}_total counter")
+            for k, v in sorted(m.snapshot().items()):
+                lines.append(f"{pname}_total{_prom_labels(k)} {v}")
+        elif isinstance(m, Gauge):
+            lines.append(f"# HELP {pname} {_prom_help(m.description)}")
+            lines.append(f"# TYPE {pname} gauge")
+            for k, v in sorted(m.snapshot().items()):
+                lines.append(f"{pname}{_prom_labels(k)} {v}")
+        elif isinstance(m, Histogram):
+            lines.append(f"# HELP {pname} {_prom_help(m.description)}")
+            lines.append(f"# TYPE {pname} histogram")
+            for k, d in sorted(m.snapshot().items()):
+                cum = 0
+                for bound, n in zip(m.boundaries, d["buckets"]):
+                    cum += n
+                    lines.append(
+                        f"{pname}_bucket{_prom_labels(k, f'le=\"{bound}\"')} {cum}"
+                    )
+                cum += d["buckets"][-1]
+                lines.append(
+                    f"{pname}_bucket{_prom_labels(k, 'le=\"+Inf\"')} {cum}"
+                )
+                lines.append(f"{pname}_sum{_prom_labels(k)} {d['sum']}")
+                lines.append(f"{pname}_count{_prom_labels(k)} {d['count']}")
+    for name, value in sorted((extra_gauges or {}).items()):
+        pname = _prom_name(f"ray_tpu_{name}")
+        lines.append(f"# TYPE {pname} gauge")
+        lines.append(f"{pname} {value}")
+    return "\n".join(lines) + "\n"
+
+
 def collect() -> Dict[str, Dict]:
     """Snapshot every registered metric in this process."""
     with _REGISTRY_LOCK:

@@ -227,3 +227,79 @@ def test_task_parentage_tracing(rt):
     assert parents[0].get("parent_task_id") is None  # driver submit
     for c in children:
         assert c["parent_task_id"] == parents[0]["task_id"]
+
+
+def test_prometheus_endpoint(rt):
+    """/metrics serves the Prometheus text exposition format with user
+    metrics + runtime gauges (ray: metrics_agent.py:375 export path)."""
+    import urllib.request
+
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+    from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+    c = Counter("prom_requests", "reqs", tag_keys=("route",))
+    c.inc(3, tags={"route": "/a"})
+    g = Gauge("prom_inflight", "inflight")
+    g.set(7)
+    h = Histogram("prom_latency", "lat", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get(f.remote(), timeout=30)
+
+    dash = start_dashboard()
+    try:
+        body = urllib.request.urlopen(f"{dash.url}/metrics", timeout=10).read().decode()
+    finally:
+        stop_dashboard()
+    assert '# TYPE prom_requests_total counter' in body
+    assert 'prom_requests_total{route="/a"} 3.0' in body
+    assert "prom_inflight 7.0" in body
+    assert 'prom_latency_bucket{le="0.1"} 1' in body
+    assert 'prom_latency_bucket{le="+Inf"} 3' in body
+    assert "prom_latency_count 3" in body
+    # Runtime gauges ride along.
+    assert "ray_tpu_tasks_finished" in body
+    assert "ray_tpu_object_store_capacity_bytes" in body
+
+
+def test_hung_daemon_declared_dead_by_heartbeat_timeout():
+    """A daemon that stops heartbeating (SIGSTOP: conn open, process
+    frozen) must be declared dead within the timeout so its tasks retry
+    elsewhere (ray: gcs_health_check_manager.h:28-37 — EOF alone cannot
+    catch a hung node)."""
+    import os
+    import signal
+    import time
+
+    import ray_tpu
+    from ray_tpu._private.runtime import get_runtime
+
+    os.environ["RAY_TPU_HEALTH_CHECK_TIMEOUT_MS"] = "3000"
+    try:
+        ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+        rt = get_runtime()
+        nid = rt.add_daemon_node(num_cpus=2)
+        assert nid in rt.node_daemons
+        daemon_pid = rt._daemon_procs[nid].pid
+        os.kill(daemon_pid, signal.SIGSTOP)  # hung, not dead: no EOF
+        try:
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and nid in rt.node_daemons:
+                time.sleep(0.2)
+            assert nid not in rt.node_daemons, (
+                "hung daemon still counted alive after heartbeat timeout"
+            )
+        finally:
+            os.kill(daemon_pid, signal.SIGCONT)
+    finally:
+        os.environ.pop("RAY_TPU_HEALTH_CHECK_TIMEOUT_MS", None)
+        from ray_tpu._private import config as _c
+
+        ray_tpu.shutdown()
+        _c._reset_for_tests()
