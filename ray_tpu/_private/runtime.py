@@ -750,6 +750,7 @@ class Runtime:
         pool dies with it (the daemon terminates its children on exit)."""
         self.node_daemons.pop(node_id, None)
         self.node_object_endpoints.pop(node_id, None)
+        self._daemon_heartbeats.pop(node_id, None)
         # Copies on the dead node are gone; objects whose ONLY copy lived
         # there become lost-bytes (gets fall through to lineage
         # reconstruction, exactly like a lost spill file).
@@ -1061,6 +1062,10 @@ class Runtime:
                     self.node_object_endpoints[node_id] = tuple(ep)
                 self.node_daemons[node_id] = conn
                 self._conn_to_daemon[conn] = node_id
+                # Fresh liveness clock: a stale entry from a previous
+                # incarnation of this node_id would instantly time the
+                # reconnected daemon out before its first heartbeat.
+                self._daemon_heartbeats[node_id] = time.monotonic()
                 self._dispatch()
             return
         if first[0] != "ready":

@@ -13,12 +13,14 @@ from ray_tpu.tune.schedulers import (
     AsyncHyperBandScheduler,
     FIFOScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
     TrialScheduler,
 )
 from ray_tpu.tune.search import (
     BasicVariantGenerator,
     Searcher,
+    TPESearcher,
     choice,
     grid_search,
     loguniform,
@@ -43,9 +45,11 @@ __all__ = [
     "BasicVariantGenerator",
     "FIFOScheduler",
     "MedianStoppingRule",
+    "PB2",
     "PopulationBasedTraining",
     "ResultGrid",
     "Searcher",
+    "TPESearcher",
     "Trial",
     "TrialScheduler",
     "TuneConfig",

@@ -186,13 +186,19 @@ class PopulationBasedTraining(TrialScheduler):
         # exploit + explore: mutate the donor's config in place on the trial
         new_cfg = dict(trial.config)
         new_cfg.update(donor_cfg)
-        for key, spec in self.mutations.items():
-            new_cfg[key] = self._mutate(new_cfg.get(key), spec)
+        new_cfg = self._explore(new_cfg)
         trial.config = new_cfg
         if donor_ckpt is not None:
             trial.checkpoint = donor_ckpt
         self.num_perturbations += 1
         return RESTART
+
+    def _explore(self, cfg: Dict) -> Dict:
+        """Perturb an exploited config (hook: PB2 replaces this with a
+        GP-bandit choice for its bounded params)."""
+        for key, spec in self.mutations.items():
+            cfg[key] = self._mutate(cfg.get(key), spec)
+        return cfg
 
     def _mutate(self, current, spec):
         from ray_tpu.tune.search import Domain
@@ -224,3 +230,107 @@ class PopulationBasedTraining(TrialScheduler):
         self.rng = random.Random()
         if rng_state is not None:
             self.rng.setstate(rng_state)
+
+
+class PB2(PopulationBasedTraining):
+    """PBT with GP-bandit exploration (ray: tune/schedulers/pb2.py).
+
+    Exploit is inherited from PBT (bottom-quantile trials copy a top
+    donor's checkpoint); EXPLORE replaces random mutation for bounded
+    continuous hyperparams with a Gaussian-process UCB choice fit to
+    (hyperparams -> score improvement) history — sample-efficient tuning
+    when perturbation budgets are small.  Unbounded/categorical params
+    still mutate the PBT way.
+    """
+
+    def __init__(
+        self,
+        time_attr: str = "training_iteration",
+        perturbation_interval: int = 5,
+        hyperparam_bounds: Optional[Dict[str, tuple]] = None,
+        quantile_fraction: float = 0.25,
+        ucb_kappa: float = 1.5,
+        n_candidates: int = 256,
+        seed: Optional[int] = None,
+        **kw,
+    ):
+        super().__init__(
+            time_attr=time_attr,
+            perturbation_interval=perturbation_interval,
+            quantile_fraction=quantile_fraction,
+            seed=seed,
+            **kw,
+        )
+        self.bounds = dict(hyperparam_bounds or {})
+        self.kappa = ucb_kappa
+        self.n_candidates = n_candidates
+        # (hyperparam vector, score delta over the interval) observations
+        self._gp_data: list = []
+        self._prev_score: Dict[str, float] = {}
+
+    def on_trial_result(self, trial: Trial, result: Dict) -> str:
+        score = self._score(result)
+        t = result.get(self.time_attr)
+        if score is not None and t is not None and self.bounds:
+            prev = self._prev_score.get(trial.trial_id)
+            if prev is not None:
+                x = [float(trial.config.get(k, 0.0)) for k in sorted(self.bounds)]
+                self._gp_data.append((x, score - prev))
+                self._gp_data = self._gp_data[-256:]
+            self._prev_score[trial.trial_id] = score
+        decision = super().on_trial_result(trial, result)
+        if decision == RESTART:
+            # The exploit copies a donor checkpoint: the next report's
+            # score jump is the COPY, not the new hyperparams' doing —
+            # recording that delta would teach the GP a fiction.
+            self._prev_score.pop(trial.trial_id, None)
+        return decision
+
+    def _explore(self, cfg: Dict) -> Dict:
+        cfg = super()._explore(cfg)  # PBT mutation for non-bounded keys
+        return self._explore_config(cfg)
+
+    def _gp_choose(self) -> Optional[Dict[str, float]]:
+        if len(self._gp_data) < 4:
+            return None
+        try:
+            import numpy as np
+            from sklearn.gaussian_process import GaussianProcessRegressor
+            from sklearn.gaussian_process.kernels import Matern
+        except Exception:
+            return None
+        keys = sorted(self.bounds)
+        X = np.array([x for x, _ in self._gp_data])
+        y = np.array([d for _, d in self._gp_data])
+        y = (y - y.mean()) / (y.std() + 1e-9)
+        lo = np.array([self.bounds[k][0] for k in keys], dtype=float)
+        hi = np.array([self.bounds[k][1] for k in keys], dtype=float)
+        span = hi - lo
+        gp = GaussianProcessRegressor(
+            kernel=Matern(nu=2.5, length_scale=span / 4.0),
+            alpha=1e-3,
+            normalize_y=False,
+        )
+        try:
+            gp.fit((X - lo) / span, y)
+        except Exception:
+            return None
+        rngs = np.random.default_rng(self.rng.randrange(1 << 31))
+        cand = rngs.uniform(size=(self.n_candidates, len(keys)))
+        mu, sigma = gp.predict(cand, return_std=True)
+        best = cand[int(np.argmax(mu + self.kappa * sigma))]
+        chosen = lo + best * span
+        return dict(zip(keys, chosen.tolist()))
+
+    def _explore_config(self, cfg: Dict) -> Dict:
+        gp_pick = self._gp_choose()
+        for key in self.bounds:
+            if gp_pick is not None:
+                cfg[key] = gp_pick[key]
+            else:
+                lo, hi = self.bounds[key]
+                cfg[key] = self.rng.uniform(lo, hi)
+        return cfg
+
+    # save/restore: PBT serializes __dict__ wholesale, which already
+    # covers _gp_data/_prev_score/bounds — no override needed.

@@ -99,6 +99,7 @@ class TrialRunner:
         # and a trial-id key would leak onto the new run and swallow its
         # real failures (hanging the whole experiment).
         self._killed_refs: List[Any] = []
+        self._searcher_done = False
         self.searcher.set_search_properties(metric, mode)
         self.scheduler.set_search_properties(metric, mode)
         os.makedirs(experiment_dir, exist_ok=True)
@@ -110,17 +111,36 @@ class TrialRunner:
             self._start_pending()
             time.sleep(self.poll_interval)
             self._process_running()
+            # Refill AFTER completions so model-based searchers (TPE) see
+            # the finished trials' scores before suggesting the next batch
+            # — draining suggest() upfront would degrade them to their
+            # random warmup for the whole experiment
+            # (ray: SearchGenerator queries the searcher incrementally).
+            self._fill_from_searcher()
         self.checkpoint_experiment()
         return self.trials
 
     def _all_finished(self) -> bool:
-        return all(t.is_finished for t in self.trials) and not self._run_refs
+        return (
+            all(t.is_finished for t in self.trials)
+            and not self._run_refs
+            and self._searcher_done
+        )
 
     def _fill_from_searcher(self):
-        while True:
+        """Top the live/pending pool up to max_concurrent from the
+        searcher; the rest of the budget stays with the searcher until
+        capacity frees."""
+        if self._searcher_done:
+            return
+        while (
+            sum(1 for t in self.trials if not t.is_finished)
+            < self.max_concurrent
+        ):
             t = Trial(config={})
             cfg = self.searcher.suggest(t.trial_id)
             if cfg is None:
+                self._searcher_done = True
                 break
             t.config = cfg
             self.trials.append(t)
