@@ -24,6 +24,13 @@ from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.policy import JaxPolicy, apply_policy, init_policy_params
 from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, LearnerGroup, vtrace
+from ray_tpu.rllib.multi_agent import (
+    MultiAgentCartPole,
+    MultiAgentEnvRunner,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+    MultiAgentVectorEnv,
+)
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae
 
@@ -36,6 +43,11 @@ __all__ = [
     "IMPALAConfig",
     "JaxPolicy",
     "LearnerGroup",
+    "MultiAgentCartPole",
+    "MultiAgentEnvRunner",
+    "MultiAgentPPO",
+    "MultiAgentPPOConfig",
+    "MultiAgentVectorEnv",
     "PPO",
     "PPOConfig",
     "SampleBatch",

@@ -307,7 +307,10 @@ class PB2(PopulationBasedTraining):
         hi = np.array([self.bounds[k][1] for k in keys], dtype=float)
         span = hi - lo
         gp = GaussianProcessRegressor(
-            kernel=Matern(nu=2.5, length_scale=span / 4.0),
+            # The GP sees [0,1]-normalized inputs, so the length scale is
+            # in NORMALIZED units — span-scaled values would flatten (or
+            # shatter) the kernel and degrade UCB to a random pick.
+            kernel=Matern(nu=2.5, length_scale=0.25),
             alpha=1e-3,
             normalize_y=False,
         )
