@@ -66,6 +66,7 @@ class WorkerRuntime:
         self.current_actor = None  # instance, when this worker hosts an actor
         self.current_actor_id: Optional[str] = None
         self.async_loop = None
+        self._async_loop_lock = threading.Lock()
 
     # -- request/reply to driver --------------------------------------------
 
@@ -311,10 +312,19 @@ def _run_on_actor_loop(rt: WorkerRuntime, coro):
     import asyncio
 
     if rt.async_loop is None:
-        loop = asyncio.new_event_loop()
-        t = threading.Thread(target=loop.run_forever, daemon=True, name="actor-asyncio")
-        t.start()
-        rt.async_loop = loop
+        # Locked double-check: concurrent FIRST async calls (threaded
+        # max_concurrency pool) racing this create would split the actor's
+        # coroutines across two loops — asyncio primitives (Event, Lock)
+        # created on one loop then awaited on the other raise
+        # "bound to a different event loop".
+        with rt._async_loop_lock:
+            if rt.async_loop is None:
+                loop = asyncio.new_event_loop()
+                t = threading.Thread(
+                    target=loop.run_forever, daemon=True, name="actor-asyncio"
+                )
+                t.start()
+                rt.async_loop = loop
     task_id = current_task_id()
 
     async def _with_context():

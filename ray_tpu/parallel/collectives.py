@@ -38,6 +38,11 @@ class _GroupCoordinator:
     (python/ray/util/collective/collective.py:40 GroupManager) — but since XLA
     needs no communicator handshake, it doubles as the data plane for host
     arrays (fine for control-sized payloads; tensor traffic is ICI-compiled).
+
+    All waits are ASYNC parks on the actor's event loop (one RPC per rank
+    per collective, zero polling): the last contributor sets the round's
+    asyncio.Event and every parked rank resumes — the blocking analogue of
+    the reference's gloo rendezvous, built on the runtime's async actors.
     """
 
     def __init__(self, world_size: int):
@@ -45,37 +50,72 @@ class _GroupCoordinator:
         self._rounds: Dict[str, Dict[int, Any]] = {}
         self._done: Dict[str, Any] = {}
         self._collected: Dict[str, set] = {}
-        self._seq = 0
+        self._events: Dict[str, Any] = {}
 
-    def contribute(self, key: str, rank: int, value):
+    def _event(self, key: str):
+        import asyncio
+
+        ev = self._events.get(key)
+        if ev is None:
+            ev = self._events[key] = asyncio.Event()
+        return ev
+
+    async def exchange(self, key: str, rank: int, value, timeout: float):
+        """Contribute this rank's value and WAIT (parked, not polling)
+        until every rank has; returns the full {rank: value} round."""
+        import asyncio
+
         round_ = self._rounds.setdefault(key, {})
         round_[rank] = value
+        ev = self._event(key)
         if len(round_) == self.world_size:
             self._done[key] = dict(round_)
             del self._rounds[key]
-        return True
-
-    def collect(self, key: str, rank: int) -> Optional[Dict[int, Any]]:
+            ev.set()
+        else:
+            try:
+                await asyncio.wait_for(ev.wait(), timeout)
+            except asyncio.TimeoutError:
+                if key not in self._done:
+                    # True timeout: withdraw this rank's contribution so a
+                    # retried round sees no ghost participant, and free the
+                    # round's state once the last waiter leaves — timed-out
+                    # keys are never reused (seq-suffixed) and would leak.
+                    round_ = self._rounds.get(key)
+                    if round_ is not None:
+                        round_.pop(rank, None)
+                        if not round_:
+                            del self._rounds[key]
+                            self._events.pop(key, None)
+                    return None
+                # Lost the race: the round completed as the timer fired —
+                # collect normally (skipping would strand _done forever).
         out = self._done.get(key)
-        if out is None:
-            return None
         # Free the round once every rank has fetched it, so a long-running
         # loop of collectives doesn't grow the coordinator without bound.
         seen = self._collected.setdefault(key, set())
         seen.add(rank)
         if len(seen) == self.world_size:
-            del self._done[key]
-            del self._collected[key]
+            self._done.pop(key, None)
+            self._collected.pop(key, None)
+            self._events.pop(key, None)
         return out
 
-    def reset(self, key: str):
-        self._done.pop(key, None)
-        self._collected.pop(key, None)
-
-    def p2p_put(self, key: str, value):
+    async def p2p_put(self, key: str, value):
         self._done[key] = value
+        self._event(key).set()
 
-    def p2p_take(self, key: str):
+    async def p2p_take(self, key: str, timeout: float):
+        import asyncio
+
+        ev = self._event(key)
+        if key not in self._done:
+            try:
+                await asyncio.wait_for(ev.wait(), timeout)
+            except asyncio.TimeoutError:
+                self._events.pop(key, None)
+                return None
+        self._events.pop(key, None)
         return self._done.pop(key, None)
 
 
@@ -83,9 +123,10 @@ class CollectiveGroup:
     """One rank's view of a host collective group.
 
     timeout_s bounds every collective: if a peer rank dies before
-    contributing, the others raise instead of spinning forever (the
-    reference's collective ops error out on dead peers).  Polls back off
-    exponentially to 50ms so a long wait doesn't hot-load the coordinator.
+    contributing, the others raise instead of waiting forever (the
+    reference's collective ops error out on dead peers).  Waits park on
+    the coordinator's event loop — one RPC per rank per collective, no
+    client-side polling.
     """
 
     def __init__(self, name: str, world_size: int, rank: int, timeout_s: float = 120.0):
@@ -97,33 +138,24 @@ class CollectiveGroup:
         self._p2p_seq: Dict[tuple, int] = {}  # (src, dst) -> next seq
         self._coord = _get_or_create_coordinator(name, world_size)
 
-    def _poll(self, fetch, what: str):
-        import time
-
-        deadline = time.monotonic() + self.timeout_s
-        interval = 0.001
-        while True:
-            out = fetch()
-            if out is not None:
-                return out
-            if time.monotonic() > deadline:
-                raise RuntimeError(
-                    f"collective {what} timed out after {self.timeout_s}s in group "
-                    f"{self.name!r} (rank {self.rank}/{self.world_size}) — a peer "
-                    "rank likely died before contributing"
-                )
-            time.sleep(interval)
-            interval = min(interval * 2, 0.05)
+    def _timeout_error(self, what: str) -> RuntimeError:
+        return RuntimeError(
+            f"collective {what} timed out after {self.timeout_s}s in group "
+            f"{self.name!r} (rank {self.rank}/{self.world_size}) — a peer "
+            "rank likely died before contributing"
+        )
 
     # -- collectives ------------------------------------------------------
     def _exchange(self, tag: str, value) -> Dict[int, Any]:
         self._seq += 1
         key = f"{tag}:{self._seq}"
-        ray_tpu.get(self._coord.contribute.remote(key, self.rank, value))
-        return self._poll(
-            lambda: ray_tpu.get(self._coord.collect.remote(key, self.rank)),
-            what=key,
+        out = ray_tpu.get(
+            self._coord.exchange.remote(key, self.rank, value, self.timeout_s),
+            timeout=self.timeout_s + 30,
         )
+        if out is None:
+            raise self._timeout_error(key)
+        return out
 
     def allreduce(self, arr, op: str = "sum"):
         parts = self._exchange("ar", np.asarray(arr))
@@ -157,10 +189,13 @@ class CollectiveGroup:
 
     def recv(self, src_rank: int):
         key = self._p2p_key(src_rank, self.rank)
-        return self._poll(
-            lambda: ray_tpu.get(self._coord.p2p_take.remote(key)),
-            what=key,
+        out = ray_tpu.get(
+            self._coord.p2p_take.remote(key, self.timeout_s),
+            timeout=self.timeout_s + 30,
         )
+        if out is None:
+            raise self._timeout_error(key)
+        return out
 
 
 _registry: Dict[str, "CollectiveGroup"] = {}

@@ -127,6 +127,10 @@ class MultiAgentCartPole(MultiAgentVectorEnv):
         )
         terminated = all_done
         truncated = (self._steps >= self.max_steps) & ~terminated
+        # Per-agent liveness AT this step (pre-reset), for value
+        # bootstrapping: a dead-but-frozen agent's final_obs belongs to a
+        # ghost sub-episode and must not be bootstrapped.
+        self.last_alive = {aid: self._alive[aid].copy() for aid in self.agent_ids}
         done_idx = np.nonzero(terminated | truncated)[0]
         if len(done_idx):
             for aid in self.agent_ids:
@@ -217,8 +221,15 @@ class MultiAgentEnvRunner:
                 # its final observation (same GAE reasoning as the
                 # single-agent runner, env_runner.py): without it, good
                 # policies that reach the cap learn V(late state) ~ 0.
-                idx = np.nonzero(truncated)[0]
+                # Only for agents still ALIVE at the cutoff — a dead
+                # agent's final_obs is a ghost sub-episode state and a
+                # bootstrap there injects phantom return.
+                alive = getattr(self.env, "last_alive", None)
                 for aid in agents:
+                    mask = truncated if alive is None else (truncated & alive[aid])
+                    idx = np.nonzero(mask)[0]
+                    if not len(idx):
+                        continue
                     pol = self.policies[self.policy_mapping[aid]]
                     _, _, v_fin = pol.compute_actions(final_obs[aid])
                     rew = rewards[aid].copy()

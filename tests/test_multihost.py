@@ -157,3 +157,73 @@ def test_two_host_spmd_train(ray_start_cluster):
         assert os.getpid() not in ppids
     finally:
         ex.shutdown()
+
+
+# -- host collective groups (SURVEY §2.2 collective library) ----------------
+
+
+@ray_tpu.remote
+class _CollectiveRank:
+    """One rank living in its own worker process."""
+
+    def __init__(self, world_size, rank, timeout_s=30.0):
+        from ray_tpu.parallel.collectives import init_collective_group
+
+        self.group = init_collective_group(
+            world_size, rank, group_name="hosttest"
+        )
+        self.group.timeout_s = timeout_s
+        self.rank = rank
+
+    def run_all(self):
+        import numpy as np
+
+        g = self.group
+        out = {}
+        out["allreduce"] = g.allreduce(np.full(4, self.rank + 1.0)).tolist()
+        out["allgather"] = [a.tolist() for a in g.allgather(np.array([self.rank]))]
+        out["broadcast"] = g.broadcast(
+            np.array([42.0]) if self.rank == 0 else None, src_rank=0
+        ).tolist()
+        out["reducescatter"] = g.reducescatter(
+            np.arange(4, dtype=np.float64)
+        ).tolist()
+        g.barrier()
+        if self.rank == 0:
+            g.send(np.array([7.0]), dst_rank=1)
+        elif self.rank == 1:
+            out["recv"] = g.recv(src_rank=0).tolist()
+        return out
+
+    def lonely_allreduce(self):
+        import numpy as np
+
+        return self.group.allreduce(np.ones(1)).tolist()
+
+
+def test_host_collective_group_full_surface(ray_start_regular):
+    """allreduce/allgather/broadcast/reducescatter/barrier/send-recv across
+    3 real worker processes, blocking (no poll) on the coordinator."""
+    world = 3
+    ranks = [_CollectiveRank.remote(world, r) for r in range(world)]
+    outs = ray_tpu.get([r.run_all.remote() for r in ranks], timeout=60)
+    for out in outs:
+        assert out["allreduce"] == [6.0] * 4  # (1+2+3)
+        assert out["allgather"] == [[0], [1], [2]]
+        assert out["broadcast"] == [42.0]
+    # reducescatter: sum = [0,3,6,9] split 3 ways (sizes 2/1/1)
+    assert outs[0]["reducescatter"] == [0.0, 3.0]
+    assert outs[1]["reducescatter"] == [6.0]
+    assert outs[2]["reducescatter"] == [9.0]
+    assert outs[1]["recv"] == [7.0]
+    for r in ranks:
+        ray_tpu.kill(r)
+
+
+def test_host_collective_times_out_on_missing_peer(ray_start_regular):
+    """A collective whose peer never contributes must raise, not hang
+    (the dead-peer contract; parked server-side with a timeout)."""
+    lonely = _CollectiveRank.remote(2, 0, 2.0)  # world 2, peer never joins
+    with pytest.raises(Exception, match="timed out"):
+        ray_tpu.get(lonely.lonely_allreduce.remote(), timeout=40)
+    ray_tpu.kill(lonely)
