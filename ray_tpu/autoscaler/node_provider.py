@@ -95,13 +95,15 @@ class LocalNodeProvider(NodeProvider):
 
 
 class TPUPodNodeProvider(NodeProvider):
-    """GCP TPU-VM provider sketch: node types are TPU slice shapes
-    (e.g. v5p-8 hosts), created via the TPU API / gcloud.
+    """GCP TPU-VM provider: node types are TPU slice shapes (e.g. v5p-8
+    hosts) created via gcloud; each VM's startup script boots a node
+    daemon pointed at the head, registering a PRE-ASSIGNED node id.
 
-    SURVEY §7.5 commits to a TPU-pod provider; this class carries the
-    shape of that integration (the commands the reference's GCP provider
-    pattern would run) — execution requires cloud credentials + egress, so
-    environments without them get a clear error instead of a silent no-op.
+    The full lifecycle (create -> daemon joins -> TPU-shaped task
+    schedules -> terminate) is exercised against a fake `gcloud`
+    executable in tests/test_autoscaler_jobs.py — the real binary needs
+    cloud credentials + egress, which CI doesn't have (the same
+    fake-provider pattern as ray: autoscaler/_private/fake_multi_node).
     """
 
     def __init__(self, provider_config: Optional[Dict[str, Any]] = None):

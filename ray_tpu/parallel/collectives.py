@@ -51,6 +51,11 @@ class _GroupCoordinator:
         self._done: Dict[str, Any] = {}
         self._collected: Dict[str, set] = {}
         self._events: Dict[str, Any] = {}
+        # p2p keys whose receiver timed out and left: a LATE put for one
+        # of these is dropped instead of stranding the payload forever
+        # (p2p seqs are never reused).  Bounded: entries clear on the
+        # matching put; a dead sender leaves only the key string.
+        self._abandoned: "set[str]" = set()
 
     def _event(self, key: str):
         import asyncio
@@ -102,6 +107,10 @@ class _GroupCoordinator:
         return out
 
     async def p2p_put(self, key: str, value):
+        if key in self._abandoned:
+            self._abandoned.discard(key)
+            self._events.pop(key, None)
+            return  # receiver already gave up on this seq: drop, don't strand
         self._done[key] = value
         self._event(key).set()
 
@@ -114,6 +123,7 @@ class _GroupCoordinator:
                 await asyncio.wait_for(ev.wait(), timeout)
             except asyncio.TimeoutError:
                 self._events.pop(key, None)
+                self._abandoned.add(key)
                 return None
         self._events.pop(key, None)
         return self._done.pop(key, None)

@@ -223,3 +223,109 @@ def test_inflight_boots_not_relaunched(rt):
         rn = autoscaler.update()
         assert sum(rn["launched"].values()) == 0, "relaunched for in-flight boot"
     assert len(provider.created) == 1
+
+
+def test_tpu_pod_provider_with_fake_gcloud(tmp_path):
+    """TPUPodNodeProvider end-to-end behind a fake `gcloud` executable: the
+    shim records every invocation and BOOTS the 'VM' by running the
+    startup script locally — the provider's pre-assigned node id must then
+    register as a live cluster node, and terminate must gcloud-delete it
+    (the fake-provider pattern of ray: autoscaler/_private/fake_multi_node)."""
+    import json
+    import signal
+    import subprocess
+    import textwrap
+
+    from ray_tpu.autoscaler.node_provider import TPUPodNodeProvider
+    from ray_tpu._private import config as _config
+    from ray_tpu._private.runtime import get_runtime
+
+    import pathlib
+
+    repo_root = str(pathlib.Path(__file__).resolve().parents[1])
+    log = tmp_path / "gcloud.log"
+    pids = tmp_path / "pids"
+    pids.mkdir()
+    fake = tmp_path / "gcloud"
+    fake.write_text(textwrap.dedent(f"""\
+        #!/usr/bin/env python3
+        import json, os, subprocess, sys, signal
+        args = sys.argv[1:]
+        with open({str(log)!r}, "a") as f:
+            f.write(json.dumps(args) + "\\n")
+        if "create" in args:
+            name = args[args.index("create") + 1]
+            meta = next(a for a in args if a.startswith("--metadata=startup-script="))
+            script = meta.split("=", 2)[2]
+            env = dict(os.environ)
+            env["PYTHONPATH"] = {repo_root!r} + os.pathsep + env.get("PYTHONPATH", "")
+            # Redirect the "VM's" stdio: inheriting pytest's capture pipes
+            # would hold them open for the daemon's lifetime and deadlock
+            # the run.
+            p = subprocess.Popen(["bash", "-c", script], env=env,
+                                 start_new_session=True,
+                                 stdout=open({str(tmp_path / "vm.out")!r}, "ab"),
+                                 stderr=open({str(tmp_path / "vm.err")!r}, "ab"))
+            with open(os.path.join({str(pids)!r}, name), "w") as f:
+                f.write(str(p.pid))
+        elif "delete" in args:
+            name = args[args.index("delete") + 1]
+            try:
+                with open(os.path.join({str(pids)!r}, name)) as f:
+                    os.killpg(int(f.read()), signal.SIGTERM)
+            except (OSError, ValueError):
+                pass
+        print("[]")
+    """))
+    fake.chmod(0o755)
+
+    old_path = os.environ["PATH"]
+    os.environ["PATH"] = f"{tmp_path}{os.pathsep}{old_path}"
+    try:
+        ray_tpu.init(
+            num_cpus=2,
+            ignore_reinit_error=True,
+            _system_config={"bind_host": "0.0.0.0"},
+        )
+        provider = TPUPodNodeProvider(
+            {"project": "proj", "zone": "us-z", "head_host": "127.0.0.1"}
+        )
+        pid = provider.create_node("v5p-8", {"CPU": 2.0, "TPU": 4.0})
+        assert pid in provider.non_terminated_nodes()
+        assert provider.node_type(pid) == "v5p-8"
+        # The fake VM's daemon boots and registers the PRE-ASSIGNED id.
+        rt = get_runtime()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            nid = provider.runtime_node_id(pid)
+            if nid is not None:
+                break
+            time.sleep(0.2)
+        assert nid is not None, "fake TPU VM daemon never joined"
+        assert rt.state.nodes[nid].resources.get("TPU") == 4.0
+        # A TPU-shaped task schedules onto the new node.
+
+        @ray_tpu.remote(resources={"TPU": 1.0})
+        def on_tpu():
+            return "ok"
+
+        assert ray_tpu.get(on_tpu.remote(), timeout=60) == "ok"
+
+        provider.terminate_node(pid)
+        assert pid not in provider.non_terminated_nodes()
+        calls = [json.loads(l) for l in log.read_text().splitlines()]
+        assert any("create" in c for c in calls)
+        assert any("delete" in c for c in calls)
+        assert all(f"--project=proj" in c for c in calls)
+    finally:
+        ray_tpu.shutdown()
+        os.environ["PATH"] = old_path
+        os.environ.pop("RAY_TPU_BIND_HOST", None)
+        _config._reset_for_tests()
+        # A mid-test failure skips terminate_node: reap any fake-VM
+        # process groups so their daemons don't outlive the test.
+        for pf in pids.iterdir():
+            try:
+                os.killpg(int(pf.read_text()), signal.SIGTERM)
+            except (OSError, ValueError):
+                pass
