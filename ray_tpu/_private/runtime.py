@@ -69,6 +69,7 @@ def _runtime_env_key(renv) -> object:
         tuple(sorted(env_vars.items())) if env_vars else None,
         renv.get("working_dir"),
         tuple(renv.get("py_modules") or ()) or None,
+        tuple(renv.get("pip") or ()) or None,
     )
 
 
@@ -415,6 +416,8 @@ class Runtime:
         # nid -> last heartbeat time: timeout-based node death detection
         # on top of conn EOF (ray: gcs_health_check_manager.h:39).
         self._daemon_heartbeats: Dict[str, float] = {}
+        # wid -> error text: runtime-env setup failures (non-retriable).
+        self._env_failures: Dict[str, str] = {}
         # Attached driver clients (head-split mode, head.py): did -> conn,
         # plus the pseudo-node each non-co-located driver reads objects as,
         # and per-driver ref borrows dropped on driver death
@@ -1068,6 +1071,20 @@ class Runtime:
                 self._daemon_heartbeats[node_id] = time.monotonic()
                 self._dispatch()
             return
+        if first[0] == "env_failed":
+            # The worker's runtime-env setup failed BEFORE it could serve:
+            # deterministic (a retry reinstalls the same broken env), so
+            # its leased task fails with RuntimeEnvSetupError, not a
+            # retriable crash (ray: RuntimeEnvSetupError semantics).
+            with self.lock:
+                h = self.workers.get(first[1])
+                if h is not None and h.state != "dead":
+                    # (storing for an already-classified worker would leak)
+                    self._env_failures[first[1]] = str(first[2])
+                    self._deferred_crashes.pop(first[1], None)
+                    self._on_worker_crash(first[1])
+            conn.close()
+            return
         if first[0] != "ready":
             conn.close()
             return
@@ -1160,7 +1177,16 @@ class Runtime:
                             and h.proc is not None
                             and not h.proc.is_alive()
                         ):
-                            self._on_worker_crash(wid)
+                            if (
+                                h.state == "starting"
+                                and wid not in self._env_failures
+                                and wid not in self._deferred_crashes
+                            ):
+                                # Give a possible env_failed hello (separate
+                                # conn) a beat to land before classifying.
+                                self._deferred_crashes[wid] = now + 2.0
+                            elif wid not in self._deferred_crashes:
+                                self._on_worker_crash(wid)
                     # Deferred daemon-worker EOFs whose daemon never
                     # reported (hung daemon / lost message): classify now.
                     for wid, deadline in list(self._deferred_crashes.items()):
@@ -1245,7 +1271,23 @@ class Runtime:
                                     self._oom_kills.setdefault(
                                         dmsg[1], tuple(dmsg[3])
                                     )
-                                self._on_worker_crash(dmsg[1])
+                                if (
+                                    h.conn is None
+                                    and h.state == "starting"
+                                    and dmsg[1] not in self._oom_kills
+                                    and dmsg[1] not in self._env_failures
+                                ):
+                                    # A starting worker that died without
+                                    # connecting usually failed env setup;
+                                    # its env_failed hello rides a separate
+                                    # conn — wait briefly so the crash
+                                    # classifies as RuntimeEnvSetupError,
+                                    # not a retriable generic death.
+                                    self._deferred_crashes[dmsg[1]] = (
+                                        time.monotonic() + 2.0
+                                    )
+                                else:
+                                    self._on_worker_crash(dmsg[1])
                             else:
                                 # Crash already classified (EOF saw the
                                 # earlier worker_oom_killed): drop any
@@ -2105,9 +2147,39 @@ class Runtime:
             }
         )
 
-    def _on_worker_crash(self, wid: str) -> None:
+    def _fail_task_record(
+        self, rec: TaskRecord, wid: Optional[str], err: Exception,
+        record_end: bool = True,
+    ) -> None:
+        """Caller holds self.lock.  Terminal task failure: pop + release,
+        error every return id, drop borrowed refs (the shared epilogue of
+        every crash/cancel/OOM/env-failure branch)."""
+        spec = rec.spec
+        self.tasks.pop(spec.task_id, None)
+        self._release_for(rec)
+        if record_end:
+            self._record_task_end(rec, wid, "FAILED")
+        for oid in spec.return_ids():
+            self.store.put_error(oid, err)
+            self._object_ready(oid)
+        for c in spec.contained_refs:
+            self._decref_local(c)
+
+    def _retry_task_record(self, rec: TaskRecord) -> None:
         # caller holds self.lock
+        self.metrics["tasks_retried"] += 1
+        self._release_for(rec)
+        rec.state = "READY"
+        rec.worker_id = None
+        self.ready_queue.append(rec.spec.task_id)
+        self._dispatch()
+
+    def _on_worker_crash(self, wid: str) -> None:
+        # caller holds self.lock.  Pop BOTH classification riders up front:
+        # leaving them behind on duplicate notifications would leak entries
+        # for the head's lifetime.
         oom = self._oom_kills.pop(wid, None)
+        env_fail = self._env_failures.pop(wid, None)
         h = self.workers.pop(wid, None)
         if h is None or h.state == "dead":
             return  # duplicate notification (daemon report + conn EOF)
@@ -2117,7 +2189,7 @@ class Runtime:
         if pool and wid in pool:
             pool.remove(wid)
         if h.actor_id is not None:
-            self._on_actor_worker_crash(h)
+            self._on_actor_worker_crash(h, env_fail=env_fail)
             return
         tid = h.current_task
         if tid is None:
@@ -2127,13 +2199,16 @@ class Runtime:
             return
         spec = rec.spec
         if rec.cancelled:
-            self.tasks.pop(tid, None)
-            self._release_for(rec)
-            for oid in spec.return_ids():
-                self.store.put_error(oid, TaskCancelledError(spec.name))
-                self._object_ready(oid)
-            for c in spec.contained_refs:
-                self._decref_local(c)
+            self._fail_task_record(
+                rec, wid, TaskCancelledError(spec.name), record_end=False
+            )
+            return
+        if env_fail is not None:
+            from ray_tpu.exceptions import RuntimeEnvSetupError
+
+            # Deterministic failure: reinstalling the same broken env on
+            # retry would fail identically — no retry budget applies.
+            self._fail_task_record(rec, wid, RuntimeEnvSetupError(env_fail))
             return
         if oom is not None:
             from ray_tpu._private import config as _config
@@ -2144,57 +2219,49 @@ class Runtime:
             oom_attempts = getattr(spec, "oom_attempts", 0)
             if oom_attempts < _config.get("task_oom_retries"):
                 spec.oom_attempts = oom_attempts + 1
-                self.metrics["tasks_retried"] += 1
-                self._release_for(rec)
-                rec.state = "READY"
-                rec.worker_id = None
-                self.ready_queue.append(tid)
-                self._dispatch()
+                self._retry_task_record(rec)
                 return
             rss, used, limit = oom
-            self.tasks.pop(tid, None)
-            self._release_for(rec)
-            self._record_task_end(rec, wid, "FAILED")
-            err = OutOfMemoryError(
+            self._fail_task_record(rec, wid, OutOfMemoryError(
                 f"task {spec.name}'s worker was killed by the node memory "
                 f"monitor (rss={rss >> 20}MiB, node usage {used >> 20}MiB "
                 f"> limit {limit >> 20}MiB) after "
                 f"{oom_attempts} OOM retries"
-            )
-            for oid in spec.return_ids():
-                self.store.put_error(oid, err)
-                self._object_ready(oid)
-            for c in spec.contained_refs:
-                self._decref_local(c)
+            ))
             return
         if spec.attempt < spec.max_retries:
             spec.attempt += 1
-            self.metrics["tasks_retried"] += 1
-            self._release_for(rec)
-            rec.state = "READY"
-            rec.worker_id = None
-            self.ready_queue.append(tid)
-            self._dispatch()
+            self._retry_task_record(rec)
         else:
-            self.tasks.pop(tid, None)
-            self._release_for(rec)
-            self._record_task_end(rec, wid, "FAILED")
-            err = WorkerCrashedError(
+            self._fail_task_record(rec, wid, WorkerCrashedError(
                 f"worker running task {spec.name} died unexpectedly"
-            )
-            for oid in spec.return_ids():
-                self.store.put_error(oid, err)
-                self._object_ready(oid)
-            for c in spec.contained_refs:
-                self._decref_local(c)
+            ))
 
-    def _on_actor_worker_crash(self, h: WorkerHandle) -> None:
+    def _on_actor_worker_crash(
+        self, h: WorkerHandle, env_fail: Optional[str] = None
+    ) -> None:
         actor_id = h.actor_id
         ar = self.actors.get(actor_id)
         info = self.state.get_actor(actor_id)
         if ar is None or info is None or info.state == DEAD:
             return
         creation = ar.info.creation_spec
+        if env_fail is not None:
+            # Runtime-env setup failed for this actor's worker: retrying
+            # would reinstall the same broken env — fail the actor NOW with
+            # the setup error, not after 3 generic creation retries.
+            from ray_tpu.exceptions import RuntimeEnvSetupError
+
+            err = RuntimeEnvSetupError(env_fail)
+            self._release_actor_placement(ar)
+            self.state.set_actor_state(actor_id, DEAD, death_cause=env_fail)
+            rec = self.tasks.pop(creation.task_id, None)
+            if rec is not None:
+                for oid in rec.spec.return_ids():
+                    self.store.put_error(oid, err)
+                    self._object_ready(oid)
+            self._fail_actor_queue(ar, err)
+            return
         crash_retries = getattr(ar, "_creation_crash_retries", 0)
         if (
             info.state in (PENDING_CREATION, RESTARTING)

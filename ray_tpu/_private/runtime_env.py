@@ -112,9 +112,9 @@ def worker_env_entries(renv: Optional[Dict[str, Any]]) -> Dict[str, str]:
 
     renv = renv or {}
     out = {"RAY_TPU_ENV_VARS": json.dumps(renv.get("env_vars") or {})}
-    if renv.get("working_dir") or renv.get("py_modules"):
+    if renv.get("working_dir") or renv.get("py_modules") or renv.get("pip"):
         out["RAY_TPU_RUNTIME_ENV"] = json.dumps(
-            {k: renv.get(k) for k in ("working_dir", "py_modules")}
+            {k: renv.get(k) for k in ("working_dir", "py_modules", "pip")}
         )
     return out
 
@@ -150,13 +150,69 @@ def fetch_and_extract(uri: str, kv_get) -> str:
     return dest
 
 
+def pip_env_dir(specs: List[str]) -> str:
+    """Worker-host-side pip environment (ray: _private/runtime_env/pip.py,
+    installed there by the per-node agent; here by the first worker that
+    needs it — content-hashed and shared by every later worker on the
+    host).
+
+    `pip install --target` into a per-spec-list cache dir; local
+    wheels/dirs work fully offline, index installs need egress (a clear
+    error either way, never a silent no-op).  Concurrent first installs
+    race benignly: both build tmp dirs, one atomic-renames, losers adopt
+    the winner's.
+    """
+    import shutil
+    import subprocess
+    import sys
+
+    key = hashlib.sha256("\x00".join(sorted(specs)).encode()).hexdigest()[:16]
+    dest = os.path.join(_extract_cache_dir(), "pip", key)
+    if os.path.isdir(dest):
+        return dest
+    tmp = dest + f".tmp-{os.getpid()}"
+    cmd = [
+        sys.executable, "-m", "pip", "install", "--target", tmp,
+        # --no-build-isolation: build local source dirs against the
+        # ambient setuptools instead of fetching a build backend — keeps
+        # local-path installs fully offline.
+        "--no-input", "--disable-pip-version-check", "--quiet",
+        "--no-build-isolation", *specs,
+    ]
+    from ray_tpu.exceptions import RuntimeEnvSetupError
+
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True, timeout=600)
+    except subprocess.TimeoutExpired:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise RuntimeEnvSetupError(
+            f"pip runtime_env install failed for {specs}: timed out after 600s"
+        )
+    if out.returncode != 0:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise RuntimeEnvSetupError(
+            f"pip runtime_env install failed for {specs}: {out.stderr[-800:]}"
+        )
+    try:
+        os.replace(tmp, dest)
+    except OSError:
+        shutil.rmtree(tmp, ignore_errors=True)  # another worker won
+    return dest
+
+
 def apply_worker_runtime_env(renv: Optional[Dict[str, Any]], kv_get) -> None:
-    """Worker-side: chdir into working_dir, put py_modules + working_dir on
-    sys.path (ray: workers import user code from the extracted URIs)."""
+    """Worker-side: chdir into working_dir, put py_modules + working_dir +
+    the pip env on sys.path (ray: workers import user code from the
+    extracted URIs / the agent-built pip env)."""
     if not renv:
         return
     import sys
 
+    pip_specs = renv.get("pip") or []
+    if pip_specs:
+        path = pip_env_dir([str(s) for s in pip_specs])
+        if path not in sys.path:
+            sys.path.insert(0, path)
     for uri in renv.get("py_modules") or []:
         path = fetch_and_extract(uri, kv_get)
         if path not in sys.path:

@@ -522,7 +522,18 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
             finally:
                 c.close()
 
-        apply_worker_runtime_env(_json.loads(renv_json), kv_get=_fetch)
+        try:
+            apply_worker_runtime_env(_json.loads(renv_json), kv_get=_fetch)
+        except Exception as e:  # noqa: BLE001 — report, then die
+            # Setup failure is deterministic: report it as a structured
+            # env_failed hello so the head fails the leased task with
+            # RuntimeEnvSetupError instead of a retriable worker crash.
+            try:
+                with conn_lock:
+                    conn.send(("env_failed", worker_id, f"{type(e).__name__}: {e}"))
+            except OSError:
+                pass
+            sys.exit(1)
 
     with conn_lock:
         conn.send(("ready", worker_id, os.getpid(), node_id))

@@ -122,9 +122,12 @@ class _GroupCoordinator:
             try:
                 await asyncio.wait_for(ev.wait(), timeout)
             except asyncio.TimeoutError:
-                self._events.pop(key, None)
-                self._abandoned.add(key)
-                return None
+                if key not in self._done:
+                    # True timeout (not the completion-vs-timer race —
+                    # that falls through and drains normally).
+                    self._events.pop(key, None)
+                    self._abandoned.add(key)
+                    return None
         self._events.pop(key, None)
         return self._done.pop(key, None)
 

@@ -186,3 +186,77 @@ def test_runtime_env_workers_not_shared_across_envs(rt, tmp_path):
     )
     assert (t1, t2) == ("one", "two")
     assert pid1 != pid2, "different runtime envs must not share a worker"
+
+
+def test_pip_runtime_env_local_package(rt, tmp_path):
+    """runtime_env={"pip": [...]} builds a content-hashed per-host env and
+    prepends it to the worker's sys.path (ray: _private/runtime_env/pip.py
+    — agent-installed there, first-worker-installed here).  Local source
+    dirs install fully offline."""
+    pkg = tmp_path / "magic_pkg"
+    pkg.mkdir()
+    (pkg / "pyproject.toml").write_text(
+        '[build-system]\nrequires=["setuptools"]\n'
+        'build-backend="setuptools.build_meta"\n'
+        '[project]\nname="magic-mod-xyz"\nversion="0.1"\n'
+        "[tool.setuptools]\npy-modules=[\"magic_mod_xyz\"]\n"
+    )
+    (pkg / "magic_mod_xyz.py").write_text("VALUE = 41\n")
+
+    @ray_tpu.remote(runtime_env={"pip": [str(pkg)]})
+    def use_pkg():
+        import magic_mod_xyz
+
+        return magic_mod_xyz.VALUE + 1
+
+    with pytest.raises(ImportError):
+        import magic_mod_xyz  # noqa: F401 — driver must NOT see it
+
+    assert ray_tpu.get(use_pkg.remote(), timeout=180) == 42
+
+    # Second task with the same spec reuses the cached env (same worker
+    # pool key) — and a DIFFERENT env key never sees the package.
+    assert ray_tpu.get(use_pkg.remote(), timeout=60) == 42
+
+    @ray_tpu.remote
+    def plain():
+        try:
+            import magic_mod_xyz  # noqa: F401
+
+            return "leaked"
+        except ImportError:
+            return "isolated"
+
+    assert ray_tpu.get(plain.remote(), timeout=60) == "isolated"
+
+
+def test_pip_runtime_env_bad_spec_fails_clearly(rt):
+    """An uninstallable pip spec surfaces a setup error, not a hang."""
+
+    @ray_tpu.remote(
+        runtime_env={"pip": ["definitely-not-a-real-package-xyz==9.9.9"]}
+    )
+    def f():
+        return 1
+
+    with pytest.raises(Exception, match="pip runtime_env install failed"):
+        ray_tpu.get(f.remote(), timeout=180)
+
+
+def test_pip_runtime_env_bad_spec_fails_actor_creation(rt):
+    """A broken env on an ACTOR fails creation with the setup error
+    immediately — no 3x generic creation-crash retries re-running the
+    install (each a full pip invocation)."""
+    import time as _time
+
+    @ray_tpu.remote(runtime_env={"pip": ["also-not-a-real-package-abc==1.0"]})
+    class A:
+        def ping(self):
+            return "pong"
+
+    t0 = _time.monotonic()
+    a = A.remote()
+    with pytest.raises(Exception, match="pip runtime_env install failed"):
+        ray_tpu.get(a.ping.remote(), timeout=180)
+    # One failed install (+ the 2s classification grace), not 3 retries.
+    assert _time.monotonic() - t0 < 60
