@@ -222,3 +222,53 @@ def main(argv=None):
 
 if __name__ == "__main__":
     main()
+
+
+def bench_broadcast_cross_node(n_nodes: int = 3, mb: int = 100) -> Dict:
+    """Broadcast one large object to N ISOLATED-store daemon nodes over the
+    transfer plane (BASELINE.md: '1 GiB broadcast to 50 nodes' scalability
+    row; here sized for CI).  Each node pulls chunked from the owner and
+    seals a local copy — no shared filesystem path involved."""
+    import numpy as np
+
+    from ray_tpu._private.runtime import get_runtime
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    rt = get_runtime()
+    nids = [rt.add_daemon_node(num_cpus=1) for _ in range(n_nodes)]
+    payload = np.random.default_rng(0).integers(
+        0, 255, size=mb * 1024 * 1024, dtype=np.uint8
+    )
+    ref = ray_tpu.put(payload)
+
+    @ray_tpu.remote
+    def land(x):
+        return int(x[::1024].sum())
+
+    expect = int(payload[::1024].sum())
+
+    def run():
+        t0 = time.perf_counter()
+        outs = ray_tpu.get(
+            [
+                land.options(
+                    scheduling_strategy=NodeAffinitySchedulingStrategy(nid)
+                ).remote(ref)
+                for nid in nids
+            ],
+            timeout=300,
+        )
+        assert all(o == expect for o in outs)
+        return time.perf_counter() - t0
+
+    cold = run()  # every node pulls over the wire
+    warm = run()  # all copies local: pure read path
+    for nid in nids:
+        rt.remove_node(nid)
+    total_gb = mb * n_nodes / 1024
+    return {
+        "name": f"broadcast_{mb}mb_to_{n_nodes}_nodes",
+        "cold_s": round(cold, 3),
+        "cold_gb_per_s": round(total_gb / cold, 2),
+        "warm_s": round(warm, 3),
+    }
