@@ -58,6 +58,31 @@ from ray_tpu.exceptions import (
 
 _worker_mode = False  # set True inside worker processes (worker_proc.py)
 
+# Lock-discipline checking (SURVEY §5.2): the reference leans on clang
+# thread-safety annotations (GUARDED_BY) + TSAN in CI; the Python analogue
+# is runtime ownership assertions on every "caller holds self.lock"
+# internal.  Enabled via RAY_TPU_DEBUG_LOCKS=1 — the test suite runs with
+# it on (tests/conftest.py), production pays only one module-bool check.
+_DEBUG_LOCKS = os.environ.get("RAY_TPU_DEBUG_LOCKS") == "1"
+
+
+def _locked(method):
+    """Decorator asserting the runtime lock is held on entry (debug mode)."""
+    if not _DEBUG_LOCKS:
+        return method
+    import functools
+
+    @functools.wraps(method)
+    def wrapper(self, *a, **kw):
+        if not self.lock._is_owned():
+            raise AssertionError(
+                f"{method.__name__} requires self.lock held (lock-discipline "
+                "violation — see RAY_TPU_DEBUG_LOCKS)"
+            )
+        return method(self, *a, **kw)
+
+    return wrapper
+
 
 def _runtime_env_key(renv) -> object:
     """Worker-pool identity of a runtime env: workers are only shared
@@ -748,6 +773,7 @@ class Runtime:
         for aid in doomed:
             self.kill_actor(aid, no_restart=True)
 
+    @_locked
     def _on_daemon_death(self, node_id: str) -> None:
         """Caller holds self.lock.  Node failure: the daemon's whole worker
         pool dies with it (the daemon terminates its children on exit)."""
@@ -1114,6 +1140,7 @@ class Runtime:
         with self.lock:
             self._dispatch()
 
+    @_locked
     def _adopt_worker(self, conn, first) -> Optional[WorkerHandle]:
         """Caller holds self.lock.  A worker this head never spawned says
         "ready": after a head restart, surviving workers reconnect within
@@ -1373,6 +1400,7 @@ class Runtime:
                     traceback.print_exc()
                 i += 1
 
+    @_locked
     def _handle_hot_locked(self, wid: str, msg: tuple) -> None:
         # caller holds self.lock
         if msg[0] == "done":
@@ -1580,6 +1608,7 @@ class Runtime:
                 t.start()
             return _PARKED
 
+    @_locked
     def _wait_token_reply(self, token) -> None:
         """Caller holds self.lock.  Reply once and detach the token from
         every oid list it is parked on (a timed-out token would otherwise
@@ -1609,6 +1638,7 @@ class Runtime:
     def _lineage_cost(spec) -> int:
         return len(spec.args_blob or b"") + 256  # blob + record overhead
 
+    @_locked
     def _reconstruct(self, oid: str) -> bool:
         """Re-execute the producer task of a lost object.  Caller holds
         self.lock.  Returns False when no lineage exists (driver put() /
@@ -1892,6 +1922,7 @@ class Runtime:
             return ("affinity", strategy.node_id, strategy.soft)
         return strategy if isinstance(strategy, (str, type(None))) else repr(strategy)
 
+    @_locked
     def _dispatch(self) -> None:
         # caller holds self.lock
         for pg_id in list(self.pending_pgs):
@@ -1951,6 +1982,7 @@ class Runtime:
             if not q:
                 self.ready_queue.buckets.pop(shape, None)
 
+    @_locked
     def _dispatch_placed(self, rec: TaskRecord, node: str) -> None:
         # caller holds self.lock; resources for `node` already acquired
         spec = rec.spec
@@ -2001,6 +2033,7 @@ class Runtime:
             self.scheduler.release(ar.placement[1], res)
         ar.placement = None
 
+    @_locked
     def _on_task_done(self, wid: str, task_id: str, results, error_blob) -> None:
         # caller holds self.lock
         rec = self.tasks.pop(task_id, None)
@@ -2147,6 +2180,7 @@ class Runtime:
             }
         )
 
+    @_locked
     def _fail_task_record(
         self, rec: TaskRecord, wid: Optional[str], err: Exception,
         record_end: bool = True,
@@ -2165,6 +2199,7 @@ class Runtime:
         for c in spec.contained_refs:
             self._decref_local(c)
 
+    @_locked
     def _retry_task_record(self, rec: TaskRecord) -> None:
         # caller holds self.lock
         self.metrics["tasks_retried"] += 1
@@ -2174,6 +2209,7 @@ class Runtime:
         self.ready_queue.append(rec.spec.task_id)
         self._dispatch()
 
+    @_locked
     def _on_worker_crash(self, wid: str) -> None:
         # caller holds self.lock.  Pop BOTH classification riders up front:
         # leaving them behind on duplicate notifications would leak entries
@@ -2237,6 +2273,7 @@ class Runtime:
                 f"worker running task {spec.name} died unexpectedly"
             ))
 
+    @_locked
     def _on_actor_worker_crash(
         self, h: WorkerHandle, env_fail: Optional[str] = None
     ) -> None:

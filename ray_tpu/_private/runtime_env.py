@@ -76,6 +76,36 @@ def package_dir(path: str, session: Optional[str] = None) -> Tuple[str, Optional
     return uri, data
 
 
+_SUPPORTED_KEYS = frozenset(
+    {"env_vars", "working_dir", "py_modules", "pip", "_resolved", "_orig"}
+)
+
+
+def validate_runtime_env(renv: Optional[Dict[str, Any]]) -> None:
+    """Fail UNKNOWN/unsupported runtime_env fields at submit time.
+
+    conda/container (ray: _private/runtime_env/{conda,container}.py) need
+    a conda toolchain / container runtime this framework doesn't manage —
+    a clear driver-side error beats a worker-boot mystery; typos in
+    supported keys surface the same way."""
+    if not renv:
+        return
+    unknown = set(renv) - _SUPPORTED_KEYS
+    if unknown:
+        from ray_tpu.exceptions import RuntimeEnvSetupError
+
+        hints = {
+            "conda": "use runtime_env={'pip': [...]} (per-host target installs)",
+            "container": "run the node daemon inside your container instead",
+        }
+        notes = "; ".join(f"{k}: {hints[k]}" for k in sorted(unknown) if k in hints)
+        raise RuntimeEnvSetupError(
+            f"unsupported runtime_env keys {sorted(unknown)} "
+            f"(supported: {sorted(k for k in _SUPPORTED_KEYS if not k.startswith('_'))})"
+            + (f" — {notes}" if notes else "")
+        )
+
+
 def resolve_runtime_env(
     renv: Optional[Dict[str, Any]], kv_put, session: Optional[str] = None
 ) -> Optional[Dict[str, Any]]:

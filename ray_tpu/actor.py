@@ -122,6 +122,10 @@ class ActorClass:
 
     def remote(self, *args, **kwargs) -> ActorHandle:
         o = self._opts
+        if o.get("runtime_env"):
+            from ray_tpu._private.runtime_env import validate_runtime_env
+
+            validate_runtime_env(o["runtime_env"])
         name = o.get("name")
         if name and o.get("get_if_exists"):
             try:

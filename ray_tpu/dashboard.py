@@ -94,8 +94,12 @@ class Dashboard:
 
         # Non-JSON routes share the same dispatch: (handler, content_type);
         # a None content_type means JSON-serialize the handler's result.
-        content_types = {"/metrics": "text/plain; version=0.0.4"}
+        content_types = {
+            "/metrics": "text/plain; version=0.0.4",
+            "/": "text/html; charset=utf-8",
+        }
         routes["/metrics"] = _prometheus
+        routes["/"] = lambda: _INDEX_HTML
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):
@@ -168,3 +172,55 @@ def stop_dashboard() -> None:
     if _dashboard is not None:
         _dashboard.shutdown()
         _dashboard = None
+
+
+# Web UI-lite: one static page over the JSON endpoints (ray: dashboard/
+# client React app reduced to a dependency-free auto-refreshing view —
+# no frontend build, works wherever the head runs).
+_INDEX_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>ray_tpu dashboard</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:1.5rem;background:#fafafa;color:#222}
+ h1{font-size:1.3rem} h2{font-size:1.05rem;margin:1.2rem 0 .4rem}
+ table{border-collapse:collapse;font-size:.85rem;background:#fff}
+ th,td{border:1px solid #ddd;padding:.25rem .6rem;text-align:left}
+ th{background:#f0f0f0} .num{text-align:right}
+ #err{color:#b00020} code{background:#eee;padding:0 .3rem}
+</style></head><body>
+<h1>ray_tpu dashboard <small id="ts"></small></h1>
+<div id="err"></div>
+<h2>Cluster metrics</h2><table id="metrics"></table>
+<h2>Nodes</h2><table id="nodes"></table>
+<h2>Actors</h2><table id="actors"></table>
+<h2>Task summary</h2><table id="summary"></table>
+<p>Raw endpoints: <code>/api/nodes</code> <code>/api/tasks</code>
+<code>/api/actors</code> <code>/api/objects</code> <code>/api/workers</code>
+<code>/api/placement_groups</code> <code>/api/metrics</code>
+<code>/api/summary</code> <code>/api/timeline</code> <code>/api/logs</code>
+<code>/metrics</code> (Prometheus)</p>
+<script>
+function row(cells, tag){const tr=document.createElement('tr');
+ for(const c of cells){const td=document.createElement(tag||'td');
+  td.textContent=(typeof c==='number')?(Number.isInteger(c)?c:c.toFixed(2)):String(c);
+  tr.appendChild(td);} return tr;}
+function fill(id, header, rows){const t=document.getElementById(id);
+ t.replaceChildren(row(header,'th')); for(const r of rows) t.appendChild(row(r));}
+async function j(p){const r=await fetch(p); if(!r.ok) throw new Error(p+': '+r.status);
+ return r.json();}
+async function refresh(){
+ try{
+  const [m, nodes, actors, summary] = await Promise.all(
+   [j('/api/metrics'), j('/api/nodes'), j('/api/actors'), j('/api/summary')]);
+  fill('metrics', ['metric','value'], Object.entries(m));
+  fill('nodes', ['node','alive','head','resources','available'], nodes.map(n =>
+   [n.node_id, n.alive===false?'dead':'alive', n.is_head?'yes':'',
+    JSON.stringify(n.resources||{}), JSON.stringify(n.available||{})]));
+  fill('actors', ['actor','name','state','restarts'], actors.map(a =>
+   [a.actor_id, a.name||'', a.state, a.num_restarts||0]));
+  fill('summary', ['state','count'], Object.entries(summary));
+  document.getElementById('ts').textContent=new Date().toLocaleTimeString();
+  document.getElementById('err').textContent='';
+ }catch(e){document.getElementById('err').textContent=String(e);}
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""

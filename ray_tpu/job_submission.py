@@ -75,6 +75,12 @@ class JobSubmissionClient:
             self._jobs[job_id] = info
         env = os.environ.copy()
         renv = runtime_env or {}
+        if renv:
+            from ray_tpu._private.runtime_env import validate_runtime_env
+
+            # Same submit-time contract as tasks/actors: typos and
+            # conda/container fail fast with guidance, never silently drop.
+            validate_runtime_env(renv)
         env.update({k: str(v) for k, v in (renv.get("env_vars") or {}).items()})
         cwd = renv.get("working_dir") or os.getcwd()
         paths = [p for p in (renv.get("py_modules") or [])] + [cwd]

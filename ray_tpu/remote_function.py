@@ -48,9 +48,15 @@ class RemoteFunction:
         return DAGNode(self, args, kwargs)
 
     def remote(self, *args, **kwargs):
-        fn_id = self._ensure_exported()
         o = self._opts
         renv = o.get("runtime_env")
+        if renv:
+            # Validate BEFORE exporting: a rejected submission must not pay
+            # the cloudpickle + KV export of a function that never runs.
+            from ray_tpu._private.runtime_env import validate_runtime_env
+
+            validate_runtime_env(renv)
+        fn_id = self._ensure_exported()
         session = current_session()
         if (
             renv

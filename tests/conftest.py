@@ -21,6 +21,10 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 # keeps CPU-only test workers from paying its ~2s sitecustomize jax import
 # on every boot (tests never touch the real chip).
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+# Lock-discipline assertions on for the whole suite (SURVEY §5.2 — the
+# Python analogue of the reference's clang GUARDED_BY + TSAN CI): every
+# "caller holds self.lock" internal verifies ownership at entry.
+os.environ.setdefault("RAY_TPU_DEBUG_LOCKS", "1")
 
 import jax
 

@@ -260,3 +260,30 @@ def test_pip_runtime_env_bad_spec_fails_actor_creation(rt):
         ray_tpu.get(a.ping.remote(), timeout=180)
     # One failed install (+ the 2s classification grace), not 3 retries.
     assert _time.monotonic() - t0 < 60
+
+
+def test_unsupported_runtime_env_keys_fail_at_submit(rt):
+    """conda/container (and typos) fail DRIVER-side with guidance, before
+    any worker spawn (ray: the conda/container plugins need toolchains
+    this framework doesn't manage)."""
+
+    @ray_tpu.remote(runtime_env={"conda": {"dependencies": ["x"]}})
+    def f():
+        return 1
+
+    with pytest.raises(Exception, match="unsupported runtime_env.*pip"):
+        f.remote()
+
+    @ray_tpu.remote(runtime_env={"working_dirr": "/tmp"})  # typo
+    def g():
+        return 1
+
+    with pytest.raises(Exception, match="working_dirr"):
+        g.remote()
+
+    @ray_tpu.remote(runtime_env={"container": {"image": "x"}})
+    class A:
+        pass
+
+    with pytest.raises(Exception, match="container"):
+        A.remote()

@@ -303,3 +303,33 @@ def test_hung_daemon_declared_dead_by_heartbeat_timeout():
 
         ray_tpu.shutdown()
         _c._reset_for_tests()
+
+
+def test_dashboard_index_page(rt):
+    """The web UI-lite page serves at / and every endpoint its script
+    fetches responds with the JSON shapes the renderer consumes."""
+    import re
+    import urllib.request
+
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get(f.remote(), timeout=30)
+    dash = start_dashboard()
+    try:
+        html = urllib.request.urlopen(f"{dash.url}/", timeout=10).read().decode()
+        assert "<html" in html and "ray_tpu dashboard" in html
+        # Every table the script fills exists in the markup.
+        for el in ("metrics", "nodes", "actors", "summary", "err", "ts"):
+            assert f'id="{el}"' in html, el
+        # Every endpoint the script fetches answers with parseable JSON.
+        import json as _json
+
+        for ep in re.findall(r"j\('(/api/[a-z_]+)'\)", html):
+            body = urllib.request.urlopen(f"{dash.url}{ep}", timeout=10).read()
+            _json.loads(body)
+    finally:
+        stop_dashboard()
