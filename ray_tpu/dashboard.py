@@ -102,6 +102,8 @@ class Dashboard:
         routes["/"] = lambda: _INDEX_HTML
 
         class Handler(BaseHTTPRequestHandler):
+            disable_nagle_algorithm = True  # no Nagle/delayed-ACK stalls
+
             def log_message(self, *a):
                 pass
 

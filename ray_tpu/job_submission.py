@@ -63,6 +63,24 @@ class JobSubmissionClient:
         submission_id: Optional[str] = None,
     ) -> str:
         job_id = submission_id or f"raytpu-job-{uuid.uuid4().hex[:12]}"
+        renv = runtime_env or {}
+        pip_path = None
+        if renv:
+            from ray_tpu._private.runtime_env import (
+                pip_env_dir,
+                validate_runtime_env,
+            )
+
+            # Same submit-time contract as tasks/actors: typos and
+            # conda/container fail fast with guidance, never silently drop.
+            # Raises happen BEFORE the job registers — a rejected
+            # submission must not leave a ghost PENDING entry (and the
+            # submission_id stays reusable for the corrected retry).
+            validate_runtime_env(renv)
+            if renv.get("pip"):
+                # Jobs run on this host: build/reuse the content-hashed
+                # pip env and put it on the entrypoint's PYTHONPATH.
+                pip_path = pip_env_dir([str(s) for s in renv["pip"]])
         with self._lock:
             if job_id in self._jobs:
                 raise ValueError(f"job {job_id!r} already exists")
@@ -74,16 +92,11 @@ class JobSubmissionClient:
             )
             self._jobs[job_id] = info
         env = os.environ.copy()
-        renv = runtime_env or {}
-        if renv:
-            from ray_tpu._private.runtime_env import validate_runtime_env
-
-            # Same submit-time contract as tasks/actors: typos and
-            # conda/container fail fast with guidance, never silently drop.
-            validate_runtime_env(renv)
         env.update({k: str(v) for k, v in (renv.get("env_vars") or {}).items()})
         cwd = renv.get("working_dir") or os.getcwd()
-        paths = [p for p in (renv.get("py_modules") or [])] + [cwd]
+        paths = ([pip_path] if pip_path else []) + [
+            p for p in (renv.get("py_modules") or [])
+        ] + [cwd]
         if env.get("PYTHONPATH"):
             paths.append(env["PYTHONPATH"])
         env["PYTHONPATH"] = os.pathsep.join(paths)

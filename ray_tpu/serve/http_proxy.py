@@ -33,6 +33,10 @@ class HTTPProxy:
             # HTTP/1.1: required for chunked transfer (streaming responses);
             # non-streaming replies all carry Content-Length.
             protocol_version = "HTTP/1.1"
+            # Headers and body go out as separate small writes: without
+            # TCP_NODELAY, Nagle holds the second segment for the peer's
+            # delayed ACK — measured ~40ms p50 on keep-alive connections.
+            disable_nagle_algorithm = True
 
             def log_message(self, *a):  # quiet
                 pass

@@ -329,3 +329,44 @@ def test_tpu_pod_provider_with_fake_gcloud(tmp_path):
                 os.killpg(int(pf.read_text()), signal.SIGTERM)
             except (OSError, ValueError):
                 pass
+
+
+def test_job_pip_runtime_env_and_validation(tmp_path):
+    """Jobs honor runtime_env pip (installed to the per-host cache, on the
+    entrypoint's PYTHONPATH) and reject bad envs BEFORE registering — a
+    rejected submission_id stays reusable."""
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    try:
+        client = JobSubmissionClient()
+        with pytest.raises(Exception, match="unsupported runtime_env"):
+            client.submit_job(
+                entrypoint="python -c 'pass'",
+                runtime_env={"conda": {}},
+                submission_id="envjob",
+            )
+        assert "envjob" not in [j.job_id for j in client.list_jobs()]
+
+        pkg = tmp_path / "jobpkg"
+        pkg.mkdir()
+        (pkg / "pyproject.toml").write_text(
+            '[build-system]\nrequires=["setuptools"]\n'
+            'build-backend="setuptools.build_meta"\n'
+            '[project]\nname="jobmod"\nversion="0.1"\n'
+            "[tool.setuptools]\npy-modules=[\"jobmod_xyz\"]\n"
+        )
+        (pkg / "jobmod_xyz.py").write_text("ANSWER = 7\n")
+        jid = client.submit_job(
+            entrypoint="python -c 'import jobmod_xyz; print(jobmod_xyz.ANSWER * 6)'",
+            runtime_env={"pip": [str(pkg)]},
+            submission_id="envjob",  # the rejected id is free again
+        )
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            st = client.get_job_status(jid)
+            if st in (SUCCEEDED, FAILED, STOPPED):
+                break
+            time.sleep(0.2)
+        assert st == SUCCEEDED, client.get_job_logs(jid)[-400:]
+        assert "42" in client.get_job_logs(jid)
+    finally:
+        ray_tpu.shutdown()
