@@ -176,9 +176,14 @@ def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
 
 def _ambient_mesh():
     """The mesh from an enclosing `with mesh:` scope, if any."""
-    from jax.interpreters import pxla
+    try:
+        # jax.interpreters.pxla.thread_resources is deprecated (jax 0.8.2+);
+        # the underlying accessor lives in jax._src.mesh.
+        from jax._src.mesh import thread_resources
+    except ImportError:  # future relocation: fall back to the deprecated path
+        from jax.interpreters.pxla import thread_resources
 
-    m = pxla.thread_resources.env.physical_mesh
+    m = thread_resources.env.physical_mesh
     return None if m.empty else m
 
 
