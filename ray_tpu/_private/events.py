@@ -1,0 +1,90 @@
+"""Structured cluster events: severity + source, durably appended.
+
+ray: src/ray/util/event.h:102 (EventManager) + event.proto — components
+RAY_EVENT important transitions (node death, worker OOM kills, actor
+restarts) into per-source event files that operators grep after the fact.
+Here one JSONL file per session (`events.jsonl` in the session dir) plus a
+bounded in-memory ring for the state API / dashboard.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+SEVERITIES = ("DEBUG", "INFO", "WARNING", "ERROR", "FATAL")
+
+
+class EventLog:
+    """Append-only structured event sink (one per runtime)."""
+
+    def __init__(self, path: Optional[str], ring_size: int = 1000):
+        self._path = path
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=ring_size)
+        self._f = None
+        if path:
+            try:
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                self._f = open(path, "a", buffering=1)  # line-buffered
+            except OSError:
+                self._f = None
+
+    def emit(
+        self,
+        severity: str,
+        source: str,
+        message: str,
+        **fields: Any,
+    ) -> None:
+        """Record one event; never raises (observability must not take the
+        control plane down)."""
+        if severity not in SEVERITIES:
+            severity = "INFO"
+        ev = {
+            "timestamp": time.time(),
+            "severity": severity,
+            "source": source,
+            "message": message,
+            **fields,
+        }
+        with self._lock:
+            self._ring.append(ev)
+            if self._f is not None:
+                try:
+                    self._f.write(json.dumps(ev, default=str) + "\n")
+                except (OSError, ValueError):
+                    pass
+
+    def recent(
+        self, limit: int = 100, severity: Optional[str] = None,
+        source: Optional[str] = None,
+    ) -> List[Dict]:
+        with self._lock:
+            evs = list(self._ring)
+        if severity:
+            severity = str(severity).upper()  # curl users type lowercase
+            if severity not in SEVERITIES:
+                raise ValueError(
+                    f"severity {severity!r} not one of {SEVERITIES}"
+                )
+            floor = SEVERITIES.index(severity)
+            evs = [e for e in evs if SEVERITIES.index(e["severity"]) >= floor]
+        if source:
+            evs = [e for e in evs if e["source"] == source]
+        if limit <= 0:  # evs[-0:] would be EVERYTHING, the opposite of "none"
+            return []
+        return evs[-limit:]
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+                self._f = None

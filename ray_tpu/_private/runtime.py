@@ -443,6 +443,8 @@ class Runtime:
         self._daemon_heartbeats: Dict[str, float] = {}
         # wid -> error text: runtime-env setup failures (non-retriable).
         self._env_failures: Dict[str, str] = {}
+        # planned node removals: their daemon EOF is routine, not failure
+        self._expected_node_removals: "Set[str]" = set()
         # Attached driver clients (head-split mode, head.py): did -> conn,
         # plus the pseudo-node each non-co-located driver reads objects as,
         # and per-driver ref borrows dropped on driver death
@@ -462,6 +464,15 @@ class Runtime:
         # and forward over their conns); every line lands in a per-worker
         # ring buffer (CLI/dashboard) and echoes to this process's stdout.
         self.log_dir = f"/tmp/raytpu-logs-{self.session_name}"
+        # Structured cluster events (SURVEY §2.1 event framework —
+        # ray: src/ray/util/event.h:102): severity/source records of
+        # control-plane transitions, durable JSONL + in-memory ring.
+        from ray_tpu._private.events import EventLog
+
+        self.events = EventLog(os.path.join(self.log_dir, "events.jsonl"))
+        self.events.emit(
+            "INFO", "runtime", "session started", session=self.session_name
+        )
         self.worker_logs: Dict[str, deque] = {}
         self.log_to_driver = _config.get("log_to_driver") != 0
         from ray_tpu._private.log_monitor import LogMonitor
@@ -780,6 +791,11 @@ class Runtime:
         self.node_daemons.pop(node_id, None)
         self.node_object_endpoints.pop(node_id, None)
         self._daemon_heartbeats.pop(node_id, None)
+        if node_id in self._expected_node_removals:
+            self._expected_node_removals.discard(node_id)
+            self.events.emit("INFO", "node", "node removed", node_id=node_id)
+        else:
+            self.events.emit("ERROR", "node", "node died", node_id=node_id)
         # Copies on the dead node are gone; objects whose ONLY copy lived
         # there become lost-bytes (gets fall through to lineage
         # reconstruction, exactly like a lost spill file).
@@ -1091,6 +1107,7 @@ class Runtime:
                     self.node_object_endpoints[node_id] = tuple(ep)
                 self.node_daemons[node_id] = conn
                 self._conn_to_daemon[conn] = node_id
+                self.events.emit("INFO", "node", "node registered", node_id=node_id)
                 # Fresh liveness clock: a stale entry from a previous
                 # incarnation of this node_id would instantly time the
                 # reconnected daemon out before its first heartbeat.
@@ -1236,6 +1253,11 @@ class Runtime:
                                 # first sight, not at epoch.
                                 self._daemon_heartbeats[nid] = now
                             elif now - last > hb_timeout:
+                                self.events.emit(
+                                    "WARNING", "node",
+                                    "heartbeat timeout: declaring node dead",
+                                    node_id=nid, silent_s=round(now - last, 1),
+                                )
                                 self._conn_to_daemon.pop(dconn, None)
                                 self._daemon_heartbeats.pop(nid, None)
                                 try:
@@ -2220,6 +2242,11 @@ class Runtime:
         if h is None or h.state == "dead":
             return  # duplicate notification (daemon report + conn EOF)
         self.metrics["worker_crashes"] += 1
+        self.events.emit(
+            "WARNING", "worker", "worker died",
+            worker_id=wid, node_id=h.node_id,
+            cause="oom_kill" if oom else ("env_setup" if env_fail else "crash"),
+        )
         h.state = "dead"
         pool = self.idle_pool.get((h.node_id, h.env_key))
         if pool and wid in pool:
@@ -2350,6 +2377,10 @@ class Runtime:
         if can_restart:
             info.num_restarts += 1
             self.metrics["actor_restarts"] += 1
+            self.events.emit(
+                "WARNING", "actor", "actor restarting",
+                actor_id=actor_id, restart=info.num_restarts,
+            )
             self.state.set_actor_state(actor_id, RESTARTING)
             ar.worker_id = None
             # resubmit the creation task (restart FSM:
@@ -2564,6 +2595,9 @@ class Runtime:
 
     def remove_node(self, node_id: str) -> None:
         with self.lock:
+            # Planned removal (autoscaler downscale / Cluster API): the
+            # ensuing daemon EOF must log as routine, not as a failure.
+            self._expected_node_removals.add(node_id)
             self.state.remove_node(node_id)
             victims = [h for h in self.workers.values() if h.node_id == node_id]
             self._daemon_send(node_id, ("shutdown",))
@@ -2590,6 +2624,11 @@ class Runtime:
         try:
             self._log_monitor.flush()
             self._log_monitor.stop()
+        except Exception:
+            pass
+        try:
+            self.events.emit("INFO", "runtime", "session shutting down")
+            self.events.close()
         except Exception:
             pass
         for nid in list(self.node_daemons):

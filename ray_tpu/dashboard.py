@@ -51,6 +51,22 @@ def timeline() -> list:
     return out
 
 
+def _events_endpoint(query=None):
+    """Structured cluster events with ?severity=&source=&limit= filters."""
+    from ray_tpu.util import state as state_api
+
+    q = query or {}
+    try:
+        limit = int(q.get("limit", [100])[0])
+    except ValueError:
+        limit = 100
+    return state_api.list_cluster_events(
+        limit=limit,
+        severity=(q.get("severity") or [None])[0],
+        source=(q.get("source") or [None])[0],
+    )
+
+
 def _logs_endpoint(worker=None, tail: int = 0, query=None):
     """Per-worker captured output (ray: dashboard log index + `ray logs`).
     Without ?worker=, lists workers that have log lines."""
@@ -82,6 +98,7 @@ class Dashboard:
             "/api/summary": state_api.summarize_tasks,
             "/api/timeline": timeline,
             "/api/logs": _logs_endpoint,
+            "/api/events": _events_endpoint,
         }
 
         def _prometheus() -> str:

@@ -162,3 +162,12 @@ def cluster_metrics() -> Dict[str, float]:
         }
     )
     return m
+
+
+def list_cluster_events(
+    limit: int = 100, severity: str = None, source: str = None
+) -> List[Dict[str, Any]]:
+    """Structured control-plane events — node/worker/actor transitions with
+    severity + source (ray: `ray list cluster-events` over the event files,
+    src/ray/util/event.h:102)."""
+    return _rt().events.recent(limit=limit, severity=severity, source=source)

@@ -333,3 +333,75 @@ def test_dashboard_index_page(rt):
             _json.loads(body)
     finally:
         stop_dashboard()
+
+
+def test_structured_cluster_events():
+    """§2.1 event framework (ray: src/ray/util/event.h:102): severity +
+    source structured events land in the session's events.jsonl AND the
+    state API / dashboard, recording node and worker transitions."""
+    import json as _json
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+    from ray_tpu.util.state import list_cluster_events
+    from ray_tpu._private.runtime import get_runtime
+
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    try:
+        rt_ = get_runtime()
+        nid = rt_.add_daemon_node(num_cpus=1)  # crashes below -> "node died"
+        nid2 = rt_.add_daemon_node(num_cpus=1)  # removed -> routine INFO
+
+        @ray_tpu.remote
+        def die():
+            import os
+
+            os._exit(1)
+
+        with pytest.raises(Exception):
+            ray_tpu.get(die.options(max_retries=0).remote(), timeout=60)
+        rt_._daemon_procs[nid].kill()  # node CRASH (unplanned)
+        rt_.remove_node(nid2)  # planned downscale
+
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            evs = list_cluster_events(limit=200)
+            kinds = {(e["source"], e["message"]) for e in evs}
+            if ("node", "node died") in kinds and (
+                "node", "node removed"
+            ) in kinds and ("worker", "worker died") in kinds:
+                break
+            time.sleep(0.2)
+        assert ("node", "node registered") in kinds
+        assert ("node", "node died") in kinds  # the kill -9'd daemon
+        assert ("node", "node removed") in kinds  # planned: NOT an ERROR
+        assert ("worker", "worker died") in kinds
+        sev = {
+            (e["source"], e["message"]): e["severity"]
+            for e in list_cluster_events(limit=200)
+        }
+        assert sev[("node", "node died")] == "ERROR"
+        assert sev[("node", "node removed")] == "INFO"
+        # Severity filter: INFO-level registration drops at WARNING floor.
+        warn_up = list_cluster_events(limit=200, severity="WARNING")
+        assert all(e["severity"] in ("WARNING", "ERROR", "FATAL") for e in warn_up)
+        # Durable file: JSONL lines parse and carry the schema.
+        path = f"{rt_.log_dir}/events.jsonl"
+        lines = [_json.loads(l) for l in open(path)]
+        assert any(l["message"] == "node died" for l in lines)
+        assert all({"timestamp", "severity", "source", "message"} <= set(l) for l in lines)
+        # Dashboard endpoint with filters.
+        dash = start_dashboard()
+        try:
+            out = _json.loads(
+                urllib.request.urlopen(
+                    f"{dash.url}/api/events?severity=WARNING&source=worker",
+                    timeout=10,
+                ).read()
+            )
+            assert out and all(e["source"] == "worker" for e in out)
+        finally:
+            stop_dashboard()
+    finally:
+        ray_tpu.shutdown()
