@@ -346,6 +346,7 @@ class Runtime:
             NodeInfo(self.head_node_id, dict(res), dict(res), is_head=True)
         )
         self.scheduler = Scheduler(self.state, self.head_node_id)
+        self.scheduler.locality_fn = self._deps_locality
 
         self.workers: Dict[str, WorkerHandle] = {}
         self.idle_pool: Dict[Tuple[str, Any], List[str]] = {}  # (node, env_key) -> worker_ids
@@ -445,6 +446,8 @@ class Runtime:
         self._env_failures: Dict[str, str] = {}
         # planned node removals: their daemon EOF is routine, not failure
         self._expected_node_removals: "Set[str]" = set()
+        # workers on nodes being removed: their EOFs are routine stops
+        self._expected_worker_stops: "Set[str]" = set()
         # Attached driver clients (head-split mode, head.py): did -> conn,
         # plus the pseudo-node each non-co-located driver reads objects as,
         # and per-driver ref borrows dropped on driver death
@@ -2203,6 +2206,18 @@ class Runtime:
         )
 
     @_locked
+    def _deps_locality(self, deps) -> Dict[str, int]:
+        """{node_id: count of dep objects whose bytes are local there} —
+        feeds the scheduler's locality preference (dispatch path; called
+        under self.lock via _dispatch)."""
+        counts: Dict[str, int] = {}
+        for d in deps:
+            for n in self.object_locations.get(d, ()):
+                counts[n] = counts.get(n, 0) + 1
+            if self.store.has_local(d):
+                counts[self.head_node_id] = counts.get(self.head_node_id, 0) + 1
+        return counts
+
     def _fail_task_record(
         self, rec: TaskRecord, wid: Optional[str], err: Exception,
         record_end: bool = True,
@@ -2241,12 +2256,21 @@ class Runtime:
         h = self.workers.pop(wid, None)
         if h is None or h.state == "dead":
             return  # duplicate notification (daemon report + conn EOF)
-        self.metrics["worker_crashes"] += 1
-        self.events.emit(
-            "WARNING", "worker", "worker died",
-            worker_id=wid, node_id=h.node_id,
-            cause="oom_kill" if oom else ("env_setup" if env_fail else "crash"),
-        )
+        if wid in self._expected_worker_stops:
+            self._expected_worker_stops.discard(wid)
+            self.events.emit(
+                "INFO", "worker", "worker stopped",
+                worker_id=wid, node_id=h.node_id, cause="node_removed",
+            )
+        else:
+            self.metrics["worker_crashes"] += 1
+            self.events.emit(
+                "WARNING", "worker", "worker died",
+                worker_id=wid, node_id=h.node_id,
+                cause="oom_kill" if oom else (
+                    "env_setup" if env_fail else "crash"
+                ),
+            )
         h.state = "dead"
         pool = self.idle_pool.get((h.node_id, h.env_key))
         if pool and wid in pool:
@@ -2596,10 +2620,17 @@ class Runtime:
     def remove_node(self, node_id: str) -> None:
         with self.lock:
             # Planned removal (autoscaler downscale / Cluster API): the
-            # ensuing daemon EOF must log as routine, not as a failure.
+            # ensuing daemon/worker EOFs must log as routine, not failures.
             self._expected_node_removals.add(node_id)
             self.state.remove_node(node_id)
             victims = [h for h in self.workers.values() if h.node_id == node_id]
+            self._expected_worker_stops.update(h.worker_id for h in victims)
+            if node_id not in self.node_daemons:
+                # In-process node (no daemon conn whose EOF would emit the
+                # event later) — record the removal now and don't leak the
+                # expectation entry.
+                self._expected_node_removals.discard(node_id)
+                self.events.emit("INFO", "node", "node removed", node_id=node_id)
             self._daemon_send(node_id, ("shutdown",))
             self.node_daemons.pop(node_id, None)
         for h in victims:

@@ -51,6 +51,11 @@ class Scheduler:
         # resolved once: the knob is fixed by the time the runtime builds
         # its scheduler, and select_node is the dispatch hot path
         self._spread_threshold = config.get("scheduler_spread_threshold")
+        # Set by the Runtime: deps -> {node_id: local-dep count} for
+        # locality-aware placement (ray: locality_aware_leasing — the
+        # lease policy prefers the node already holding the task's
+        # arguments so big deps don't cross the wire).
+        self.locality_fn = None
 
     # -- resource accounting -------------------------------------------------
 
@@ -103,7 +108,7 @@ class Scheduler:
 
         if strategy == "SPREAD":
             return self._spread(resources)
-        return self._hybrid(resources)
+        return self._hybrid(resources, deps=spec.deps)
 
     def _alive_feasible(self, resources) -> List[NodeInfo]:
         nodes = [n for n in self.state.alive_nodes() if _feasible(n, resources)]
@@ -114,9 +119,31 @@ class Scheduler:
             )
         return nodes
 
-    def _hybrid(self, resources) -> Optional[str]:
+    def _hybrid(self, resources, deps=()) -> Optional[str]:
         with self.lock:
             nodes = self._alive_feasible(resources)
+            # Locality first (ray: locality-aware leasing): among nodes
+            # with capacity, one already holding this task's argument
+            # objects beats the default head preference — re-reading a
+            # local object is free, a cross-node pull is not.
+            if deps and self.locality_fn is not None:
+                counts = self.locality_fn(deps)
+                if counts:
+                    # Below-threshold guard: a node already busy past the
+                    # spill point loses its locality pull — otherwise a
+                    # fan-out sharing one driver-put ref would pile onto
+                    # the head forever instead of spreading.
+                    local = [
+                        n for n in nodes
+                        if counts.get(n.node_id)
+                        and _available(n, resources)
+                        and _utilization(n) < self._spread_threshold
+                    ]
+                    if local:
+                        return min(
+                            local,
+                            key=lambda n: (-counts[n.node_id], _utilization(n)),
+                        ).node_id
             # Prefer head node while below threshold, like ray's hybrid policy
             # prefers the local node (hybrid_scheduling_policy.h:50).
             head = next((n for n in nodes if n.node_id == self.head_node_id), None)

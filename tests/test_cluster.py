@@ -208,3 +208,39 @@ def test_mesh_pg_unlabeled_nodes_single_host_ok(ray_start_cluster):
     multi = placement_group([{"CPU": 1}] * 7, strategy="MESH")
     assert not multi.wait(timeout_seconds=2)
     remove_placement_group(multi)
+
+
+def test_locality_aware_scheduling(ray_start_regular):
+    """A dependent task prefers the node already holding its (large)
+    argument object (ray: locality-aware leasing) — instead of defaulting
+    to the head and pulling the bytes across the transfer plane."""
+    import numpy as np
+
+    from ray_tpu._private.runtime import get_runtime
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    rt = get_runtime()
+    nid = rt.add_daemon_node(num_cpus=2)
+
+    @ray_tpu.remote
+    def produce():
+        return np.zeros(2_000_000, dtype=np.uint8)  # 2MB: stays in shm
+
+    @ray_tpu.remote
+    def consume(x):
+        import os
+
+        return (x.nbytes, os.environ.get("RAY_TPU_NODE_ID", "head"))
+
+    # Produce ON the daemon node so the bytes live in ITS store.
+    ref = produce.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(nid)
+    ).remote()
+    ray_tpu.wait([ref], num_returns=1, timeout=60)
+
+    # DEFAULT-strategy consumer must follow the data (head has free CPUs
+    # and would otherwise win the hybrid head-preference).
+    nbytes, where = ray_tpu.get(consume.remote(ref), timeout=60)
+    assert nbytes == 2_000_000
+    assert where == nid, f"consumer ran on {where}, data lives on {nid}"
+    rt.remove_node(nid)
