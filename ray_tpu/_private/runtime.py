@@ -2218,6 +2218,7 @@ class Runtime:
                 counts[self.head_node_id] = counts.get(self.head_node_id, 0) + 1
         return counts
 
+    @_locked
     def _fail_task_record(
         self, rec: TaskRecord, wid: Optional[str], err: Exception,
         record_end: bool = True,

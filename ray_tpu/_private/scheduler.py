@@ -98,12 +98,12 @@ class Scheduler:
                 node = self.state.nodes.get(strategy.node_id)
                 if node is None or not node.alive:
                     if strategy.soft:
-                        return self._hybrid(resources)
+                        return self._hybrid(resources, deps=spec.deps)
                     raise ValueError(f"affinity node {strategy.node_id} is dead")
                 if _available(node, resources):
                     return node.node_id
                 if strategy.soft:
-                    return self._hybrid(resources)
+                    return self._hybrid(resources, deps=spec.deps)
                 return None
 
         if strategy == "SPREAD":

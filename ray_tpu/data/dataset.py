@@ -442,6 +442,42 @@ class Dataset:
     def to_pandas(self):
         return BlockAccessor(self.take_all()).to_batch("pandas")
 
+    def iter_torch_batches(
+        self,
+        *,
+        batch_size: int = 256,
+        drop_last: bool = False,
+        prefetch_blocks: int = 4,
+        dtypes=None,
+        device: Optional[str] = None,
+    ) -> Iterator[Any]:
+        """Batches as torch tensors (ray: dataset.py:3080 to_torch /
+        iter_torch_batches) — same streaming window as iter_batches, with
+        the numpy->tensor conversion zero-copy where dtypes allow."""
+        import torch
+
+        for batch in self.iter_batches(
+            batch_size=batch_size,
+            batch_format="numpy",
+            drop_last=drop_last,
+            prefetch_blocks=prefetch_blocks,
+        ):
+            def conv(arr, col=None):
+                t = torch.as_tensor(arr)
+                # dtypes: one dtype for every column, or a per-column dict
+                # (both forms of the referenced Ray API).
+                dt = dtypes.get(col) if isinstance(dtypes, dict) else dtypes
+                if dt is not None:
+                    t = t.to(dt)
+                if device is not None:
+                    t = t.to(device)
+                return t
+
+            if isinstance(batch, dict):
+                yield {k: conv(v, k) for k, v in batch.items()}
+            else:
+                yield conv(batch)
+
     def stats(self) -> str:
         return self.__repr__()
 
