@@ -251,9 +251,13 @@ def test_streaming_overlaps_production_with_consumption(rt):
     list(it)
     total = _t.monotonic() - t0
     # With 4 CPUs and window 3 the first batch cannot be gated on all 8
-    # slow blocks (which serially would be ~2s).
-    assert first_latency < total, "no overlap: first batch waited for everything"
-    assert first_latency < 1.5, f"first batch took {first_latency:.2f}s"
+    # slow blocks (which serially would be ~2s).  Margins are load-tolerant:
+    # the absolute 1.5s bound flaked when the full suite saturated the
+    # 1-vCPU CI host — the OVERLAP property is the relative gap.
+    assert first_latency < total - 0.2, (
+        f"no overlap: first batch at {first_latency:.2f}s of {total:.2f}s"
+    )
+    assert first_latency < 3.0, f"first batch took {first_latency:.2f}s"
 
 
 def test_take_executes_few_blocks(rt):
