@@ -405,43 +405,3 @@ def test_structured_cluster_events():
             stop_dashboard()
     finally:
         ray_tpu.shutdown()
-
-
-def test_multiprocessing_pool_shim(rt):
-    """Drop-in Pool over the task runtime (ray: util/multiprocessing):
-    map/starmap/apply/imap semantics match the stdlib contract."""
-    from ray_tpu.util.multiprocessing import Pool
-
-    def sq(x):
-        return x * x
-
-    with Pool(processes=4) as pool:
-        assert pool.map(sq, range(10)) == [x * x for x in range(10)]
-        assert pool.apply(sq, (7,)) == 49
-        ar = pool.apply_async(sq, (8,))
-        assert ar.get(timeout=30) == 64 and ar.ready() and ar.successful()
-        assert pool.starmap(lambda a, b: a + b, [(1, 2), (3, 4)]) == [3, 7]
-        assert list(pool.imap(sq, range(6), chunksize=2)) == [0, 1, 4, 9, 16, 25]
-        assert sorted(pool.imap_unordered(sq, range(6), chunksize=2)) == [
-            0, 1, 4, 9, 16, 25,
-        ]
-    with pytest.raises(ValueError):
-        pool.map(sq, [1])  # closed
-
-
-def test_dataset_iter_torch_batches(rt):
-    import numpy as np
-
-    from ray_tpu import data as rdata
-
-    ds = rdata.from_items([{"x": float(i), "y": i} for i in range(20)])
-    batches = list(ds.iter_torch_batches(batch_size=8))
-    import torch
-
-    assert all(isinstance(b["x"], torch.Tensor) for b in batches)
-    assert [len(b["y"]) for b in batches] == [8, 8, 4]
-    assert float(batches[0]["x"][3]) == 3.0
-    # Per-column dtypes dict (the Ray API form).
-    b = next(iter(ds.iter_torch_batches(
-        batch_size=4, dtypes={"x": torch.float32, "y": torch.int64})))
-    assert b["x"].dtype == torch.float32 and b["y"].dtype == torch.int64
