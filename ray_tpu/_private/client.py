@@ -79,6 +79,7 @@ class CoreClient:
         self._stamp_parent(spec)
         wr = self._wr()
         if wr is not None:
+            wr.note_escaped(spec.contained_refs)
             return_ids = wr.request("submit", spec)
         else:
             return_ids = self._rt().submit_task(spec)
@@ -88,6 +89,7 @@ class CoreClient:
         self._stamp_parent(spec)
         wr = self._wr()
         if wr is not None:
+            wr.note_escaped(spec.contained_refs)
             return wr.request("create_actor", spec)
         return self._rt().create_actor(spec)
 
@@ -95,6 +97,15 @@ class CoreClient:
         self._stamp_parent(spec)
         wr = self._wr()
         if wr is not None:
+            # Hot path: push straight to the actor's worker when eligible
+            # (ray: direct_actor_task_submitter.h:67) — zero head messages.
+            wr.note_escaped(spec.contained_refs)
+            if wr.direct is not None:
+                return_ids = wr.direct.submit(spec)
+                if return_ids is not None:
+                    # _count=False: the transport pre-counted these refs at
+                    # submit (see DirectTransport.submit).
+                    return [ObjectRef(oid, _count=False) for oid in return_ids]
             return_ids = wr.request("actor_call", spec)
         else:
             return_ids = self._rt().submit_actor_task(spec)
@@ -144,6 +155,11 @@ class CoreClient:
         # damage of a lost reply: the next chunk re-asks instead of hanging.
         deadline = None if timeout is None else time.monotonic() + timeout
         oids = [r.id for r in refs]
+        # Locally-owned direct results aren't visible to the owner until
+        # promoted: promote any involved in a wait so one head-side wait
+        # covers the whole list (wait is not the per-call hot path).
+        wr.note_escaped([oid for oid in oids if wr.direct is not None
+                         and wr.direct.owns(oid)])
         flags = [False] * len(refs)
         while True:
             remaining = None if deadline is None else deadline - time.monotonic()
@@ -167,6 +183,13 @@ class CoreClient:
     def cancel(self, ref: ObjectRef, force: bool = False) -> None:
         wr = self._wr()
         if wr is not None:
+            # Direct calls are tracked caller-side only: cancel rides the
+            # peer socket with queued-drop semantics.  force=True is
+            # deliberately NOT escalated here — the reference likewise
+            # rejects force-cancellation of actor tasks (the interruption
+            # primitive for a stuck actor is kill, not cancel).
+            if wr.direct is not None and wr.direct.cancel(ref.id):
+                return
             wr.request("cancel", (ref.id, force))
         else:
             self._rt().cancel(ref, force)

@@ -29,6 +29,12 @@ import threading
 from typing import Dict
 
 
+def _peer_host() -> str:
+    from ray_tpu._private import config as _config
+
+    return _config.get("node_ip")
+
+
 def _build_worker_env(
     wid: str, host: str, port: int, authkey_hex: str, session: str, renv,
     store_dir: str, node_id: str,
@@ -54,6 +60,10 @@ def _build_worker_env(
             # Node identity rides the worker's "ready" handshake so a
             # restarted head can adopt the worker back onto this node.
             "RAY_TPU_NODE_ID": node_id,
+            # Peer-transport advertise host: this NODE's address (the
+            # worker's direct-call listener must be reachable from other
+            # nodes' workers), not the head's.
+            "RAY_TPU_PEER_HOST": _peer_host(),
             **worker_env_entries(renv),
         }
     )

@@ -129,29 +129,46 @@ def bench_actor_calls_async(n: int = 3000, window: int = 200) -> Dict:
     return out
 
 
-def bench_n_n_actor_calls_async(n_actors: int = 4, n_per: int = 1000) -> Dict:
-    actors = [_Actor.remote() for _ in range(n_actors)]
-    ray_tpu.get([a.noop.remote() for a in actors], timeout=60)
+@ray_tpu.remote
+class _Client:
+    """Driving client hosted in a worker process — the reference's
+    multi-client microbenchmarks also fan out from worker-side clients, so
+    each client's calls ride its own core-worker transport (here: the
+    direct peer path, zero head messages per call)."""
 
-    def client(a):
+    def run_actor_calls(self, handle, n, window):
         refs = []
-        for _ in range(n_per):
-            refs.append(a.noop.remote())
-            if len(refs) >= 100:
+        for _ in range(n):
+            refs.append(handle.noop.remote())
+            if len(refs) >= window:
                 ray_tpu.get(refs, timeout=120)
                 refs = []
         if refs:
             ray_tpu.get(refs, timeout=120)
+        return n
+
+
+def bench_n_n_actor_calls_async(n_actors: int = 4, n_per: int = 1000) -> Dict:
+    actors = [_Actor.remote() for _ in range(n_actors)]
+    clients = [_Client.remote() for _ in range(n_actors)]
+    ray_tpu.get([a.noop.remote() for a in actors], timeout=60)
+    ray_tpu.get(
+        [c.run_actor_calls.remote(a, 1, 1) for c, a in zip(clients, actors)],
+        timeout=60,
+    )
 
     def run():
-        with ThreadPoolExecutor(n_actors) as pool:
-            futs = [pool.submit(client, a) for a in actors]
-            for f in futs:
-                f.result()
-        return n_actors * n_per
+        done = ray_tpu.get(
+            [
+                c.run_actor_calls.remote(a, n_per, 100)
+                for c, a in zip(clients, actors)
+            ],
+            timeout=300,
+        )
+        return sum(done)
 
     out = timeit("n_n_actor_calls_async", run)
-    for a in actors:
+    for a in actors + clients:
         ray_tpu.kill(a)
     return out
 

@@ -406,6 +406,19 @@ class Runtime:
             "workers_spawned": 0,
             "worker_crashes": 0,
         }
+        # Per-op counts of synchronous worker requests — the direct
+        # transport's "zero head hops on the hot path" claim is asserted
+        # against these (tests/test_direct_transport.py).
+        from collections import defaultdict
+
+        self.req_counts: Dict[str, int] = defaultdict(int)
+        # Direct transport directory: worker_id -> peer (host, port) from
+        # the ready handshake (ray: worker addresses in the GCS worker
+        # table, resolved once per caller and cached).
+        self.worker_peer_endpoints: Dict[str, Tuple[str, int]] = {}
+        # Transport-switch fences: fence_id -> (caller, req_id, wid, ep).
+        self._pending_fences: Dict[str, tuple] = {}
+        self._fence_counter = 0
 
         from multiprocessing.connection import Listener
 
@@ -1136,6 +1149,8 @@ class Runtime:
             return
         wid = first[1]
         with self.lock:
+            if len(first) > 4 and first[4]:
+                self.worker_peer_endpoints[wid] = tuple(first[4])
             h = self.workers.get(wid)
             if h is None:
                 h = self._adopt_worker(conn, first)
@@ -1477,6 +1492,57 @@ class Runtime:
                 if ar:
                     ar.expected_death = True
                     ar.no_restart = True
+        elif kind == "fence_ack":
+            with self.lock:
+                ent = self._pending_fences.pop(msg[1], None)
+            if ent is not None:
+                caller, req_id, awid, ep = ent
+                self._reply(caller, req_id, True, ("direct", awid, ep))
+        elif kind == "direct_seal":
+            # A direct call's large result, sealed in the callee's node
+            # store: enter it in the directory/accounting and hold the
+            # caller's reference (released by the caller's refop del).
+            # The executor's serialize-time guard borrows are swapped for
+            # the stored-object borrows _store_contained just took.
+            oid, size, contained = msg[1], msg[2], msg[3]
+            with self.lock:
+                self._store_contained(oid, contained)
+                for c in contained:
+                    self._decref_local(c)
+                self._record_sealed(wid, oid, size)
+                self.store.add_ref(oid)
+                self._object_ready(oid)
+        elif kind == "promote":
+            # A caller-owned inline result escaped its owner: register the
+            # bytes here so any process can resolve the ref.  Idempotent —
+            # a shm twin may already be registered via direct_seal.
+            oid, packed, contained = msg[1], msg[2], msg[3]
+            with self.lock:
+                if not self.store.is_ready(oid):
+                    self._store_contained(oid, contained)
+                    self._put_packed(oid, packed)
+                    self.store.add_ref(oid)
+                    self._object_ready(oid)
+        elif kind == "promote_error":
+            oid = msg[1]
+            with self.lock:
+                if not self.store.is_ready(oid):
+                    self.store.put_error(oid, cloudpickle.loads(msg[2]))
+                    self.store.add_ref(oid)
+                    self._object_ready(oid)
+        elif kind in ("seal_ow", "put_ow"):
+            # Fire-and-forget worker put (locally-minted id; for seal_ow the
+            # segment is already in the worker's node store, for put_ow the
+            # packed bytes ride the message).
+            oid, data, contained = msg[1], msg[2], msg[3]
+            with self.lock:
+                self.metrics["objects_put"] += 1
+                self._store_contained(oid, contained)
+                if kind == "seal_ow":
+                    self._record_sealed(wid, oid, data)
+                else:
+                    self._put_packed(oid, data)
+                self._object_ready(oid)
         elif kind == "req":
             req_id, op, payload = msg[1], msg[2], msg[3]
             try:
@@ -1501,22 +1567,13 @@ class Runtime:
                 pass  # driver died; its EOF cleanup is in flight
 
     def _handle_req(self, wid: str, req_id: int, op: str, payload: Any) -> Any:
+        self.req_counts[op] += 1
         if op == "get_object":
             return self._req_get_object(wid, req_id, payload)
-        if op == "alloc_object_id":
-            return ids.object_id()
-        if op == "seal_object":
-            oid, size, contained = payload
-            self._store_contained(oid, contained)
-            self._record_sealed(wid, oid, size)
-            self._object_ready(oid)
-            return None
-        if op == "put_object":
-            oid, packed, contained = payload
-            self._store_contained(oid, contained)
-            self._put_packed(oid, packed)
-            self._object_ready(oid)
-            return None
+        if op == "sync":
+            return None  # put-backpressure barrier (worker flushes oneways)
+        if op == "resolve_actor":
+            return self._req_resolve_actor(wid, req_id, *payload)
         if op == "get_function":
             blob = self.state.get_function(payload)
             if blob is None:
@@ -1584,6 +1641,39 @@ class Runtime:
         if op == "get_logs":
             return self.get_logs(*payload)
         raise ValueError(f"unknown op {op}")
+
+    def _req_resolve_actor(self, wid: str, req_id: int, actor_id: str,
+                           need_fence: bool):
+        """Directory lookup for the direct transport (peer.py).
+
+        Replies ("direct", worker_id, endpoint) only for actors whose
+        worker binding is immutable (max_restarts == 0) — a restartable
+        actor's calls keep the head path so the restart FSM sees them.
+        When the caller previously relayed calls (need_fence), the reply is
+        parked until a marker flushed through the actor worker's control
+        conn is acked: every relayed call is then provably in the executor
+        queue, so the caller's first direct push cannot overtake one.
+        """
+        with self.lock:
+            info = self.state.get_actor(actor_id)
+            ar = self.actors.get(actor_id)
+            if info is None or ar is None or info.state == DEAD:
+                return ("dead", None, None)
+            if (info.max_restarts or 0) != 0:
+                return ("ineligible", None, None)
+            if info.state != ALIVE or not ar.worker_id:
+                return ("pending", None, None)
+            ep = self.worker_peer_endpoints.get(ar.worker_id)
+            h = self.workers.get(ar.worker_id)
+            if ep is None or h is None or h.conn is None:
+                return ("ineligible", None, None)
+            if not need_fence:
+                return ("direct", ar.worker_id, ep)
+            self._fence_counter += 1
+            fid = f"f{self._fence_counter}"
+            self._pending_fences[fid] = (wid, req_id, ar.worker_id, ep)
+            self._send(h, ("fence", fid))
+            return _PARKED
 
     def _req_get_object(self, wid: str, req_id: int, oid: str):
         with self.lock:
@@ -2064,6 +2154,13 @@ class Runtime:
         rec = self.tasks.pop(task_id, None)
         h = self.workers.get(wid)
         if rec is None:
+            # Unknown/already-failed task (e.g. cancelled, actor queue
+            # failed): its results are dropped, so the executor's
+            # serialize-time guard borrows must still be released.
+            if error_blob is None:
+                for item in results:
+                    for c in item[3]:
+                        self._decref_local(c)
             return
         spec = rec.spec
         if error_blob is None:
@@ -2077,6 +2174,11 @@ class Runtime:
             for item in results:
                 oid, kind, data, contained = item
                 self._store_contained(oid, contained)
+                # Release the executor's serialize-time guard borrows now
+                # that the stored-object borrow above holds the children
+                # (see worker_proc._store_results).
+                for c in contained:
+                    self._decref_local(c)
                 if kind == "shm":
                     self._record_sealed(wid, oid, data)
                 else:
@@ -2254,6 +2356,13 @@ class Runtime:
         # for the head's lifetime.
         oom = self._oom_kills.pop(wid, None)
         env_fail = self._env_failures.pop(wid, None)
+        self.worker_peer_endpoints.pop(wid, None)
+        # Fences routed through this worker can never ack: fail them so the
+        # caller falls back to the head path instead of hanging.
+        for fid, ent in list(self._pending_fences.items()):
+            if ent[2] == wid:
+                self._pending_fences.pop(fid, None)
+                self._reply(ent[0], ent[1], True, ("dead", None, None))
         h = self.workers.pop(wid, None)
         if h is None or h.state == "dead":
             return  # duplicate notification (daemon report + conn EOF)
@@ -2424,6 +2533,9 @@ class Runtime:
         else:
             self.state.set_actor_state(actor_id, DEAD, death_cause="worker died")
             self._fail_actor_queue(ar, err)
+            # The released placement may unblock queued work (e.g. a new
+            # actor's creation parked on the resources this one held).
+            self._dispatch()
 
     # ------------------------------------------------------------------
     # public API surface (driver side)

@@ -319,6 +319,10 @@ class OwnerStore:
         self._in_shm: Dict[str, int] = {}  # id -> size
         self._spilled: Dict[str, str] = {}  # id -> file path
         self._refcount: Dict[str, int] = {}
+        # Releases that arrived before their object was registered (the
+        # control plane has per-connection FIFO only — see remove_ref),
+        # consumed by the matching add_ref.
+        self._early_dels: Dict[str, int] = {}
         self._available = threading.Condition()
         self._ready: Dict[str, bool] = {}
         # wait() bookkeeping: per-oid waiter tokens so a completion is O(its
@@ -352,11 +356,38 @@ class OwnerStore:
 
     def add_ref(self, object_id: str, n: int = 1) -> None:
         with self._lock:
+            early = self._early_dels.pop(object_id, 0)
+            if early:
+                # Consume buffered releases that raced ahead of this add
+                # (see remove_ref): each buffered del corresponds to
+                # exactly one add still in flight.
+                consumed = min(early, n)
+                if early - consumed:
+                    self._early_dels[object_id] = early - consumed
+                n -= consumed
+                if n <= 0:
+                    return
             self._refcount[object_id] = self._refcount.get(object_id, 0) + n
 
     def remove_ref(self, object_id: str, n: int = 1) -> bool:
-        """Returns True when the count hit zero and the object was freed."""
+        """Returns True when the count hit zero and the object was freed.
+
+        A release for an object this store has never seen is BUFFERED, not
+        applied: the control plane is per-connection FIFO but has no
+        cross-connection ordering, so a caller's balancing del (its conn)
+        can overtake the callee's registering direct_seal/promote/guard-add
+        (the callee's conn).  Applying it eagerly would let the later add
+        resurrect the count to a permanently-leaked 1.  The buffered del is
+        consumed by the matching add when it lands (add_ref)."""
         with self._lock:
+            known = (
+                object_id in self._refcount
+                or object_id in self._ready
+                or object_id in self._errors
+            )
+            if not known:
+                self._early_dels[object_id] = self._early_dels.get(object_id, 0) + n
+                return False
             c = self._refcount.get(object_id, 0) - n
             if c > 0:
                 self._refcount[object_id] = c

@@ -46,6 +46,11 @@ class WorkerRuntime:
         self.conn_lock = conn_lock
         self.worker_id = worker_id
         self.authkey = authkey
+        # Direct worker<->worker transport (peer.py): installed by
+        # worker_main after the peer server binds.  None only in tests
+        # that construct a bare WorkerRuntime.
+        self.direct = None
+        self._puts_unacked = 0
         # RAY_TPU_STORE_DIR scopes the store to THIS worker's node (set by
         # its node daemon); without it (head-node workers) the session
         # default resolves to the head store.  Objects on other nodes are
@@ -102,7 +107,35 @@ class WorkerRuntime:
 
         return ObjectRef(id, owner)  # hooks installed in worker_main count it
 
+    def borrow_ref(self, oid: str) -> None:
+        """Add one reference on behalf of an in-flight direct call's args
+        (released by unborrow_ref when the call completes)."""
+        if self.direct is not None and self.direct.addref(oid):
+            return
+        self.oneway(("refop", "add", oid))
+
+    def unborrow_ref(self, oid: str) -> None:
+        if self.direct is not None and self.direct.decref(oid):
+            return
+        self.oneway(("refop", "del", oid))
+
+    def note_escaped(self, contained) -> None:
+        """Serialize-time hook: any locally-owned direct result leaving this
+        process must become visible to the head (promotion) so remote
+        consumers can resolve it."""
+        if self.direct is None or not contained:
+            return
+        for oid in contained:
+            self.direct.mark_escaped(oid)
+
     def get_value(self, object_id: str, timeout: Optional[float] = None) -> Any:
+        # Fastest path: a result of one of OUR direct calls, cached locally.
+        if self.direct is not None:
+            if self.direct.ready_local(object_id) is not None:
+                found, val = self.direct.get_local(object_id, timeout)
+                if found:
+                    return val
+                # shm result on a remote node: resolve via the owner below.
         # Fast path: sealed segment already in this NODE's store.
         obj = self.shm.get(object_id)
         if obj is not None:
@@ -176,14 +209,27 @@ class WorkerRuntime:
             return self.shm.get(object_id)
 
     def put_value(self, value: Any) -> str:
+        """Store a value under a locally-minted id with fire-and-forget
+        sealing (the owner learns of it via a oneway riding the same FIFO
+        conn as every later message naming the id — so a submit carrying
+        the ref always lands after the seal).  A sync request every 64
+        unacked puts bounds the backlog a put-loop can build up (the
+        backpressure the old request-per-put path provided implicitly)."""
+        from ray_tpu._private import ids as _ids
+
         payload, buffers, contained = ser.serialize(value)
+        self.note_escaped(contained)
         size = len(payload) + sum(len(b.raw()) for b in buffers)
-        oid = self.request("alloc_object_id", None)
+        oid = _ids.object_id()
         if size >= inline_threshold() and not self.force_inline_puts:
             packed = self.shm.create(oid, payload, buffers)
-            self.request("seal_object", (oid, packed, contained))
+            self.oneway(("seal_ow", oid, packed, contained))
         else:
-            self.request("put_object", (oid, bytes(ser.pack(payload, buffers)), contained))
+            self.oneway(("put_ow", oid, bytes(ser.pack(payload, buffers)), contained))
+        self._puts_unacked += 1
+        if self._puts_unacked >= 64:
+            self._puts_unacked = 0
+            self.request("sync", None)
         return oid
 
     # -- function resolution -------------------------------------------------
@@ -246,16 +292,42 @@ def _store_results(rt: WorkerRuntime, spec: TaskSpec, out) -> list:
                 f"task {spec.name} declared num_returns={spec.num_returns} "
                 f"but returned {len(out)} values"
             )
-    results = []
+    # Serialize EVERY result before sending any bookkeeping: a failure on
+    # result k after result 0's guards went out would leak those borrows
+    # (the task then reports an error and no release path runs).
+    serialized = []
     for i, value in enumerate(out):
         oid = f"o:{spec.task_id}:{i}"
-        payload, buffers, contained = ser.serialize(value)
-        size = len(payload) + sum(len(b.raw()) for b in buffers)
-        if size >= inline_threshold():
-            packed = rt.shm.create(oid, payload, buffers)
-            results.append((oid, "shm", packed, contained))
-        else:
-            results.append((oid, "inline", bytes(ser.pack(payload, buffers)), contained))
+        serialized.append((oid, ser.serialize(value)))
+    results = []
+    guarded: list = []
+    try:
+        for oid, (payload, buffers, contained) in serialized:
+            rt.note_escaped(contained)  # refs we own, leaving via our result
+            # Guard borrows, sent WHILE the contained refs are still alive
+            # in this frame: the executor's own ObjectRefs die at frame
+            # teardown (their refop dels hit the conn before the done/seal
+            # messages), so without a preceding add the owner could free a
+            # contained child in the del→done window.  The owner releases
+            # the guard once its own stored-object borrow is in place
+            # (_on_task_done / direct_seal); for caller-owned inline direct
+            # results the guard IS the caller-cache borrow, released when
+            # the cache entry drops.
+            for c in contained:
+                rt.oneway(("refop", "add", c))
+                guarded.append(c)
+            size = len(payload) + sum(len(b.raw()) for b in buffers)
+            if size >= inline_threshold():
+                packed = rt.shm.create(oid, payload, buffers)
+                results.append((oid, "shm", packed, contained))
+            else:
+                results.append(
+                    (oid, "inline", bytes(ser.pack(payload, buffers)), contained)
+                )
+    except BaseException:
+        for c in guarded:  # storage failed: balance the sent guards
+            rt.oneway(("refop", "del", c))
+        raise
     return results
 
 
@@ -396,10 +468,9 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
     # task's own completion message so no use-after-free races).
     from ray_tpu._private import refs as refs_mod
 
-    refs_mod.set_ref_hooks(
-        lambda oid: rt.oneway(("refop", "add", oid)),
-        lambda oid: rt.oneway(("refop", "del", oid)),
-    )
+    # Locally-owned direct-call results are counted in-process; everything
+    # else proxies to the owner as before.
+    refs_mod.set_ref_hooks(rt.borrow_ref, rt.unborrow_ref)
     # Mark this process as a worker for ray_tpu API routing.
     from ray_tpu._private import runtime as runtime_mod
 
@@ -409,8 +480,61 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
 
     task_q: "queue.Queue[tuple]" = queue.Queue()
     pool = None  # ThreadPoolExecutor for max_concurrency > 1
+    pool_lock = threading.Lock()
 
     node_id = os.environ.get("RAY_TPU_NODE_ID")
+
+    # -- direct peer transport (ray: direct_actor_task_submitter.h:67) -----
+    # The peer server's endpoint rides the "ready" handshake; peer-pushed
+    # tasks execute on the SAME queues as head-pushed ones (per-caller
+    # order = the pushing connection's FIFO), replying on the peer socket.
+    from ray_tpu._private.peer import DirectTransport, PeerServer
+
+    def route_task(msg: tuple, reply) -> None:
+        """Route one executable task to the right executor (shared by the
+        head recv loop and every peer connection)."""
+        nonlocal pool
+        spec: TaskSpec = msg[1]
+        if spec.max_concurrency > 1 and not spec.is_actor_creation:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with pool_lock:
+                if pool is None:
+                    pool = ThreadPoolExecutor(max_workers=spec.max_concurrency)
+            pool.submit(_run_and_reply, msg, reply)
+        else:
+            task_q.put((msg, reply))
+
+    peer_cancelled: set = set()
+
+    def peer_handler(msg: tuple, reply) -> None:
+        if msg[0] == "pcall":
+            route_task(("task", msg[1], None), reply)
+        elif msg[0] == "pcancel":
+            # Best-effort: queued (not yet started) calls are dropped at
+            # execution time; a running method is never interrupted.
+            # Bounded — a cancel for a running/finished task would
+            # otherwise park in the set forever (evicting an arbitrary
+            # stale entry only downgrades that cancel to a no-op).
+            if len(peer_cancelled) >= 4096:
+                peer_cancelled.pop()
+            peer_cancelled.add(msg[1])
+
+    advertise = os.environ.get("RAY_TPU_PEER_HOST") or (
+        address[0] if isinstance(address, tuple) else "127.0.0.1"
+    )
+    if advertise in ("0.0.0.0", "::", ""):
+        # The head listener may bind a wildcard (RAY_TPU_BIND_HOST=0.0.0.0)
+        # — unroutable as an advertised address (a remote peer would dial
+        # its OWN loopback); fall back to this node's routable IP knob.
+        advertise = _cfg.get("node_ip")
+    bind = "127.0.0.1" if advertise in ("127.0.0.1", "localhost") else "0.0.0.0"
+    try:
+        peer_server = PeerServer(authkey, bind, advertise, peer_handler)
+        peer_endpoint = peer_server.endpoint
+    except OSError:
+        peer_server, peer_endpoint = None, None  # no direct path; head relays
+    rt.direct = DirectTransport(rt)
 
     def try_reconnect() -> bool:
         """Head conn lost: in head-split mode (reconnect window > 0) retry
@@ -445,7 +569,9 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
                 pass
             rt.conn = newconn
             try:
-                rt.conn.send(("ready", worker_id, os.getpid(), node_id))
+                rt.conn.send(
+                    ("ready", worker_id, os.getpid(), node_id, peer_endpoint)
+                )
             except OSError:
                 return False  # head bounced again; outer loop re-enters
         # In-flight request replies died with the old conn: fail them so
@@ -458,7 +584,6 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
         return True
 
     def recv_loop():
-        nonlocal pool
         while True:
             try:
                 msg = rt.conn.recv()
@@ -470,22 +595,31 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
             if kind == "reply":
                 rt._on_reply(msg[1], msg[2], msg[3])
             elif kind in ("task", "create_actor"):
-                spec: TaskSpec = msg[1]
-                if spec.max_concurrency > 1 and not spec.is_actor_creation:
-                    from concurrent.futures import ThreadPoolExecutor
-
-                    if pool is None:
-                        pool = ThreadPoolExecutor(max_workers=spec.max_concurrency)
-                    pool.submit(_run_and_reply, msg)
-                else:
-                    task_q.put(msg)
+                route_task(msg, None)
+            elif kind == "fence":
+                # Transport-switch barrier: acking from the recv thread
+                # certifies every earlier task on this conn is already in
+                # the executor queue — a direct call sent after the ack
+                # cannot overtake a relayed one (see peer.py docstring).
+                rt.oneway(("fence_ack", msg[1]))
             elif kind == "kill":
                 os._exit(0)
             elif kind == "shutdown":
-                task_q.put(("__shutdown__",))
+                task_q.put((("__shutdown__",), None))
 
-    def _run_and_reply(msg):
+    def _run_and_reply(msg, reply=None):
         spec, blob = msg[1], msg[2]
+        if reply is not None and spec.task_id in peer_cancelled:
+            peer_cancelled.discard(spec.task_id)
+            import cloudpickle
+
+            from ray_tpu.exceptions import TaskCancelledError
+
+            reply.send(
+                ("pdone", spec.task_id, [],
+                 cloudpickle.dumps(TaskCancelledError(spec.name)))
+            )
+            return
         try:
             done = _execute(rt, spec, blob)
         except SystemExit:
@@ -494,11 +628,27 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
             # leaving the caller hanging — exit the process here (the
             # actor_exit oneway was already sent by exit_actor()).
             os._exit(0)
-        try:
-            with conn_lock:
-                rt.conn.send(done)
-        except OSError:
-            pass  # head restarting: this result is lost; recv_loop reconnects
+        if reply is None:
+            try:
+                with conn_lock:
+                    rt.conn.send(done)
+            except OSError:
+                pass  # head restarting: this result is lost; recv_loop reconnects
+            return
+        # Direct-call completion: registration oneways go to the head first
+        # (FIFO behind the guard borrows _store_results already sent), then
+        # the caller unblocks via the peer socket.  Inline results send
+        # nothing — they are caller-owned, and the serialize-time guard
+        # doubles as the caller-cache borrow.
+        _task_id, results, err_blob = done[1], done[2], done[3]
+        for item in results:
+            oid, kind, data, contained = item
+            if kind == "shm":
+                # Register the sealed copy with the directory so remote
+                # consumers (and capacity accounting) can find it; the head
+                # swaps the guard borrows for its stored-object borrows.
+                rt.oneway(("direct_seal", oid, data, contained))
+        reply.send(("pdone", _task_id, results, err_blob))
 
     threading.Thread(target=recv_loop, daemon=True, name="worker-recv").start()
 
@@ -536,13 +686,13 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
             sys.exit(1)
 
     with conn_lock:
-        conn.send(("ready", worker_id, os.getpid(), node_id))
+        conn.send(("ready", worker_id, os.getpid(), node_id, peer_endpoint))
 
     while True:
-        msg = task_q.get()
+        msg, reply = task_q.get()
         if msg[0] == "__shutdown__":
             break
-        _run_and_reply(msg)
+        _run_and_reply(msg, reply)
     sys.exit(0)
 
 

@@ -1,0 +1,280 @@
+"""Direct worker-to-worker transport tests (peer.py).
+
+The reference's actor-call hot path never touches the control plane
+(ray: src/ray/core_worker/transport/direct_actor_task_submitter.h:67);
+these tests prove ours doesn't either — the head's per-op request counters
+must stay flat while a worker drives calls at an actor — and that the
+ownership bookkeeping (caller-owned results, promotion on escape, borrow
+balancing) stays correct across every result shape.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class Echo:
+    def __init__(self):
+        self.n = 0
+
+    def bump(self, k=1):
+        self.n += k
+        return self.n
+
+    def big(self, k):
+        # >> inline threshold: lands in the callee's node store (shm path).
+        return np.full((1 << 16,), k, dtype=np.int64)
+
+    def boom(self):
+        raise ValueError("bad call")
+
+    def make_ref(self):
+        return ray_tpu.put("held")
+
+
+def _counts():
+    from ray_tpu._private.runtime import get_runtime
+
+    return get_runtime().req_counts
+
+
+def test_worker_actor_calls_skip_head(ray_start_regular):
+    """A worker driving N calls at an actor costs the head ZERO actor_call
+    requests and at most one resolve (the VERDICT item-1 'done' check)."""
+    a = Echo.remote()
+    assert ray_tpu.get(a.bump.remote()) == 1  # actor alive before the worker runs
+
+    @ray_tpu.remote
+    def driver_task(h, n):
+        out = [ray_tpu.get(h.bump.remote()) for _ in range(n)]
+        return out
+
+    before_calls = _counts().get("actor_call", 0)
+    before_gets = _counts().get("get_object", 0)
+    out = ray_tpu.get(driver_task.remote(a, 40))
+    assert out == list(range(2, 42))
+    assert _counts().get("actor_call", 0) == before_calls, (
+        "direct path must not relay actor calls through the head"
+    )
+    # Result reads came from the caller-local cache, not head get_object
+    # round-trips (a couple of unrelated gets — arg resolution — are fine).
+    assert _counts().get("get_object", 0) - before_gets <= 2
+
+
+def test_direct_results_ordering_and_values(ray_start_regular):
+    a = Echo.remote()
+    ray_tpu.get(a.bump.remote(0))
+
+    @ray_tpu.remote
+    def burst(h, n):
+        refs = [h.bump.remote() for _ in range(n)]
+        return ray_tpu.get(refs)
+
+    assert ray_tpu.get(burst.remote(a, 25)) == list(range(1, 26))
+
+
+def test_direct_large_result_shm(ray_start_regular):
+    a = Echo.remote()
+    ray_tpu.get(a.bump.remote(0))
+
+    @ray_tpu.remote
+    def fetch_big(h):
+        arr = ray_tpu.get(h.big.remote(7))
+        return int(arr.sum()), arr.shape[0]
+
+    s, n = ray_tpu.get(fetch_big.remote(a))
+    assert (s, n) == (7 * (1 << 16), 1 << 16)
+
+
+def test_direct_error_propagates(ray_start_regular):
+    a = Echo.remote()
+    ray_tpu.get(a.bump.remote(0))
+
+    @ray_tpu.remote
+    def poke(h):
+        try:
+            ray_tpu.get(h.boom.remote())
+        except ray_tpu.exceptions.TaskError as e:
+            return "caught:" + type(e).__name__
+        return "no error"
+
+    assert ray_tpu.get(poke.remote(a)).startswith("caught:")
+
+
+def test_direct_result_escapes_to_driver(ray_start_regular):
+    """A caller-owned direct result returned to the driver must promote so
+    the driver (a different process) can resolve the ref."""
+    a = Echo.remote()
+    ray_tpu.get(a.bump.remote(0))
+
+    @ray_tpu.remote
+    def handoff(h):
+        return h.bump.remote(5)  # the REF escapes via our result
+
+    inner = ray_tpu.get(handoff.remote(a))
+    assert ray_tpu.get(inner) == 5
+
+
+def test_direct_result_chained_to_second_actor(ray_start_regular):
+    """An owned ref passed as an arg to ANOTHER actor's direct call:
+    promotion + head-side dependency resolution on the callee."""
+    a = Echo.remote()
+    b = Echo.remote()
+    ray_tpu.get([a.bump.remote(0), b.bump.remote(0)])
+
+    @ray_tpu.remote
+    def chain(h1, h2):
+        r1 = h1.bump.remote(3)  # owned, possibly still in flight
+        r2 = h2.bump.remote(ray_tpu.get(r1))
+        return ray_tpu.get(r2)
+
+    assert ray_tpu.get(chain.remote(a, b)) == 3
+
+
+def test_direct_contained_ref_in_result(ray_start_regular):
+    """Result VALUE contains an ObjectRef: the borrow chain must keep the
+    inner object alive until the outer ref is consumed."""
+    a = Echo.remote()
+    ray_tpu.get(a.bump.remote(0))
+
+    @ray_tpu.remote
+    def indirect(h):
+        inner_ref = ray_tpu.get(h.make_ref.remote())  # value IS a ref
+        return ray_tpu.get(inner_ref)
+
+    assert ray_tpu.get(indirect.remote(a)) == "held"
+
+
+def test_direct_actor_death_fails_inflight(ray_start_regular):
+    @ray_tpu.remote
+    class Mortal:
+        def ok(self):
+            return 1
+
+        def die(self):
+            import os
+
+            os._exit(1)
+
+    m = Mortal.remote()
+    ray_tpu.get(m.ok.remote())
+
+    @ray_tpu.remote
+    def prod(h):
+        h.die.remote()
+        try:
+            ray_tpu.get(h.ok.remote(), timeout=10)
+        except ray_tpu.exceptions.ActorDiedError:
+            return "died"
+        except ray_tpu.exceptions.GetTimeoutError:
+            return "hung"
+        return "alive?"
+
+    assert ray_tpu.get(prod.remote(m)) == "died"
+
+
+def test_restartable_actor_keeps_head_path(ray_start_regular):
+    """max_restarts != 0 means the binding can move: calls must relay so the
+    restart FSM sees them (direct would pin a dead endpoint)."""
+    a = Echo.options(max_restarts=2).remote()
+    ray_tpu.get(a.bump.remote(0))
+    before = _counts().get("actor_call", 0)
+
+    @ray_tpu.remote
+    def drive(h):
+        return [ray_tpu.get(h.bump.remote()) for _ in range(3)]
+
+    assert ray_tpu.get(drive.remote(a)) == [1, 2, 3]
+    assert _counts().get("actor_call", 0) == before + 3
+
+
+def test_fence_on_pending_to_direct_switch(ray_start_regular):
+    """First calls land while the actor is still creating (relayed); later
+    calls switch to direct behind the fence — order must hold across the
+    switch."""
+
+    @ray_tpu.remote
+    class Slow:
+        def __init__(self):
+            time.sleep(1.0)
+            self.log = []
+
+        def add(self, i):
+            self.log.append(i)
+            return list(self.log)
+
+    @ray_tpu.remote
+    def run(h):
+        refs = [h.add.remote(i) for i in range(6)]  # first few: pending relay
+        time.sleep(1.5)  # actor comes alive; later calls re-resolve direct
+        refs += [h.add.remote(i) for i in range(6, 12)]
+        return ray_tpu.get(refs[-1])
+
+    s = Slow.remote()
+    assert ray_tpu.get(run.remote(s)) == list(range(12))
+
+
+def test_async_actor_direct(ray_start_regular):
+    @ray_tpu.remote
+    class Async:
+        async def work(self, x):
+            import asyncio
+
+            await asyncio.sleep(0.01)
+            return x * 2
+
+    a = Async.remote()
+    ray_tpu.get(a.work.remote(1))
+
+    @ray_tpu.remote
+    def fan(h):
+        return sorted(ray_tpu.get([h.work.remote(i) for i in range(8)]))
+
+    assert ray_tpu.get(fan.remote(a)) == [0, 2, 4, 6, 8, 10, 12, 14]
+
+
+def test_direct_cancel_queued_call(ray_start_regular):
+    """Cancel of a queued direct call drops it with TaskCancelledError;
+    the running method is not interrupted (reference actor-cancel
+    semantics)."""
+
+    @ray_tpu.remote
+    class Slow:
+        def work(self, t):
+            time.sleep(t)
+            return t
+
+    s = Slow.remote()
+    ray_tpu.get(s.work.remote(0))
+
+    @ray_tpu.remote
+    def drive(h):
+        first = h.work.remote(1.5)  # occupies the actor
+        queued = h.work.remote(0)   # sits in the executor queue
+        ray_tpu.cancel(queued)
+        try:
+            ray_tpu.get(queued, timeout=20)
+        except ray_tpu.exceptions.TaskCancelledError:
+            pass
+        else:
+            return "not cancelled"
+        return ray_tpu.get(first, timeout=20)  # running call unaffected
+
+    assert ray_tpu.get(drive.remote(s)) == 1.5
+
+
+def test_direct_calls_between_two_worker_callers(ray_start_regular):
+    """Two independent caller workers hammer one actor concurrently."""
+    a = Echo.remote()
+    ray_tpu.get(a.bump.remote(0))
+
+    @ray_tpu.remote
+    def drive(h, n):
+        return [ray_tpu.get(h.bump.remote()) for _ in range(n)]
+
+    r1, r2 = ray_tpu.get([drive.remote(a, 30), drive.remote(a, 30)])
+    assert sorted(r1 + r2) == list(range(1, 61))
