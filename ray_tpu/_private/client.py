@@ -80,6 +80,13 @@ class CoreClient:
         wr = self._wr()
         if wr is not None:
             wr.note_escaped(spec.contained_refs)
+            # Nested submissions push straight to a head-leased worker when
+            # the task shape allows it (ray: direct_task_transport.h:75);
+            # a denied/ineligible lease falls back to the queued head path.
+            if wr.direct is not None:
+                return_ids = wr.direct.submit_plain(spec)
+                if return_ids is not None:
+                    return [ObjectRef(oid, _count=False) for oid in return_ids]
             return_ids = wr.request("submit", spec)
         else:
             return_ids = self._rt().submit_task(spec)

@@ -72,16 +72,31 @@ class PeerServer:
 
         while not self._shutdown:
             try:
-                conn = self.listener.accept()
+                conn = self._accept_one()
             except (OSError, EOFError):
                 if self._shutdown:
                     return
+                continue
+            if conn is None:
                 continue
             set_nodelay(conn)
             threading.Thread(
                 target=self._serve_conn, args=(conn,), daemon=True,
                 name="raytpu-peer-conn",
             ).start()
+
+    def _accept_one(self):
+        """One accept, hardened: Listener.accept runs the authkey HMAC
+        challenge inline, so a stray connection (port scanner, wrong-key
+        peer) raises AuthenticationError — which must not kill the accept
+        loop (that would silently disable this worker's direct transport
+        for the rest of its life)."""
+        try:
+            return self.listener.accept()
+        except (OSError, EOFError):
+            raise
+        except Exception:
+            return None  # bad handshake from a stranger: keep serving
 
     def _serve_conn(self, conn) -> None:
         reply = PeerReply(conn)
@@ -209,12 +224,46 @@ class DirectResult:
         self.promoted = False
 
 
-class DirectTransport:
-    """Caller-side state machine for direct actor calls (one per worker).
+class Lease:
+    """One head-granted worker lease (ray: direct_task_transport.h:75 —
+    lease pooling keyed by SchedulingKey, reused across same-shape tasks)."""
 
-    Resolution cache is sticky: "direct" (endpoint) and "head" (relay) are
-    both terminal per actor — mixing transports per (caller, actor) would
-    break per-caller call order.
+    __slots__ = ("lease_id", "worker_id", "conn", "inflight", "last_used")
+
+    def __init__(self, lease_id: str, worker_id: str, conn: PeerConn):
+        import time as _time
+
+        self.lease_id = lease_id
+        self.worker_id = worker_id
+        self.conn = conn
+        self.inflight = 0
+        # Stamped at creation: a zero would read as idle-since-forever and
+        # let the maintenance tick return a just-granted lease.
+        self.last_used = _time.monotonic()
+
+
+# How many unacked tasks one lease pipelines before another worker is
+# leased, how many workers one key may hold, and how long an idle lease is
+# kept before being returned to the head's pool.
+_LEASE_PIPELINE = 4
+_LEASE_MAX_PER_KEY = 8
+_LEASE_IDLE_RETURN_S = 2.0
+
+
+class DirectTransport:
+    """Caller-side state machine for direct calls (one per worker).
+
+    Actor calls: resolution cache is sticky — "direct" (endpoint) and
+    "head" (relay) are both terminal per actor, since mixing transports per
+    (caller, actor) would break per-caller call order.
+
+    Plain tasks: the head grants reusable worker LEASES per scheduling key
+    (resource shape); tasks push directly to leased workers, so per-task
+    head traffic is O(1 lease per key-burst), not O(1 request per task)
+    (ray: direct_task_transport.h:75, local_task_manager.h:58 — our
+    leases still reserve through the head's scheduler, which is what makes
+    spillback and backpressure fall out: a full cluster denies the lease
+    and the task takes the queued head path).
     """
 
     def __init__(self, wr):
@@ -226,8 +275,13 @@ class DirectTransport:
         # oid -> DirectResult for every in-flight or cached direct return.
         self.results: Dict[str, DirectResult] = {}
         self.counts: Dict[str, int] = {}  # local refcounts for owned oids
-        self.inflight: Dict[str, tuple] = {}  # task_id -> (actor_id, spec, conn)
+        # task_id -> (actor_id | None, spec, conn[, lease]) — actor calls
+        # carry the actor id, leased plain tasks carry None + their lease.
+        self.inflight: Dict[str, tuple] = {}
         self.calls_sent = 0  # diagnostics
+        self.leases: Dict[Any, list] = {}  # key -> [Lease]
+        self.lease_backoff: Dict[Any, float] = {}  # key -> retry-not-before
+        self._maint_started = False
 
     # -- routing -------------------------------------------------------------
 
@@ -297,13 +351,8 @@ class DirectTransport:
 
     # -- submission ----------------------------------------------------------
 
-    def submit(self, spec) -> Optional[list]:
-        """Try the direct path; returns return_ids or None (caller relays)."""
-        if spec.max_retries > 0:
-            return None  # retried calls keep head-side bookkeeping
-        conn = self.route_for(spec.actor_id)
-        if conn is None:
-            return None
+    def _register(self, spec, conn, lease=None) -> list:
+        """Caller bookkeeping shared by actor calls and leased tasks."""
         return_ids = spec.return_ids()
         # Borrow every arg ref for the call's lifetime BEFORE the push: the
         # add must precede (same head conn, FIFO) any release the caller's
@@ -318,7 +367,17 @@ class DirectTransport:
                 # that construction, a zero count would release the entry
                 # under the caller's feet.
                 self.counts[oid] = 1
-            self.inflight[spec.task_id] = (spec.actor_id, spec, conn)
+            self.inflight[spec.task_id] = (spec.actor_id, spec, conn, lease)
+        return return_ids
+
+    def submit(self, spec) -> Optional[list]:
+        """Try the direct actor path; returns return_ids or None (relay)."""
+        if spec.max_retries > 0:
+            return None  # retried calls keep head-side bookkeeping
+        conn = self.route_for(spec.actor_id)
+        if conn is None:
+            return None
+        return_ids = self._register(spec, conn)
         if not conn.send(("pcall", spec)):
             # Connection died between resolve and push: fail like an actor
             # death (no silent re-relay — the relay could double-execute).
@@ -326,6 +385,178 @@ class DirectTransport:
             return return_ids
         self.calls_sent += 1
         return return_ids
+
+    # -- leased plain tasks --------------------------------------------------
+
+    @staticmethod
+    def _plain_eligible(spec) -> bool:
+        return (
+            spec.actor_id is None
+            and not spec.is_actor_creation
+            and spec.scheduling_strategy in (None, "DEFAULT")
+            and spec.placement_group_id is None
+            and not spec.runtime_env
+        )
+
+    @staticmethod
+    def _lease_key(spec):
+        return frozenset(spec.resources.items())
+
+    def submit_plain(self, spec) -> Optional[list]:
+        """Push a plain task to a head-leased worker; None = relay.
+
+        Tradeoffs vs the head path, by design: the task is invisible to the
+        head's task table/lineage (results are non-reconstructable, like
+        actor results), and arg-locality node scoring does not apply — the
+        win is zero per-task head requests.  Crash retries run caller-side
+        against a fresh lease (same at-least-once semantics)."""
+        if not self._plain_eligible(spec):
+            return None
+        # Deadlock guard: the head path dep-gates BEFORE occupying a
+        # worker; a direct push occupies the leased worker through arg
+        # resolution.  A task whose dep is still being produced could
+        # therefore park leased workers while the producer starves for the
+        # very resources those leases hold.  Only push when every dep is
+        # already materialized (caller-owned and landed — promoted on the
+        # escape into these args — or sealed in this node's store);
+        # anything else takes the dep-gated head path.
+        for d in spec.deps:
+            r = self.ready_local(d)
+            if r is False:
+                return None  # ours, still in flight
+            if r is None and not self.wr.shm.contains(d):
+                return None  # not locally provable: let the head gate it
+        lease = self._acquire_lease(self._lease_key(spec), spec)
+        if lease is None:
+            return None
+        return_ids = self._register(spec, lease.conn, lease)
+        if not lease.conn.send(("pcall", spec)):
+            self._fail_inflight_on(lease.conn)
+            return return_ids
+        self.calls_sent += 1
+        self._ensure_maintenance()
+        return return_ids
+
+    def _acquire_lease(self, key, spec, ignore_backoff: bool = False):
+        """Select-or-grant a lease and bump its inflight count in ONE lock
+        hold — selection and increment in separate holds would race the
+        maintenance tick, which returns idle leases to the head (a task
+        could land on a worker the head already re-pooled).
+
+        Policy: take a lease with pipeline headroom; at the per-key cap (or
+        when a grant is denied — cluster full) pipeline DEEP onto the least
+        loaded instead of splitting the burst with the head queue, which
+        convoys: the head backlog would wait on the very CPUs our leases
+        hold.  Relay (None) only when the key holds no lease at all."""
+        import time as _time
+
+        grant_allowed = True
+        with self.lock:
+            pool = [l for l in self.leases.get(key, []) if not l.conn.dead]
+            self.leases[key] = pool
+            if pool:
+                best = min(pool, key=lambda l: l.inflight)
+                if best.inflight < _LEASE_PIPELINE or len(pool) >= _LEASE_MAX_PER_KEY:
+                    best.inflight += 1
+                    best.last_used = _time.monotonic()
+                    return best
+            if not ignore_backoff and self.lease_backoff.get(key, 0) > _time.monotonic():
+                grant_allowed = False
+        if grant_allowed:
+            granted = self._grant_lease(key, spec)
+            if granted is not None:
+                return granted  # registered + incremented by _grant_lease
+        with self.lock:
+            pool = [l for l in self.leases.get(key, []) if not l.conn.dead]
+            if not pool:
+                return None
+            best = min(pool, key=lambda l: l.inflight)
+            best.inflight += 1
+            best.last_used = _time.monotonic()
+            return best
+
+    def _grant_lease(self, key, spec) -> Optional[Lease]:
+        """Request one worker lease from the head; on success the lease is
+        registered AND pre-incremented for the caller (atomic with its
+        insertion, so the maintenance tick can never doom it first)."""
+        import time as _time
+
+        try:
+            reply = self.wr.request(
+                "lease_worker", (dict(spec.resources),), timeout=15.0
+            )
+        except Exception:
+            reply = ("busy",)
+        if not (isinstance(reply, tuple) and reply and reply[0] == "ok"):
+            with self.lock:
+                self.lease_backoff[key] = _time.monotonic() + 0.25
+            return None
+        _, lease_id, worker_id, endpoint = reply
+        conn = self._conn_to(tuple(endpoint))
+        if conn is None:
+            self.wr.oneway(("lease_return", lease_id))
+            with self.lock:
+                self.lease_backoff[key] = _time.monotonic() + 0.25
+            return None
+        lease = Lease(lease_id, worker_id, conn)
+        with self.lock:
+            lease.inflight += 1
+            self.leases.setdefault(key, []).append(lease)
+        return lease
+
+    def _ensure_maintenance(self) -> None:
+        with self.lock:
+            if self._maint_started:
+                return
+            self._maint_started = True
+        t = threading.Thread(
+            target=self._maintenance_loop, daemon=True, name="raytpu-leases"
+        )
+        t.start()
+
+    def _maintenance_loop(self) -> None:
+        """Return leases idle past the keep-alive so the head can re-pool
+        the workers (ray: lease reuse with idle release)."""
+        import time as _time
+
+        while True:
+            _time.sleep(1.0)
+            now = _time.monotonic()
+            doomed = []
+            with self.lock:
+                for key, pool in list(self.leases.items()):
+                    keep = []
+                    for l in pool:
+                        if l.conn.dead or (
+                            l.inflight == 0
+                            and now - l.last_used > _LEASE_IDLE_RETURN_S
+                        ):
+                            doomed.append(l)
+                        else:
+                            keep.append(l)
+                    if keep:
+                        self.leases[key] = keep
+                    else:
+                        self.leases.pop(key, None)
+            for l in doomed:
+                self.wr.oneway(("lease_return", l.lease_id))
+
+    def _resend(self, spec) -> bool:
+        """Re-push a crashed/retried task on a fresh lease, keeping the
+        existing (still-pending) result registrations."""
+        lease = self._acquire_lease(
+            self._lease_key(spec), spec, ignore_backoff=True
+        )
+        if lease is None:
+            return False
+        with self.lock:
+            self.inflight[spec.task_id] = (None, spec, lease.conn, lease)
+        if not lease.conn.send(("pcall", spec)):
+            with self.lock:
+                self.inflight.pop(spec.task_id, None)
+                lease.inflight -= 1
+            return False
+        return True
 
     # -- completion ----------------------------------------------------------
 
@@ -337,7 +568,10 @@ class DirectTransport:
             entry = self.inflight.pop(task_id, None)
         if entry is None:
             return
-        _aid, spec, _conn = entry
+        _aid, spec, _conn, lease = entry
+        if lease is not None:
+            with self.lock:
+                lease.inflight -= 1
         err = None
         if err_blob is not None:
             import cloudpickle
@@ -352,6 +586,20 @@ class DirectTransport:
                     f"direct call {task_id} failed with an error that could "
                     f"not be deserialized in the caller: {e!r}"
                 )
+        from ray_tpu.exceptions import TaskCancelledError
+
+        if (
+            err is not None
+            and lease is not None
+            and spec.retry_exceptions
+            and spec.attempt < spec.max_retries
+            # A cancel is a user decision, not a failure: retrying it
+            # would silently undo ray_tpu.cancel.
+            and not isinstance(err, TaskCancelledError)
+        ):
+            spec.attempt += 1
+            if self._resend(spec):
+                return  # retried: the pending results land on a later pdone
         for oid in spec.return_ids():
             value = None
             if err is None:
@@ -394,7 +642,7 @@ class DirectTransport:
             self._release_locked(oid)
 
     def _fail_inflight_on(self, conn: PeerConn) -> None:
-        from ray_tpu.exceptions import ActorDiedError
+        from ray_tpu.exceptions import ActorDiedError, WorkerCrashedError
 
         with self.lock:
             doomed = [
@@ -408,8 +656,21 @@ class DirectTransport:
             ]
             for aid in routes_dead:
                 self.routes.pop(aid, None)
-        for _tid, (aid, spec, _c) in doomed:
-            err = ActorDiedError(aid)
+        for _tid, (aid, spec, _c, lease) in doomed:
+            if lease is not None:
+                with self.lock:
+                    lease.inflight -= 1
+                # Leased plain task: crash retries run caller-side against
+                # a fresh lease (ray: owner-side TaskManager resubmission).
+                if spec.attempt < spec.max_retries:
+                    spec.attempt += 1
+                    if self._resend(spec):
+                        continue
+                err: Exception = WorkerCrashedError(
+                    f"worker running task {spec.name} died unexpectedly"
+                )
+            else:
+                err = ActorDiedError(aid)
             for oid in spec.return_ids():
                 self._land(oid, err, None)
             for c in spec.contained_refs:
@@ -428,9 +689,9 @@ class DirectTransport:
         already finished — either way the head has nothing to do)."""
         with self.lock:
             target = None
-            for tid, (aid, spec, conn) in self.inflight.items():
-                if oid in spec.return_ids():
-                    target = (tid, conn)
+            for tid, entry in self.inflight.items():
+                if oid in entry[1].return_ids():
+                    target = (tid, entry[2])
                     break
             if target is None:
                 return oid in self.results  # finished (or never direct)

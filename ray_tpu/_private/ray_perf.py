@@ -75,24 +75,23 @@ def bench_tasks_async(n: int = 2000, window: int = 100) -> Dict:
 
 
 def bench_multi_client_tasks_async(n_clients: int = 4, n_per: int = 1000) -> Dict:
-    def client():
-        refs = []
-        for _ in range(n_per):
-            refs.append(_noop.remote())
-            if len(refs) >= 100:
-                ray_tpu.get(refs, timeout=120)
-                refs = []
-        if refs:
-            ray_tpu.get(refs, timeout=120)
+    """N worker-process clients each fanning out plain tasks (the
+    reference's multi-client shape — its clients are worker-side too, so
+    each rides its own transport: here, head-granted leases + direct push
+    instead of a per-task head request)."""
+    clients = [_Client.remote() for _ in range(n_clients)]
+    ray_tpu.get([c.run_tasks.remote(1, 1) for c in clients], timeout=60)
 
     def run():
-        with ThreadPoolExecutor(n_clients) as pool:
-            futs = [pool.submit(client) for _ in range(n_clients)]
-            for f in futs:
-                f.result()
-        return n_clients * n_per
+        done = ray_tpu.get(
+            [c.run_tasks.remote(n_per, 100) for c in clients], timeout=300
+        )
+        return sum(done)
 
-    return timeit("multi_client_tasks_async", run)
+    out = timeit("multi_client_tasks_async", run)
+    for c in clients:
+        ray_tpu.kill(c)
+    return out
 
 
 def bench_actor_calls_sync(n: int = 500) -> Dict:
@@ -140,6 +139,17 @@ class _Client:
         refs = []
         for _ in range(n):
             refs.append(handle.noop.remote())
+            if len(refs) >= window:
+                ray_tpu.get(refs, timeout=120)
+                refs = []
+        if refs:
+            ray_tpu.get(refs, timeout=120)
+        return n
+
+    def run_tasks(self, n, window):
+        refs = []
+        for _ in range(n):
+            refs.append(_noop.remote())
             if len(refs) >= window:
                 ray_tpu.get(refs, timeout=120)
                 refs = []

@@ -419,6 +419,15 @@ class Runtime:
         # Transport-switch fences: fence_id -> (caller, req_id, wid, ep).
         self._pending_fences: Dict[str, tuple] = {}
         self._fence_counter = 0
+        # Peer-leased workers (ray: direct_task_transport.h lease pooling):
+        # lease_id -> (worker_id, node_id, resources, caller_id).  A leased
+        # worker executes tasks pushed straight by the caller; the head
+        # only holds the resource reservation.
+        self.peer_leases: Dict[str, tuple] = {}
+        self._lease_counter = 0
+        # Lease grants awaiting a spawning worker's ready handshake:
+        # worker_id -> [(caller, req_id, lease_id)].
+        self._parked_peer_leases: Dict[str, list] = {}
 
         from multiprocessing.connection import Listener
 
@@ -1172,6 +1181,7 @@ class Runtime:
                     sp.remove(wid)
                 self.idle_pool.setdefault((h.node_id, h.env_key), []).append(wid)
             self._conn_to_worker[conn] = wid
+            self._grant_parked_leases(wid)
         with self.lock:
             self._dispatch()
 
@@ -1492,6 +1502,21 @@ class Runtime:
                 if ar:
                     ar.expected_death = True
                     ar.no_restart = True
+        elif kind == "task_events":
+            # Batched task-state reports for peer-executed (direct) tasks:
+            # restores state-API/metrics visibility without a per-task
+            # head message on the latency path.
+            with self.lock:
+                for e in msg[1]:
+                    self.metrics["tasks_submitted"] += 1
+                    self.metrics[
+                        "tasks_finished" if e.get("state") == "FINISHED"
+                        else "tasks_failed"
+                    ] += 1
+                    self.task_events.append(e)
+        elif kind == "lease_return":
+            with self.lock:
+                self._release_peer_lease_locked(msg[1], return_worker=True)
         elif kind == "fence_ack":
             with self.lock:
                 ent = self._pending_fences.pop(msg[1], None)
@@ -1574,6 +1599,8 @@ class Runtime:
             return None  # put-backpressure barrier (worker flushes oneways)
         if op == "resolve_actor":
             return self._req_resolve_actor(wid, req_id, *payload)
+        if op == "lease_worker":
+            return self._req_lease_worker(wid, req_id, *payload)
         if op == "get_function":
             blob = self.state.get_function(payload)
             if blob is None:
@@ -1674,6 +1701,77 @@ class Runtime:
             self._pending_fences[fid] = (wid, req_id, ar.worker_id, ep)
             self._send(h, ("fence", fid))
             return _PARKED
+
+    def _req_lease_worker(self, wid: str, req_id: int, resources: Dict[str, float]):
+        """Grant a reusable worker lease for one scheduling key
+        (ray: NodeManager::HandleRequestWorkerLease, node_manager.h:508 +
+        the submitter-side pooling of direct_task_transport.h:75).
+
+        The reservation goes through the same scheduler as head-dispatched
+        tasks, so policy (incl. spillback to another node when one fills)
+        and backpressure (("busy",) when the cluster is full → the caller
+        relays through the queued head path) are inherited rather than
+        reimplemented.  A grant on a still-spawning worker parks until its
+        ready handshake delivers the peer endpoint."""
+        probe = TaskSpec(
+            task_id="lease-probe", name="lease", fn_id="", args_blob=b"",
+            resources=dict(resources),
+        )
+        with self.lock:
+            try:
+                node = self.scheduler.select_node(probe)
+            except ValueError:
+                return ("infeasible",)
+            if node is None or not self.scheduler.acquire(node, probe.resources):
+                return ("busy",)
+            h = self._lease_worker(node, probe)
+            h.state = "peer_leased"
+            self._lease_counter += 1
+            lease_id = f"lease-{self._lease_counter}"
+            self.peer_leases[lease_id] = (h.worker_id, node, dict(resources), wid)
+            ep = self.worker_peer_endpoints.get(h.worker_id)
+            if h.conn is not None and ep is not None:
+                return ("ok", lease_id, h.worker_id, ep)
+            if h.conn is not None and ep is None:
+                # Connected worker without a peer listener (bind failed):
+                # useless for direct push — undo the grant.
+                self._release_peer_lease_locked(lease_id, return_worker=True)
+                return ("busy",)
+            self._parked_peer_leases.setdefault(h.worker_id, []).append(
+                (wid, req_id, lease_id)
+            )
+            return _PARKED
+
+    @_locked
+    def _release_peer_lease_locked(self, lease_id: str, return_worker: bool) -> None:
+        rec = self.peer_leases.pop(lease_id, None)
+        if rec is None:
+            return
+        worker_id, node, resources, _caller = rec
+        self.scheduler.release(node, resources)
+        h = self.workers.get(worker_id)
+        if return_worker and h is not None and h.state == "peer_leased":
+            self._return_worker(h)
+        self._dispatch()
+
+    @_locked
+    def _grant_parked_leases(self, wid: str) -> None:
+        """Caller holds self.lock: a worker's ready handshake landed —
+        complete lease grants that were waiting on its peer endpoint."""
+        parked = self._parked_peer_leases.pop(wid, None)
+        if not parked:
+            return
+        ep = self.worker_peer_endpoints.get(wid)
+        for caller, req_id, lease_id in parked:
+            if ep is not None and lease_id in self.peer_leases:
+                self._reply(caller, req_id, True, ("ok", lease_id, wid, ep))
+            else:
+                # No peer endpoint (listener bind failed) or the lease was
+                # already released: the worker itself is alive and
+                # connected — return it to the pool or it would sit in
+                # state "peer_leased" forever, invisible to the scheduler.
+                self._release_peer_lease_locked(lease_id, return_worker=True)
+                self._reply(caller, req_id, True, ("busy",))
 
     def _req_get_object(self, wid: str, req_id: int, oid: str):
         with self.lock:
@@ -2363,6 +2461,19 @@ class Runtime:
             if ent[2] == wid:
                 self._pending_fences.pop(fid, None)
                 self._reply(ent[0], ent[1], True, ("dead", None, None))
+        # Leases die with the worker they lease (callers see the peer conn
+        # EOF and retry) and with the CALLER that held them (its workers
+        # return to the pool).
+        for lid, rec in list(self.peer_leases.items()):
+            if rec[0] == wid:
+                self._release_peer_lease_locked(lid, return_worker=False)
+            elif rec[3] == wid:
+                self._release_peer_lease_locked(lid, return_worker=True)
+        parked = self._parked_peer_leases.pop(wid, None)
+        if parked:
+            for caller, req_id, lease_id in parked:
+                self._release_peer_lease_locked(lease_id, return_worker=False)
+                self._reply(caller, req_id, True, ("busy",))
         h = self.workers.pop(wid, None)
         if h is None or h.state == "dead":
             return  # duplicate notification (daemon report + conn EOF)

@@ -366,6 +366,13 @@ class OwnerStore:
                     self._early_dels[object_id] = early - consumed
                 n -= consumed
                 if n <= 0:
+                    # The adds and their buffered releases cancelled out:
+                    # if nothing else holds the object, free any bytes that
+                    # were registered between the buffered del and this add
+                    # (otherwise they'd sit at refcount 0 forever — the
+                    # balancing remove_ref already fired).
+                    if object_id not in self._refcount:
+                        self._free(object_id)
                     return
             self._refcount[object_id] = self._refcount.get(object_id, 0) + n
 

@@ -507,6 +507,53 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
 
     peer_cancelled: set = set()
 
+    # Task events for peer-executed tasks, reported to the head in BATCHES
+    # off the latency path (ray: task_event_buffer.h:147 — the reference
+    # buffers and flushes task state transitions on an interval too; the
+    # state API is eventually consistent in both systems).
+    events_buf: list = []
+    events_lock = threading.Lock()
+
+    def flush_task_events() -> None:
+        with events_lock:
+            if not events_buf:
+                return
+            batch = events_buf[:]
+            events_buf.clear()
+        rt.oneway(("task_events", batch))
+
+    def record_peer_task_event(spec, err_blob, t0: float, t1: float) -> None:
+        with events_lock:
+            events_buf.append(
+                {
+                    "task_id": spec.task_id,
+                    "name": spec.name,
+                    "state": "FINISHED" if err_blob is None else "FAILED",
+                    "node_id": node_id,
+                    "worker_id": worker_id,
+                    "actor_id": spec.actor_id,
+                    "parent_task_id": spec.parent_task_id,
+                    "attempt": spec.attempt,
+                    "end_time": t1,
+                    "duration": t1 - t0,
+                    "direct": True,
+                }
+            )
+            full = len(events_buf) >= 64
+        if full:
+            flush_task_events()
+
+    def _events_ticker() -> None:
+        import time as _time
+
+        while True:
+            _time.sleep(0.5)
+            flush_task_events()
+
+    threading.Thread(
+        target=_events_ticker, daemon=True, name="raytpu-task-events"
+    ).start()
+
     def peer_handler(msg: tuple, reply) -> None:
         if msg[0] == "pcall":
             route_task(("task", msg[1], None), reply)
@@ -620,6 +667,9 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
                  cloudpickle.dumps(TaskCancelledError(spec.name)))
             )
             return
+        import time as _time
+
+        t0 = _time.time()
         try:
             done = _execute(rt, spec, blob)
         except SystemExit:
@@ -648,6 +698,7 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
                 # consumers (and capacity accounting) can find it; the head
                 # swaps the guard borrows for its stored-object borrows.
                 rt.oneway(("direct_seal", oid, data, contained))
+        record_peer_task_event(spec, err_blob, t0, _time.time())
         reply.send(("pdone", _task_id, results, err_blob))
 
     threading.Thread(target=recv_loop, daemon=True, name="worker-recv").start()

@@ -1,0 +1,184 @@
+"""Leased direct dispatch of plain tasks (peer.py submit_plain +
+runtime._req_lease_worker).
+
+The reference's normal-task hot path leases a worker per scheduling key
+and pushes subsequent same-shape tasks straight to it
+(ray: src/ray/core_worker/transport/direct_task_transport.h:40-75,
+raylet lease protocol node_manager.h:508).  These tests prove per-task
+head traffic is O(1 lease per key) — not O(1 request per task) — and that
+crash retries, dep gating, and lease return keep semantics intact.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def _counts():
+    from ray_tpu._private.runtime import get_runtime
+
+    return get_runtime().req_counts
+
+
+def test_nested_submits_lease_not_per_task(ray_start_regular):
+    """30 nested tasks from one worker: zero head submits, a handful of
+    lease grants (the VERDICT item-2 'done' check)."""
+
+    @ray_tpu.remote
+    def leaf(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def driver_task(n):
+        return ray_tpu.get([leaf.remote(i) for i in range(n)])
+
+    before_submit = _counts().get("submit", 0)
+    out = ray_tpu.get(driver_task.remote(30), timeout=90)
+    assert out == [i * 2 for i in range(30)]
+    assert _counts().get("submit", 0) == before_submit, (
+        "leased direct dispatch must not relay plain tasks through the head"
+    )
+    assert _counts().get("lease_worker", 0) <= 8
+
+
+def test_lease_reuse_across_bursts(ray_start_regular):
+    @ray_tpu.remote
+    def leaf(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def driver_task():
+        a = ray_tpu.get([leaf.remote(i) for i in range(10)])
+        b = ray_tpu.get([leaf.remote(i) for i in range(10)])  # reuses leases
+        return a, b
+
+    before = _counts().get("lease_worker", 0)
+    a, b = ray_tpu.get(driver_task.remote(), timeout=90)
+    assert a == b == [i + 1 for i in range(10)]
+    assert _counts().get("lease_worker", 0) - before <= 8
+
+
+def test_leases_returned_when_idle(ray_start_regular):
+    """Idle leases flow back: the head's resources free up within the
+    keep-alive window and head-path work can use them again."""
+    from ray_tpu._private.runtime import get_runtime
+
+    @ray_tpu.remote
+    def leaf():
+        return 1
+
+    @ray_tpu.remote
+    def driver_task():
+        return sum(ray_tpu.get([leaf.remote() for _ in range(8)]))
+
+    assert ray_tpu.get(driver_task.remote(), timeout=60) == 8
+    rt = get_runtime()
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and rt.peer_leases:
+        time.sleep(0.25)
+    assert not rt.peer_leases, "idle leases must be returned to the pool"
+    assert rt.available_resources().get("CPU", 0) >= 3.0
+
+
+def test_leased_task_crash_retries(ray_start_regular, tmp_path):
+    """A leased worker dying mid-task retries caller-side on a new lease
+    (ray: owner-side TaskManager resubmission semantics)."""
+    flag = str(tmp_path / "crashed-once")
+
+    @ray_tpu.remote
+    def flaky(path):
+        if not os.path.exists(path):
+            with open(path, "w") as f:
+                f.write("x")
+            os._exit(1)  # kill the leased worker on first attempt
+        return "recovered"
+
+    @ray_tpu.remote
+    def driver_task(path):
+        return ray_tpu.get(flaky.remote(path), timeout=60)
+
+    assert ray_tpu.get(driver_task.remote(flag), timeout=90) == "recovered"
+
+
+def test_leased_chain_with_materialized_dep(ray_start_regular):
+    """f(g_ref): g's landed (and escape-promoted) result is a materialized
+    dep, so f may still go direct; values must flow correctly."""
+
+    @ray_tpu.remote
+    def g():
+        return 21
+
+    @ray_tpu.remote
+    def f(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def driver_task():
+        gref = g.remote()
+        ray_tpu.get(gref)  # materialize before chaining
+        return ray_tpu.get(f.remote(gref), timeout=30)
+
+    assert ray_tpu.get(driver_task.remote(), timeout=90) == 42
+
+
+def test_pending_dep_takes_head_path(ray_start_regular):
+    """f(g.remote()) with g still in flight must NOT occupy a leased
+    worker (deadlock guard): it relays to the dep-gating head path and
+    still completes."""
+
+    @ray_tpu.remote
+    def g():
+        time.sleep(0.3)
+        return 5
+
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def driver_task():
+        return ray_tpu.get(f.remote(g.remote()), timeout=60)
+
+    assert ray_tpu.get(driver_task.remote(), timeout=90) == 6
+
+
+def test_spillback_when_key_saturated(ray_start_regular):
+    """More concurrent leased tasks than CPUs: excess grants are denied
+    ("busy") and overflow relays to the head queue — everything completes,
+    nothing deadlocks."""
+
+    @ray_tpu.remote
+    def slowleaf(i):
+        time.sleep(0.1)
+        return i
+
+    @ray_tpu.remote
+    def driver_task(n):
+        return sorted(ray_tpu.get([slowleaf.remote(i) for i in range(n)],
+                                  timeout=120))
+
+    assert ray_tpu.get(driver_task.remote(20), timeout=150) == list(range(20))
+
+
+def test_ineligible_shapes_relay(ray_start_regular):
+    """SPREAD strategy and runtime_env tasks keep the head path."""
+
+    @ray_tpu.remote
+    def which():
+        return os.environ.get("MARKER", "none")
+
+    @ray_tpu.remote
+    def driver_task():
+        a = ray_tpu.get(
+            which.options(runtime_env={"env_vars": {"MARKER": "m1"}}).remote(),
+            timeout=60,
+        )
+        b = ray_tpu.get(
+            which.options(scheduling_strategy="SPREAD").remote(), timeout=60
+        )
+        return a, b
+
+    assert ray_tpu.get(driver_task.remote(), timeout=120) == ("m1", "none")

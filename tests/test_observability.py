@@ -220,9 +220,18 @@ def test_task_parentage_tracing(rt):
         return ray_tpu.get([child.remote(i) for i in range(2)], timeout=30)
 
     assert ray_tpu.get(parent.remote(), timeout=60) == [1, 2]
-    events = {e["task_id"]: e for e in state_api.list_tasks()}
-    parents = [e for e in events.values() if e["name"] == "parent"]
-    children = [e for e in events.values() if e["name"] == "child"]
+    # Direct (peer-executed) tasks report state in BATCHES off the latency
+    # path (ray: task_event_buffer.h flushes on an interval too), so the
+    # state API is eventually consistent: poll briefly.
+    deadline = time.time() + 5
+    parents = children = []
+    while time.time() < deadline:
+        events = {e["task_id"]: e for e in state_api.list_tasks()}
+        parents = [e for e in events.values() if e["name"] == "parent"]
+        children = [e for e in events.values() if e["name"] == "child"]
+        if len(parents) == 1 and len(children) == 2:
+            break
+        time.sleep(0.2)
     assert len(parents) == 1 and len(children) == 2
     assert parents[0].get("parent_task_id") is None  # driver submit
     for c in children:
