@@ -68,6 +68,18 @@ _DEFS: Dict[str, tuple] = {
         "chunk size for cross-node object pulls "
         "(ray: object_manager_default_chunk_size)",
     ),
+    "serve_proxy_max_connections": (
+        2048, int,
+        "max concurrent HTTP connections one serve proxy holds open; "
+        "connections beyond the bound are refused at accept "
+        "(ray: uvicorn's backlog/limit-concurrency role)",
+    ),
+    "serve_proxy_threads": (
+        32, int,
+        "executor threads one serve proxy uses to resolve replica "
+        "responses; bounds active requests while idle keep-alive "
+        "connections cost only a coroutine",
+    ),
     "object_transfer_max_concurrency": (
         8, int,
         "max concurrent outbound transfers an object server runs; excess "

@@ -20,7 +20,6 @@ from __future__ import annotations
 import json
 import sys
 import time
-from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List
 
 import ray_tpu

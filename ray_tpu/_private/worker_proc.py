@@ -51,6 +51,7 @@ class WorkerRuntime:
         # that construct a bare WorkerRuntime.
         self.direct = None
         self._puts_unacked = 0
+        self._puts_lock = threading.Lock()  # max_concurrency>1 puts race
         # RAY_TPU_STORE_DIR scopes the store to THIS worker's node (set by
         # its node daemon); without it (head-node workers) the session
         # default resolves to the head store.  Objects on other nodes are
@@ -226,9 +227,12 @@ class WorkerRuntime:
             self.oneway(("seal_ow", oid, packed, contained))
         else:
             self.oneway(("put_ow", oid, bytes(ser.pack(payload, buffers)), contained))
-        self._puts_unacked += 1
-        if self._puts_unacked >= 64:
-            self._puts_unacked = 0
+        with self._puts_lock:
+            self._puts_unacked += 1
+            flush = self._puts_unacked >= 64
+            if flush:
+                self._puts_unacked = 0
+        if flush:
             self.request("sync", None)
         return oid
 

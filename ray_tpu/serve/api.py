@@ -61,7 +61,10 @@ def start(
             _proxy = (
                 ray_tpu.remote(HTTPProxy)
                 .options(max_concurrency=32)
-                .remote(_controller, http_options.host, http_options.port)
+                .remote(
+                    _controller, http_options.host, http_options.port,
+                    http_options.max_connections,
+                )
             )
             ray_tpu.get(_proxy.ping.remote(), timeout=30)
 

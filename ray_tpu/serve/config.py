@@ -74,6 +74,10 @@ class HTTPOptions:
 
     host: str = "127.0.0.1"
     port: int = 8000
+    # 0 = the serve_proxy_max_connections config knob.  Connections beyond
+    # the bound are refused with 503 at accept (ray: uvicorn
+    # limit-concurrency role).
+    max_connections: int = 0
 
 
 # Controller actor's well-known name (ray: serve/_private/constants.py

@@ -90,10 +90,45 @@ def bench_http(addr: str, n: int, concurrency: int):
     return len(lat) / wall, lat
 
 
+def bench_http_under_idle_load(addr: str, n: int, concurrency: int,
+                               idle_conns: int):
+    """p99 of active requests while `idle_conns` extra keep-alive
+    connections sit open — the asyncio proxy must hold them at flat
+    latency (a thread-per-connection server degrades as idle_conns grows;
+    ray: uvicorn's event loop has the same property)."""
+    import http.client
+    import socket
+    from urllib.parse import urlparse
+
+    parsed = urlparse(addr)
+    idle = []
+    try:
+        for _ in range(idle_conns):
+            s = socket.create_connection(
+                (parsed.hostname, parsed.port), timeout=30
+            )
+            # One real request primes the connection as keep-alive.
+            s.sendall(b"GET /echo?x=1 HTTP/1.1\r\nHost: x\r\n\r\n")
+            idle.append(s)
+        for s in idle:
+            s.recv(65536)  # drain the priming response; conn stays open
+        qps, lat = bench_http(addr, n, concurrency)
+    finally:
+        for s in idle:
+            try:
+                s.close()
+            except OSError:
+                pass
+    return qps, lat
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=300)
     ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--idle-conns", type=int, default=0,
+                    help="sweep: hold N idle keep-alive conns during the "
+                         "HTTP bench and report latency under that load")
     ap.add_argument("--output", default=None)
     args = ap.parse_args(argv)
 
@@ -125,6 +160,19 @@ def main(argv=None) -> int:
         "http_p50_ms": round(_percentile(wlat, 0.50) * 1e3, 2),
         "http_p99_ms": round(_percentile(wlat, 0.99) * 1e3, 2),
     }
+    if args.idle_conns:
+        iqps, ilat = bench_http_under_idle_load(
+            addr, args.requests, args.concurrency, args.idle_conns
+        )
+        out.update(
+            {
+                "idle_conns": args.idle_conns,
+                "http_qps_under_idle": round(iqps, 1),
+                "http_p99_ms_under_idle": round(
+                    _percentile(ilat, 0.99) * 1e3, 2
+                ),
+            }
+        )
     line = json.dumps(out)
     print(line)
     if args.output:

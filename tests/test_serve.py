@@ -446,3 +446,59 @@ def test_async_handle_await(serve_instance):
         return a, b
 
     assert asyncio.run(drive()) == (6, 8)
+
+
+def test_http_proxy_keepalive_and_connection_bound(serve_instance, monkeypatch):
+    """Asyncio proxy: many idle keep-alive connections are cheap
+    (coroutines, not threads), and connections beyond the configured bound
+    are refused with 503 instead of degrading everyone
+    (ray: http_proxy.py:234 uvicorn event-loop model)."""
+    import socket
+
+    serve.start(
+        http_options={"host": "127.0.0.1", "port": 0, "max_connections": 12}
+    )
+
+    @serve.deployment(name="echo2")
+    def echo2(body=None):
+        return {"ok": True}
+
+    serve.run(echo2.bind())
+    addr = serve.get_http_address()
+    from urllib.parse import urlparse
+
+    parsed = urlparse(addr)
+
+    idle = []
+    try:
+        # Hold 10 primed keep-alive connections open.
+        for _ in range(10):
+            s = socket.create_connection((parsed.hostname, parsed.port), timeout=30)
+            s.sendall(b"GET /echo2 HTTP/1.1\r\nHost: x\r\n\r\n")
+            idle.append(s)
+        for s in idle:
+            assert b"200" in s.recv(65536)
+        # Requests still serve promptly under the idle load.
+        resp = urllib.request.urlopen(f"{addr}/echo2", timeout=30)
+        assert json.loads(resp.read())["result"] == {"ok": True}
+        # Beyond the bound: 503 at accept.
+        extra = []
+        refused = False
+        try:
+            for _ in range(12):
+                s = socket.create_connection(
+                    (parsed.hostname, parsed.port), timeout=10
+                )
+                extra.append(s)
+                s.sendall(b"GET /echo2 HTTP/1.1\r\nHost: x\r\n\r\n")
+                data = s.recv(65536)
+                if b"503" in data or data == b"":
+                    refused = True
+                    break
+        finally:
+            for s in extra:
+                s.close()
+        assert refused, "over-bound connection was not refused"
+    finally:
+        for s in idle:
+            s.close()
