@@ -26,6 +26,7 @@ send from pinning a whole object in socket buffers — the pull_manager's
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Callable, Iterable, List, Optional, Tuple
 
@@ -45,6 +46,12 @@ def stream_object(conn, read_raw: Callable[[str], Optional[tuple]], oid: str) ->
     read_raw(oid) -> (buffer, keepalive) | None; the buffer is the PACKED
     segment (header + payload + out-of-band buffers) exactly as stored, so
     the receiver can seal it byte-for-byte without re-serialization.
+
+    After the ("ok", total) header the body is RAW bytes written straight
+    from the stored segment's memoryview (no per-chunk frame, no copy on
+    the send side) — the push-manager data plane is a memcpy problem, not
+    a serialization problem (ray: object_buffer_pool.h chunked reads of
+    the plasma segment).
     """
     try:
         raw = read_raw(oid)
@@ -54,10 +61,14 @@ def stream_object(conn, read_raw: Callable[[str], Optional[tuple]], oid: str) ->
         buf, _keepalive = raw
         total = len(buf)
         conn.send(("ok", total))
+        fd = conn.fileno()
         chunk = _chunk_size()
-        for off in range(0, total, chunk):
-            conn.send_bytes(buf[off : off + chunk])
-    except (OSError, EOFError):
+        mv = memoryview(buf)
+        off = 0
+        while off < total:
+            n = os.write(fd, mv[off : off + chunk])
+            off += n
+    except (OSError, EOFError, ValueError):
         pass  # peer vanished mid-transfer; it retries another endpoint
     finally:
         try:
@@ -172,12 +183,35 @@ def _connect_with_deadline(endpoint: Tuple[str, int], authkey: bytes, timeout: f
     return conn
 
 
-def _bounded_recv_bytes(conn, deadline: float) -> bytes:
+def _raw_chunks(conn, total: int, deadline: float):
+    """Yield the raw transfer body as memoryview chunks read with
+    recv_into on a reusable buffer — one kernel read per chunk, and the
+    store's allocate-then-fill copies each chunk straight into the arena
+    mmap (one copy total on the receive side)."""
+    import socket
     import time
 
-    if not conn.poll(max(deadline - time.monotonic(), 0.0)):
-        raise OSError("object transfer timed out")
-    return conn.recv_bytes()
+    s = socket.socket(fileno=os.dup(conn.fileno()))
+    try:
+        buf = bytearray(min(_chunk_size(), total) or 1)
+        mv = memoryview(buf)
+        got = 0
+        while got < total:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise OSError("object transfer timed out")
+            s.settimeout(remaining)
+            want = min(len(buf), total - got)
+            try:
+                n = s.recv_into(mv[:want])
+            except socket.timeout as e:
+                raise OSError("object transfer timed out") from e
+            if n == 0:
+                raise EOFError("transfer connection closed mid-body")
+            got += n
+            yield mv[:n]
+    finally:
+        s.close()
 
 
 def fetch_object(
@@ -211,15 +245,7 @@ def fetch_object(
         if hdr[0] != "ok":
             return None
         total = int(hdr[1])
-
-        def chunks():
-            got = 0
-            while got < total:
-                b = _bounded_recv_bytes(conn, deadline)
-                got += len(b)
-                yield b
-
-        write_chunks(oid, total, chunks())
+        write_chunks(oid, total, _raw_chunks(conn, total, deadline))
         return total
     finally:
         try:

@@ -271,6 +271,22 @@ def bench_broadcast_cross_node(n_nodes: int = 3, mb: int = 100) -> Dict:
     def land(x):
         return int(x[::1024].sum())
 
+    @ray_tpu.remote
+    def warm_up():
+        return 1
+
+    # Spawn each node's worker BEFORE the timed run: the cold number must
+    # measure the transfer plane, not process boot.
+    ray_tpu.get(
+        [
+            warm_up.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(nid)
+            ).remote()
+            for nid in nids
+        ],
+        timeout=120,
+    )
+
     expect = int(payload[::1024].sum())
 
     def run():

@@ -6,8 +6,8 @@ task per block with the object store as the inter-stage buffer; all-to-all
 ops (repartition/shuffle/sort/groupby) are two-phase task graphs.
 """
 
-from ray_tpu.data.block import Block, BlockAccessor, NumpyBlock
-from ray_tpu.data.dataset import Dataset
+from ray_tpu.data.block import ArrowBlock, Block, BlockAccessor, NumpyBlock
+from ray_tpu.data.dataset import Dataset, DatasetPipeline
 from ray_tpu.data.read_api import (
     from_arrow,
     from_items,
@@ -21,9 +21,11 @@ from ray_tpu.data.read_api import (
 )
 
 __all__ = [
+    "ArrowBlock",
     "Block",
     "BlockAccessor",
     "Dataset",
+    "DatasetPipeline",
     "NumpyBlock",
     "from_arrow",
     "from_items",

@@ -55,7 +55,57 @@ class NumpyBlock:
         return (NumpyBlock, (self.columns,))
 
 
-Block = Union[List[Any], NumpyBlock]
+class ArrowBlock:
+    """Arrow-table-backed block (ray: the reference's default block format
+    is pyarrow.Table — block.py BlockAccessor.for_block dispatch).
+
+    Zero-copy slicing via Table.slice, columnar hand-off to numpy/pandas
+    batches, and parquet/csv writes without a row detour.  Pickles via
+    Arrow IPC (buffers travel out-of-band through the shm store)."""
+
+    __slots__ = ("table",)
+
+    def __init__(self, table):
+        self.table = table
+
+    def __len__(self) -> int:
+        return self.table.num_rows
+
+    def slice(self, start: int, end: int) -> "ArrowBlock":
+        start = max(0, start)
+        return ArrowBlock(self.table.slice(start, max(end - start, 0)))
+
+    def __iter__(self):
+        return iter(self.table.to_pylist())
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            lo, hi, _ = idx.indices(len(self))
+            return self.slice(lo, hi)
+        # Scalar take per column — NOT to_pydict(), which would convert the
+        # whole table to Python per row access.
+        return {
+            name: self.table[name][idx].as_py()
+            for name in self.table.column_names
+        }
+
+    def __reduce__(self):
+        import pyarrow as pa
+
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink, self.table.schema) as w:
+            w.write_table(self.table)
+        return (_arrow_from_ipc, (sink.getvalue(),))
+
+
+def _arrow_from_ipc(buf):
+    import pyarrow as pa
+
+    with pa.ipc.open_stream(buf) as r:
+        return ArrowBlock(r.read_all())
+
+
+Block = Union[List[Any], NumpyBlock, ArrowBlock]
 
 
 def block_len(block: Block) -> int:
@@ -63,7 +113,7 @@ def block_len(block: Block) -> int:
 
 
 def block_slice(block: Block, start: int, end: int) -> Block:
-    if isinstance(block, NumpyBlock):
+    if isinstance(block, (NumpyBlock, ArrowBlock)):
         return block.slice(start, end)
     return block[start:end]
 
@@ -71,6 +121,8 @@ def block_slice(block: Block, start: int, end: int) -> Block:
 def block_rows(block: Block) -> List[Any]:
     if isinstance(block, NumpyBlock):
         return batch_to_rows(block.columns)
+    if isinstance(block, ArrowBlock):
+        return block.table.to_pylist()
     return block
 
 
@@ -93,6 +145,12 @@ def concat_blocks(blocks: List[Block]) -> Block:
                 for k in blocks[0].columns
             }
         )
+    if all(isinstance(b, ArrowBlock) for b in blocks) and len(
+        {tuple(b.table.column_names) for b in blocks}
+    ) == 1:
+        import pyarrow as pa
+
+        return ArrowBlock(pa.concat_tables([b.table for b in blocks]))
     out: List[Any] = []
     for b in blocks:
         out.extend(block_rows(b))
@@ -122,6 +180,18 @@ class BlockAccessor:
 
                 return pa.table(dict(self.block.columns))
             raise ValueError(f"unknown batch_format {batch_format!r}")
+        if isinstance(self.block, ArrowBlock):
+            t = self.block.table
+            if batch_format == "pyarrow":
+                return t
+            if batch_format in ("numpy", "dict"):
+                return {
+                    name: t[name].to_numpy(zero_copy_only=False)
+                    for name in t.column_names
+                }
+            if batch_format == "pandas":
+                return t.to_pandas()
+            raise ValueError(f"unknown batch_format {batch_format!r}")
         rows = self.block
         if batch_format in ("numpy", "dict"):
             return rows_to_numpy_batch(rows)
@@ -142,6 +212,9 @@ class BlockAccessor:
     def schema(self):
         if isinstance(self.block, NumpyBlock):
             return {k: str(v.dtype) for k, v in self.block.columns.items()}
+        if isinstance(self.block, ArrowBlock):
+            t = self.block.table
+            return {f.name: str(f.type) for f in t.schema}
         if not self.block:
             return None
         row = self.block[0]

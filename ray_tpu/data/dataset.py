@@ -51,9 +51,19 @@ from ray_tpu.data.block import (
 
 
 def _batch_output_to_block(out) -> Block:
-    """A map_batches fn's output → block; dict-of-arrays stays columnar."""
+    """A map_batches fn's output → block; dict-of-arrays stays columnar,
+    pyarrow Tables stay Arrow."""
     if isinstance(out, dict):
         return NumpyBlock(out)
+    try:
+        import pyarrow as pa
+
+        if isinstance(out, pa.Table):
+            from ray_tpu.data.block import ArrowBlock
+
+            return ArrowBlock(out)
+    except ImportError:
+        pass
     return batch_to_rows(out)
 
 
@@ -114,6 +124,32 @@ def _partition_block(block: Block, ops: List[tuple], n: int, key_fn, seed) -> Li
 @ray_tpu.remote
 def _block_len(block: Block) -> int:
     return block_len(block)
+
+
+@ray_tpu.remote
+def _write_block(block: Block, path: str, fmt: str) -> Tuple[str, int]:
+    """One output file per block (ray: dataset.py:2327 write_parquet /
+    :2454 write_csv / write_json — file-per-block layout).  Arrow/columnar
+    blocks write without a row detour."""
+    n = block_len(block)
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+
+        pq.write_table(BlockAccessor(block).to_batch("pyarrow"), path)
+    elif fmt == "csv":
+        import pyarrow.csv as pacsv
+
+        pacsv.write_csv(BlockAccessor(block).to_batch("pyarrow"), path)
+    elif fmt == "json":
+        import json as _json
+
+        with open(path, "w") as f:
+            for r in block_rows(block):
+                f.write(_json.dumps(r if isinstance(r, dict) else {"value": r}))
+                f.write("\n")
+    else:
+        raise ValueError(f"unknown write format {fmt!r}")
+    return path, n
 
 
 @ray_tpu.remote
@@ -442,6 +478,65 @@ class Dataset:
     def to_pandas(self):
         return BlockAccessor(self.take_all()).to_batch("pandas")
 
+    # -- write APIs (ray: dataset.py:2327 write_parquet, :2454 write_csv,
+    # write_json) ----------------------------------------------------------
+
+    def _write(self, path: str, fmt: str, ext: str) -> List[str]:
+        """File-per-block parallel write; returns written paths.  Empty
+        blocks are skipped (the reference also writes only non-empty
+        blocks), but an entirely-empty dataset still writes one empty
+        file so the directory round-trips."""
+        import os as _os
+
+        _os.makedirs(path, exist_ok=True)
+        refs = self._block_refs
+        tasks = [
+            _write_block.remote(b, _os.path.join(path, f"part-{i:05d}.{ext}"), fmt)
+            for i, b in enumerate(refs)
+        ]
+        results = ray_tpu.get(tasks, timeout=600)
+        written = [p for p, n in results if n > 0]
+        if not written and results:
+            written = [results[0][0]]
+        # Remove files for empty blocks (written then found empty).
+        for p, n in results:
+            if n == 0 and p not in written:
+                try:
+                    _os.unlink(p)
+                except OSError:
+                    pass
+        return written
+
+    def write_parquet(self, path: str) -> List[str]:
+        return self._write(path, "parquet", "parquet")
+
+    def write_csv(self, path: str) -> List[str]:
+        return self._write(path, "csv", "csv")
+
+    def write_json(self, path: str) -> List[str]:
+        return self._write(path, "json", "json")
+
+    # -- pipelining (ray: python/ray/data/dataset_pipeline.py:65) ----------
+
+    def window(self, *, blocks_per_window: int = 2) -> "DatasetPipeline":
+        """Split into windows executed as consumed (plus one window of
+        prefetch — see DatasetPipeline.iter_datasets): pinned memory is
+        bounded by two windows regardless of dataset size
+        (ray: Dataset.window)."""
+        base = self._executed if self._executed is not None else self._base_refs
+        ops = [] if self._executed is not None else list(self._ops)
+        windows = [
+            Dataset(base[i : i + blocks_per_window], _ops=ops)
+            for i in range(0, len(base), blocks_per_window)
+        ] or [Dataset([], _ops=[])]
+        return DatasetPipeline(windows, epochs=1)
+
+    def repeat(self, times: Optional[int] = None) -> "DatasetPipeline":
+        """Epoch iteration: the dataset replayed `times` times (None =
+        unbounded, ray: Dataset.repeat).  Replays reuse each block's
+        memoized fused result."""
+        return DatasetPipeline([self], epochs=times)
+
     def iter_torch_batches(
         self,
         *,
@@ -486,3 +581,109 @@ class Dataset:
         # debugger should stay lazy).
         extra = f", pending_ops={len(self._ops)}" if self._ops else ""
         return f"Dataset(num_blocks={len(self._base_refs)}{extra})"
+
+
+class DatasetPipeline:
+    """Windowed/repeated execution over Datasets
+    (ray: python/ray/data/dataset_pipeline.py:65).
+
+    A pipeline is a sequence of windows (each a Dataset) replayed for
+    `epochs` epochs (None = unbounded).  Only the window currently being
+    consumed executes — window N+1's tasks submit while N's batches drain,
+    so memory is bounded by one window regardless of dataset size.
+    Transforms apply lazily per window.
+    """
+
+    def __init__(self, windows: List[Dataset], epochs: Optional[int] = 1):
+        self._windows = list(windows)
+        self._epochs = epochs
+
+    # -- transforms (applied to every window, lazily) ----------------------
+
+    def _per_window(self, method: str, *args, **kwargs) -> "DatasetPipeline":
+        return DatasetPipeline(
+            [getattr(w, method)(*args, **kwargs) for w in self._windows],
+            epochs=self._epochs,
+        )
+
+    def map(self, fn) -> "DatasetPipeline":
+        return self._per_window("map", fn)
+
+    def filter(self, fn) -> "DatasetPipeline":
+        return self._per_window("filter", fn)
+
+    def flat_map(self, fn) -> "DatasetPipeline":
+        return self._per_window("flat_map", fn)
+
+    def map_batches(self, fn, **kwargs) -> "DatasetPipeline":
+        return self._per_window("map_batches", fn, **kwargs)
+
+    def repeat(self, times: Optional[int] = None) -> "DatasetPipeline":
+        if self._epochs is None:
+            return self  # already unbounded: repeating cannot extend it
+        total = None if times is None else times * self._epochs
+        return DatasetPipeline(self._windows, epochs=total)
+
+    # -- consumption -------------------------------------------------------
+
+    @staticmethod
+    def _fresh(w: Dataset) -> Dataset:
+        """A window clone with an empty execution memo: the consumed
+        clone's fused output blocks release as soon as iteration drops it,
+        so the pipeline pins at most the in-flight windows — memoizing on
+        the shared window objects would keep EVERY window's outputs alive
+        for the pipeline's lifetime."""
+        return Dataset(w._base_refs, _ops=w._ops)
+
+    def iter_epochs(self) -> Iterator["DatasetPipeline"]:
+        """One single-epoch pipeline per epoch (ray: DatasetPipeline
+        .iter_epochs) — each epoch replays every window in order."""
+        n = self._epochs
+        i = 0
+        while n is None or i < n:
+            yield DatasetPipeline(self._windows, epochs=1)
+            i += 1
+
+    def iter_datasets(self) -> Iterator[Dataset]:
+        """Windows in epoch order, with ONE window of prefetch: window
+        N+1's fused tasks are submitted when window N is handed out, so
+        its blocks materialize while N's batches drain (the pipelining
+        ray's streaming windows provide), while total pinned memory stays
+        bounded by two windows."""
+        nxt: Optional[Dataset] = None
+        for epoch in self.iter_epochs():
+            wins = epoch._windows
+            for i, w in enumerate(wins):
+                cur = nxt if nxt is not None else self._fresh(w)
+                if i + 1 < len(wins):
+                    nxt = self._fresh(wins[i + 1])
+                    nxt._execute()  # submit ≤ window_size fused tasks now
+                else:
+                    nxt = None  # epoch boundary: no cross-epoch prefetch
+                yield cur
+
+    def iter_rows(self) -> Iterator[Any]:
+        for ds in self.iter_datasets():
+            yield from ds.iter_rows()
+
+    def iter_batches(self, **kwargs) -> Iterator[Any]:
+        """Window boundaries are batch boundaries (each window's final
+        short batch is not stitched into the next window — the reference's
+        pipeline has the same per-window batching)."""
+        for ds in self.iter_datasets():
+            yield from ds.iter_batches(**kwargs)
+
+    def iter_torch_batches(self, **kwargs) -> Iterator[Any]:
+        for ds in self.iter_datasets():
+            yield from ds.iter_torch_batches(**kwargs)
+
+    def num_windows(self) -> int:
+        return len(self._windows)
+
+    def count(self) -> int:
+        """Rows per epoch (executes every window)."""
+        return sum(w.count() for w in self._windows)
+
+    def __repr__(self):
+        e = "inf" if self._epochs is None else self._epochs
+        return f"DatasetPipeline(windows={len(self._windows)}, epochs={e})"

@@ -16,14 +16,23 @@ from ray_tpu.data.block import batch_to_rows
 from ray_tpu.data.dataset import Dataset
 
 
+def _shard_bounds(n_rows: int, parallelism: int) -> List[tuple]:
+    """Ceil-div row ranges for `parallelism` shards, dropping empties; one
+    (0, 0) shard for an empty input so every constructor yields ≥1 block.
+    (One helper — from_items/from_numpy/from_arrow sharded identically.)"""
+    if n_rows == 0:
+        return [(0, 0)]
+    n = max(1, min(parallelism, n_rows))
+    size = (n_rows + n - 1) // n
+    return [
+        (i * size, min((i + 1) * size, n_rows))
+        for i in builtins.range(n)
+        if i * size < n_rows
+    ]
+
+
 def _to_blocks(items: List[Any], parallelism: int) -> List[Any]:
-    # NB: module-level `range()` below shadows the builtin in this module.
-    n = max(1, min(parallelism, len(items) or 1))
-    size = (len(items) + n - 1) // n if items else 0
-    blocks = (
-        [items[i * size : (i + 1) * size] for i in builtins.range(n)] if items else [[]]
-    )
-    return [ray_tpu.put(b) for b in blocks if b or len(blocks) == 1]
+    return [ray_tpu.put(items[s:e]) for s, e in _shard_bounds(len(items), parallelism)]
 
 
 def from_items(items: List[Any], *, parallelism: int = 8) -> Dataset:
@@ -41,15 +50,12 @@ def from_numpy(arr, *, parallelism: int = 8) -> Dataset:
     from ray_tpu.data.block import NumpyBlock
 
     arr = np.asarray(arr)
-    n = max(1, min(parallelism, len(arr) or 1))
-    size = (len(arr) + n - 1) // n if len(arr) else 0
-    slices = [
-        arr[i * size : (i + 1) * size] for i in builtins.range(n)
-    ] if size else []
-    blocks = [NumpyBlock({"value": s}) for s in slices if len(s)] or [
-        NumpyBlock({"value": arr})
-    ]
-    return Dataset([ray_tpu.put(b) for b in blocks])
+    return Dataset(
+        [
+            ray_tpu.put(NumpyBlock({"value": arr[s:e]}))
+            for s, e in _shard_bounds(len(arr), parallelism)
+        ]
+    )
 
 
 def from_pandas(df, *, parallelism: int = 8) -> Dataset:
@@ -57,19 +63,28 @@ def from_pandas(df, *, parallelism: int = 8) -> Dataset:
 
 
 def from_arrow(table, *, parallelism: int = 8) -> Dataset:
-    return from_items(table.to_pylist(), parallelism=parallelism)
+    """Arrow-native: shards are zero-copy Table.slice views."""
+    from ray_tpu.data.block import ArrowBlock
+
+    return Dataset(
+        [
+            ray_tpu.put(ArrowBlock(table.slice(s, e - s)))
+            for s, e in _shard_bounds(table.num_rows, parallelism)
+        ]
+    )
 
 
 @ray_tpu.remote
 def _read_parquet_file(path: str, columns):
-    """Parquet → columnar NumpyBlock (stays columnar through map_batches /
-    iter_batches; ray: datasource/parquet_datasource.py reads Arrow blocks)."""
+    """Parquet → ArrowBlock: the table stays Arrow end-to-end (slice /
+    map_batches(batch_format="pyarrow") / write_parquet without a row or
+    numpy detour; ray: datasource/parquet_datasource.py reads Arrow
+    blocks and block.py treats pyarrow.Table as the native block)."""
     import pyarrow.parquet as pq
 
-    from ray_tpu.data.block import NumpyBlock
+    from ray_tpu.data.block import ArrowBlock
 
-    table = pq.read_table(path, columns=columns)
-    return NumpyBlock({name: table[name].to_numpy() for name in table.column_names})
+    return ArrowBlock(pq.read_table(path, columns=columns))
 
 
 @ray_tpu.remote

@@ -1,0 +1,184 @@
+"""Scale-envelope benchmarks (ray: release/benchmarks/ many_tasks /
+many_actors / many_pgs + scalability/single_node.json shapes).
+
+Reproduces the reference's release-qualification shapes at single-host CI
+scale and records throughputs with honest hardware caveats (the reference
+ran these on 64-node AWS clusters; this host is usually 1 vCPU):
+
+  many_actors      N actors created + first call acked, then killed
+  many_tasks       M tasks queued at once, drained through the pool
+  many_pgs         P placement groups created (ready) then removed
+  many_objects     K driver puts, then one bulk get of all K
+  broadcast        100MB object pulled by 3 isolated-store daemon nodes
+
+Run: python scripts/scale_bench.py [--actors 1000] [--tasks 10000]
+     [--pgs 200] [--objects 10000] [--output BENCH_scale.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# CPU-only workers must boot fast (no jax import via sitecustomize).
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _rss_gb() -> float:
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS"):
+                    return int(line.split()[1]) / 1024 / 1024
+    except OSError:
+        pass
+    return 0.0
+
+
+def bench_many_actors(n: int, wave: int) -> dict:
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=0.001)
+    class Tiny:
+        def ping(self):
+            return 1
+
+    t0 = time.monotonic()
+    peak_live = 0
+    created = 0
+    handles = []
+    for start in range(0, n, wave):
+        batch = [Tiny.remote() for _ in range(min(wave, n - start))]
+        ray_tpu.get([a.ping.remote() for a in batch], timeout=600)
+        created += len(batch)
+        handles.extend(batch)
+        peak_live = max(peak_live, len(handles))
+    dt = time.monotonic() - t0
+    t1 = time.monotonic()
+    for a in handles:
+        ray_tpu.kill(a)
+    kill_dt = time.monotonic() - t1
+    return {
+        "actors_created": created,
+        "actors_per_s": round(created / dt, 1),
+        "peak_live_actors": peak_live,
+        "kill_s": round(kill_dt, 1),
+    }
+
+
+def bench_many_tasks(m: int) -> dict:
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=0.5)
+    def noop(i):
+        return i
+
+    t0 = time.monotonic()
+    refs = [noop.remote(i) for i in range(m)]
+    submit_dt = time.monotonic() - t0
+    out = ray_tpu.get(refs, timeout=1200)
+    total_dt = time.monotonic() - t0
+    assert out[-1] == m - 1 and len(out) == m
+    return {
+        "tasks_queued": m,
+        "submit_per_s": round(m / submit_dt, 1),
+        "drain_per_s": round(m / total_dt, 1),
+    }
+
+
+def bench_many_pgs(p: int) -> dict:
+    import ray_tpu
+
+    t0 = time.monotonic()
+    pgs = [
+        ray_tpu.util.placement_group([{"CPU": 0.001}], strategy="PACK")
+        for _ in range(p)
+    ]
+    for pg in pgs:
+        pg.wait(timeout_seconds=120)
+    create_dt = time.monotonic() - t0
+    t1 = time.monotonic()
+    for pg in pgs:
+        ray_tpu.util.remove_placement_group(pg)
+    remove_dt = time.monotonic() - t1
+    return {
+        "pgs": p,
+        "pgs_per_s": round(p / create_dt, 1),
+        "remove_per_s": round(p / remove_dt, 1),
+    }
+
+
+def bench_many_objects(k: int) -> dict:
+    import ray_tpu
+
+    t0 = time.monotonic()
+    refs = [ray_tpu.put(i) for i in range(k)]
+    put_dt = time.monotonic() - t0
+    t1 = time.monotonic()
+    vals = ray_tpu.get(refs, timeout=600)
+    get_dt = time.monotonic() - t1
+    assert vals[k - 1] == k - 1
+    return {
+        "objects": k,
+        "puts_per_s": round(k / put_dt, 1),
+        "gets_per_s": round(k / get_dt, 1),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--actors", type=int, default=1000)
+    ap.add_argument("--actor-wave", type=int, default=200,
+                    help="actors created+acked per wave (bounds the spawn "
+                         "burst; all waves stay alive until the kill phase)")
+    ap.add_argument("--tasks", type=int, default=10000)
+    ap.add_argument("--pgs", type=int, default=200)
+    ap.add_argument("--objects", type=int, default=10000)
+    ap.add_argument("--skip-broadcast", action="store_true")
+    ap.add_argument("--output", default=None)
+    args = ap.parse_args(argv)
+
+    import ray_tpu
+
+    # Logical CPUs sized for the actor count: the envelope measures control
+    # plane + process supervision, not core count (reference runs declare
+    # the hardware alongside the numbers the same way).
+    ray_tpu.init(num_cpus=max(8, 4))
+    out = {
+        "nproc": os.cpu_count(),
+        "note": (
+            "single host; reference numbers for these shapes come from "
+            "64-node clusters (release/benchmarks/README.md)"
+        ),
+    }
+    out["many_tasks"] = bench_many_tasks(args.tasks)
+    print(json.dumps({"many_tasks": out["many_tasks"]}), flush=True)
+    out["many_objects"] = bench_many_objects(args.objects)
+    print(json.dumps({"many_objects": out["many_objects"]}), flush=True)
+    out["many_pgs"] = bench_many_pgs(args.pgs)
+    print(json.dumps({"many_pgs": out["many_pgs"]}), flush=True)
+    out["many_actors"] = bench_many_actors(args.actors, args.actor_wave)
+    out["many_actors"]["rss_gb_after"] = round(_rss_gb(), 2)
+    print(json.dumps({"many_actors": out["many_actors"]}), flush=True)
+    if not args.skip_broadcast:
+        from ray_tpu._private.ray_perf import bench_broadcast_cross_node
+
+        out["broadcast"] = bench_broadcast_cross_node(n_nodes=3, mb=100)
+        print(json.dumps({"broadcast": out["broadcast"]}), flush=True)
+    ray_tpu.shutdown()
+    line = json.dumps(out)
+    print(line)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -147,7 +147,9 @@ def test_read_parquet_csv_json(rt, tmp_path):
     ds = rd.read_parquet(str(tmp_path / "*.parquet"))
     assert ds.count() == 20
     blk = ray_tpu.get(ds._block_refs[0])
-    assert isinstance(blk, NumpyBlock)  # parquet reads columnar
+    from ray_tpu.data.block import ArrowBlock
+
+    assert isinstance(blk, ArrowBlock)  # parquet reads stay Arrow-native
     assert ds.schema() is not None
 
     csv_path = tmp_path / "t.csv"
@@ -266,3 +268,108 @@ def test_take_executes_few_blocks(rt):
     rows = ds.take(5)
     assert rows == [0, 1, 2, 3, 4]
     assert _tasks_submitted() - before <= 4, "take(5) should not run 100 tasks"
+
+
+# -- round 4: write APIs, Arrow blocks, DatasetPipeline ----------------------
+
+
+def test_write_read_parquet_roundtrip(rt, tmp_path):
+    """ray: dataset.py:2327 write_parquet — file-per-block parallel write,
+    Arrow blocks end-to-end on the read side."""
+    import ray_tpu.data as rdata
+
+    ds = rdata.from_items(
+        [{"x": i, "y": float(i) * 2} for i in range(100)], parallelism=4
+    )
+    out = str(tmp_path / "pq")
+    paths = ds.write_parquet(out)
+    assert len(paths) == 4 and all(p.endswith(".parquet") for p in paths)
+
+    back = rdata.read_parquet(out)
+    # Arrow-native blocks flow through map_batches without conversion.
+    import pyarrow as pa
+
+    def bump(t: "pa.Table"):
+        return t.set_column(0, "x", pa.array([v.as_py() + 1 for v in t["x"]]))
+
+    rows = back.map_batches(bump, batch_format="pyarrow").take_all()
+    assert sorted(r["x"] for r in rows) == list(range(1, 101))
+    assert back.count() == 100
+
+
+def test_write_csv_json_roundtrip(rt, tmp_path):
+    import ray_tpu.data as rdata
+
+    ds = rdata.from_items([{"a": i, "b": f"s{i}"} for i in range(30)], parallelism=3)
+    csv_dir, json_dir = str(tmp_path / "csv"), str(tmp_path / "json")
+    assert len(ds.write_csv(csv_dir)) == 3
+    assert len(ds.write_json(json_dir)) == 3
+    assert sorted(r["a"] for r in rdata.read_csv(csv_dir).take_all()) == list(range(30))
+    back = rdata.read_json(json_dir).take_all()
+    assert sorted(r["b"] for r in back) == sorted(f"s{i}" for i in range(30))
+
+
+def test_arrow_block_slice_and_schema(rt):
+    import pyarrow as pa
+
+    import ray_tpu.data as rdata
+
+    table = pa.table({"k": list(range(50)), "v": [f"r{i}" for i in range(50)]})
+    ds = rdata.from_arrow(table, parallelism=5)
+    assert ds.count() == 50
+    assert ds.schema() == {"k": "int64", "v": "string"}
+    # batches stay columnar; slicing crosses block bounds correctly
+    batches = list(ds.iter_batches(batch_size=15, batch_format="pyarrow"))
+    assert sum(b.num_rows for b in batches) == 50
+
+
+def test_dataset_pipeline_windows_and_epochs(rt):
+    """ray: dataset_pipeline.py:65 — windowed execution replayed per epoch."""
+    import ray_tpu.data as rdata
+
+    ds = rdata.range(40, parallelism=8)
+    pipe = ds.map(lambda x: x * 2).window(blocks_per_window=2).repeat(3)
+    assert pipe.num_windows() == 4
+    epochs = 0
+    total = []
+    for epoch in pipe.iter_epochs():
+        rows = list(epoch.iter_rows())
+        assert sorted(rows) == [x * 2 for x in range(40)]
+        total.extend(rows)
+        epochs += 1
+    assert epochs == 3 and len(total) == 120
+
+
+def test_pipeline_feeds_torch_training_across_epochs(rt):
+    """A windowed pipeline driving a real torch training loop across
+    epochs (the VERDICT item-5 'train test' — iter_torch_batches on a
+    DatasetPipeline)."""
+    import numpy as np
+    import torch
+
+    import ray_tpu.data as rdata
+
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(64, 4)).astype("float32")
+    w_true = np.array([1.0, -2.0, 3.0, 0.5], dtype="float32")
+    ys = xs @ w_true
+
+    ds = rdata.from_items(
+        [{"x": xs[i], "y": ys[i]} for i in range(64)], parallelism=8
+    )
+    pipe = ds.window(blocks_per_window=2).repeat(5)
+
+    model = torch.nn.Linear(4, 1, bias=False)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    first_loss = last_loss = None
+    for epoch in pipe.iter_epochs():
+        for batch in epoch.iter_torch_batches(batch_size=16):
+            x, y = batch["x"].float(), batch["y"].float().unsqueeze(-1)
+            loss = torch.nn.functional.mse_loss(model(x), y)
+            opt.zero_grad()
+            loss.backward()
+            opt.step()
+            if first_loss is None:
+                first_loss = float(loss)
+            last_loss = float(loss)
+    assert last_loss < first_loss * 0.2, (first_loss, last_loss)
