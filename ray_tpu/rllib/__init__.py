@@ -31,6 +31,8 @@ from ray_tpu.rllib.multi_agent import (
     MultiAgentPPOConfig,
     MultiAgentVectorEnv,
 )
+from ray_tpu.rllib.offline import OfflineData, write_experiences
+from ray_tpu.rllib.policy_client import PolicyClient, PolicyServer
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae
 
@@ -48,8 +50,11 @@ __all__ = [
     "MultiAgentPPO",
     "MultiAgentPPOConfig",
     "MultiAgentVectorEnv",
+    "OfflineData",
     "PPO",
     "PPOConfig",
+    "PolicyClient",
+    "PolicyServer",
     "SampleBatch",
     "VectorEnv",
     "apply_policy",
@@ -58,4 +63,5 @@ __all__ = [
     "make_vector_env",
     "register_env",
     "vtrace",
+    "write_experiences",
 ]

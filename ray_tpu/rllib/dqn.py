@@ -125,9 +125,19 @@ class DQNConfig:
         self.epsilon_decay_iters = 30
         self.hidden = (64, 64)
         self.seed = 0
+        # Offline training (ray: AlgorithmConfig.offline_data): when set,
+        # no env runners spawn and the replay buffer is bulk-loaded from
+        # the logged dataset — training never steps an environment.
+        self.offline_input = None
 
     def environment(self, env) -> "DQNConfig":
         self.env = env
+        return self
+
+    def offline_data(self, input_) -> "DQNConfig":
+        """input_: parquet path(s) from offline.write_experiences, or a
+        ray_tpu.data Dataset with the experience columns."""
+        self.offline_input = input_
         return self
 
     def env_runners(self, num_env_runners=1, num_envs_per_runner=8, rollout_length=32):
@@ -153,8 +163,8 @@ class DQNConfig:
         return self
 
     def build(self) -> "DQN":
-        if self.env is None:
-            raise ValueError("call .environment(env) first")
+        if self.env is None and self.offline_input is None:
+            raise ValueError("call .environment(env) or .offline_data(...) first")
         return DQN(self)
 
 
@@ -222,25 +232,49 @@ class DQN:
     def __init__(self, config: DQNConfig):
         self.config = config
         ray_tpu.init(ignore_reinit_error=True)
-        probe = make_vector_env(config.env, 1, seed=0)
-        self._obs_size = probe.observation_size
-        self._num_actions = probe.num_actions
+        self.offline = None
+        if config.offline_input is not None:
+            # Offline mode (ray: offline/dataset_reader.py): shapes come
+            # from the logged data; training steps NO environment.
+            from ray_tpu.rllib.offline import OfflineData
+
+            self.offline = OfflineData(config.offline_input)
+            self._obs_size = self.offline.obs_size
+            self._num_actions = self.offline.num_actions
+        else:
+            probe = make_vector_env(config.env, 1, seed=0)
+            self._obs_size = probe.observation_size
+            self._num_actions = probe.num_actions
         init_state, self._update, self._sync = _make_learner(
             config, self._obs_size, self._num_actions
         )
         self._state = init_state(config.seed)
-        self.buffer = ReplayBuffer(config.buffer_capacity, self._obs_size)
+        capacity = config.buffer_capacity
+        if self.offline is not None:
+            # The buffer must hold the WHOLE logged dataset — ring-wrapping
+            # would silently train on only the last `capacity` rows.
+            capacity = max(capacity, self.offline.size)
+        self.buffer = ReplayBuffer(capacity, self._obs_size)
         self._rng = np.random.default_rng(config.seed)
-        Runner = ray_tpu.remote(_DQNRunner)
-        self.runners = [
-            Runner.remote(
-                config.env,
-                config.num_envs_per_runner,
-                config.seed + 997 * (i + 1),
-            )
-            for i in range(config.num_env_runners)
-        ]
-        ray_tpu.get([r.ping.remote() for r in self.runners], timeout=120)
+        self.runners = []
+        if self.offline is None:
+            Runner = ray_tpu.remote(_DQNRunner)
+            self.runners = [
+                Runner.remote(
+                    config.env,
+                    config.num_envs_per_runner,
+                    config.seed + 997 * (i + 1),
+                )
+                for i in range(config.num_env_runners)
+            ]
+            ray_tpu.get([r.ping.remote() for r in self.runners], timeout=120)
+        else:
+            self.offline.fill_buffer(self.buffer)
+            # Release the reader's materialized copy: the buffer holds the
+            # data now; keeping both doubles resident memory for the run.
+            self.offline._cols = None
+        self._eval_runner = None
+        self._eval_env = None
         self.iteration = 0
         self._total_steps = 0
         self._episode_returns: List[float] = []
@@ -249,6 +283,22 @@ class DQN:
         import jax
 
         return jax.tree_util.tree_map(np.asarray, self._state["params"])
+
+    def compute_single_action(self, obs, explore: bool = True) -> int:
+        """One action for one observation (the PolicyServer inference
+        hook; ray: Algorithm.compute_single_action).  explore=True applies
+        the current epsilon schedule."""
+        import jax
+        import jax.numpy as jnp
+
+        if not hasattr(self, "_single_apply"):
+            from ray_tpu.rllib.policy import apply_policy
+
+            self._single_apply = jax.jit(lambda p, o: apply_policy(p, o)[0])
+        if explore and self._rng.random() < self._epsilon():
+            return int(self._rng.integers(0, self._num_actions))
+        q = self._single_apply(self._state["params"], jnp.asarray(obs)[None, :])
+        return int(np.asarray(q)[0].argmax())
 
     def _epsilon(self) -> float:
         c = self.config
@@ -261,18 +311,19 @@ class DQN:
         c = self.config
         t0 = time.time()
         eps = self._epsilon()
-        w_ref = ray_tpu.put(self.get_weights())
-        outs = ray_tpu.get(
-            [r.collect.remote(w_ref, c.rollout_length, eps) for r in self.runners],
-            timeout=300,
-        )
-        for o in outs:
-            self.buffer.add_batch(
-                o["obs"], o["actions"], o["rewards"], o["next_obs"], o["dones"]
+        if self.runners:
+            w_ref = ray_tpu.put(self.get_weights())
+            outs = ray_tpu.get(
+                [r.collect.remote(w_ref, c.rollout_length, eps) for r in self.runners],
+                timeout=300,
             )
-            self._episode_returns.extend(o["episode_returns"])
-            self._total_steps += o["steps"]
-        self._episode_returns = self._episode_returns[-100:]
+            for o in outs:
+                self.buffer.add_batch(
+                    o["obs"], o["actions"], o["rewards"], o["next_obs"], o["dones"]
+                )
+                self._episode_returns.extend(o["episode_returns"])
+                self._total_steps += o["steps"]
+            self._episode_returns = self._episode_returns[-100:]
 
         loss = 0.0
         if self.buffer.size >= c.learn_batch_size:
@@ -301,6 +352,42 @@ class DQN:
             "time_this_iter_s": time.time() - t0,
         }
 
+    def evaluate(self, *, num_steps: int = 500, env=None) -> Dict[str, Any]:
+        """Greedy-policy evaluation on a DEDICATED eval runner actor —
+        separate from the training runners, so evaluation never perturbs
+        the epsilon-greedy collection stream (ray: evaluation_config /
+        evaluation_num_workers split).  Offline-trained algorithms pass
+        `env` (or set config.env) to measure the learned policy."""
+        env = env or self.config.env
+        if env is None:
+            raise ValueError("evaluate() needs an env (config.env or env=)")
+        if self._eval_runner is None or self._eval_env is not env:
+            if self._eval_runner is not None:
+                try:
+                    ray_tpu.kill(self._eval_runner)
+                except Exception:
+                    pass
+            Runner = ray_tpu.remote(_DQNRunner)
+            self._eval_runner = Runner.remote(
+                env, self.config.num_envs_per_runner, self.config.seed + 31337
+            )
+            self._eval_env = env
+            ray_tpu.get(self._eval_runner.ping.remote(), timeout=120)
+        w_ref = ray_tpu.put(self.get_weights())
+        out = ray_tpu.get(
+            self._eval_runner.collect.remote(w_ref, num_steps, 0.0), timeout=300
+        )
+        returns = out["episode_returns"]
+        return {
+            "evaluation": {
+                "episode_reward_mean": (
+                    float(np.mean(returns)) if returns else 0.0
+                ),
+                "episodes": len(returns),
+                "num_env_steps": out["steps"],
+            }
+        }
+
     def save(self, path: Optional[str] = None) -> str:
         import jax
 
@@ -322,9 +409,12 @@ class DQN:
         self.iteration = d["iteration"]
 
     def stop(self) -> None:
-        for r in self.runners:
+        for r in self.runners + (
+            [self._eval_runner] if self._eval_runner is not None else []
+        ):
             try:
                 ray_tpu.kill(r)
             except Exception:
                 pass
         self.runners = []
+        self._eval_runner = None

@@ -1,0 +1,126 @@
+"""Offline RL: train from logged experience, no environment stepping.
+
+ray: rllib/offline/dataset_reader.py (DatasetReader feeding an algorithm
+from a ray.data Dataset of logged transitions) + dataset_writer.py /
+json_writer.py (experience logging).  TPU-first shape: experiences are
+columnar — a parquet round-trip of {obs, actions, rewards, next_obs,
+dones} arrays feeds the learner's jitted scanned updates exactly like a
+live replay buffer; there is no per-row Python in the path.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+
+def write_experiences(batch: Dict[str, np.ndarray], path: str,
+                      *, parallelism: int = 4) -> List[str]:
+    """Log a batch of transitions to parquet files (ray: dataset/json
+    writer output_config).  `batch` columns: obs [N, D] float, actions [N]
+    int, rewards [N] float, next_obs [N, D] float, dones [N] float/bool.
+    Observation rows are flattened per-component columns so the parquet
+    schema stays scalar-typed."""
+    import pyarrow as pa
+
+    import ray_tpu.data as rdata
+
+    _n, d = np.asarray(batch["obs"]).shape
+    cols: Dict[str, np.ndarray] = {}
+    obs = np.asarray(batch["obs"], np.float32)
+    nxt = np.asarray(batch["next_obs"], np.float32)
+    for j in range(d):
+        cols[f"obs_{j}"] = obs[:, j]
+        cols[f"next_obs_{j}"] = nxt[:, j]
+    cols["actions"] = np.asarray(batch["actions"], np.int64)
+    cols["rewards"] = np.asarray(batch["rewards"], np.float32)
+    cols["dones"] = np.asarray(batch["dones"], np.float32)
+    # Columnar end-to-end: numpy -> Arrow table -> zero-copy table-slice
+    # shards -> parquet, no per-row Python objects anywhere.
+    ds = rdata.from_arrow(pa.table(cols), parallelism=parallelism)
+    return ds.write_parquet(path)
+
+
+class OfflineData:
+    """Reader over logged experiences (ray: offline/dataset_reader.py:
+    DatasetReader.next() serving train batches from a data Dataset).
+
+    Accepts parquet paths (as written by write_experiences) or any
+    ray_tpu.data Dataset with the same columns.
+    """
+
+    def __init__(self, source):
+        import ray_tpu.data as rdata
+
+        if isinstance(source, (str, list)):
+            self.dataset = rdata.read_parquet(source)
+        else:
+            self.dataset = source
+        self._cols: Optional[Dict[str, np.ndarray]] = None
+
+    def _materialize(self) -> Dict[str, np.ndarray]:
+        if self._cols is None:
+            batches = list(self.dataset.iter_batches(batch_size=65536))
+            keys = batches[0].keys()
+            merged = {
+                k: np.concatenate([np.asarray(b[k]) for b in batches])
+                for k in keys
+            }
+            obs_keys = sorted(
+                (k for k in merged if k.startswith("obs_")),
+                key=lambda k: int(k.split("_")[1]),
+            )
+            nxt_keys = sorted(
+                (k for k in merged if k.startswith("next_obs_")),
+                key=lambda k: int(k.split("_")[2]),
+            )
+            self._cols = {
+                "obs": np.stack([merged[k] for k in obs_keys], axis=1).astype(
+                    np.float32
+                ),
+                "next_obs": np.stack(
+                    [merged[k] for k in nxt_keys], axis=1
+                ).astype(np.float32),
+                "actions": merged["actions"].astype(np.int64),
+                "rewards": merged["rewards"].astype(np.float32),
+                "dones": merged["dones"].astype(np.float32),
+            }
+        return self._cols
+
+    @property
+    def size(self) -> int:
+        return len(self._materialize()["actions"])
+
+    @property
+    def obs_size(self) -> int:
+        return self._materialize()["obs"].shape[1]
+
+    @property
+    def num_actions(self) -> int:
+        return int(self._materialize()["actions"].max()) + 1
+
+    def fill_buffer(self, buffer) -> int:
+        """Bulk-load into a ReplayBuffer (the offline algorithms sample
+        minibatches from it exactly like live replay)."""
+        c = self._materialize()
+        buffer.add_batch(
+            c["obs"], c["actions"], c["rewards"], c["next_obs"], c["dones"]
+        )
+        return len(c["actions"])
+
+    def iter_batches(self, batch_size: int, *, seed: int = 0,
+                     epochs: Optional[int] = 1) -> Iterator[Dict[str, Any]]:
+        """Shuffled minibatch iterator (for algorithms that stream rather
+        than replay)."""
+        c = self._materialize()
+        n = len(c["actions"])
+        rng = np.random.default_rng(seed)
+        e = 0
+        while epochs is None or e < epochs:
+            order = rng.permutation(n)
+            for i in range(0, n - batch_size + 1, batch_size):
+                idx = order[i : i + batch_size]
+                yield {k: v[idx] for k, v in c.items()}
+            e += 1

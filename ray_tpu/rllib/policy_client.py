@@ -1,0 +1,204 @@
+"""External-env policy serving: client/server action round-trips over TCP.
+
+ray: rllib/env/policy_client.py:58 + policy_server_input.py — environments
+that CANNOT be stepped by the framework (simulators behind their own
+process/machine boundary, live systems) drive the loop themselves: they
+request actions from a PolicyServer and log rewards back; the server
+assembles the resulting transitions into training input.
+
+Wire protocol: authkey-authenticated multiprocessing.connection (the same
+transport the rest of the control plane uses), one request tuple per
+round-trip.  Inference runs the algorithm's current weights server-side;
+completed transitions accumulate in a thread-safe buffer the training loop
+drains (the analogue of PolicyServerInput feeding an algorithm's sampler).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class PolicyServer:
+    """Serves get_action/log_returns/end_episode to external envs.
+
+    `compute_action(obs, explore) -> int` is the inference hook (the
+    algorithm's current policy, e.g. DQN.compute_single_action).
+    """
+
+    def __init__(self, compute_action, host: str = "127.0.0.1", port: int = 0,
+                 authkey: bytes = b"raytpu-policy"):
+        from multiprocessing.connection import Listener
+
+        self._compute = compute_action
+        self._authkey = authkey
+        self._listener = Listener((host, port), backlog=16, authkey=authkey)
+        self.address: Tuple[str, int] = (host, self._listener.address[1])
+        self._lock = threading.Lock()
+        self._episodes: Dict[str, dict] = {}
+        self._eid = 0
+        self._transitions: List[tuple] = []
+        self._shutdown = False
+        threading.Thread(
+            target=self._accept_loop, daemon=True, name="policy-server"
+        ).start()
+
+    # -- experience intake ---------------------------------------------------
+
+    def _record(self, ep: dict, next_obs, done: float) -> None:
+        self._transitions.append(
+            (ep["obs"], ep["action"], ep["reward"], next_obs, done)
+        )
+        ep["obs"] = None
+        ep["reward"] = 0.0
+
+    def samples_ready(self) -> int:
+        with self._lock:
+            return len(self._transitions)
+
+    def drain(self) -> Optional[Dict[str, np.ndarray]]:
+        """Completed transitions as a columnar batch (feed it to a replay
+        buffer: buffer.add_batch(**drain()) — the PolicyServerInput role)."""
+        with self._lock:
+            if not self._transitions:
+                return None
+            ts = self._transitions
+            self._transitions = []
+        obs, actions, rewards, next_obs, dones = zip(*ts)
+        return {
+            "obs": np.asarray(obs, np.float32),
+            "actions": np.asarray(actions, np.int64),
+            "rewards": np.asarray(rewards, np.float32),
+            "next_obs": np.asarray(next_obs, np.float32),
+            "dones": np.asarray(dones, np.float32),
+        }
+
+    # -- wire ----------------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):
+                if self._shutdown:
+                    return
+                continue
+            except Exception:
+                continue  # failed auth handshake from a stranger
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn) -> None:
+        while True:
+            try:
+                msg = conn.recv()
+            except (OSError, EOFError):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+            try:
+                out = self._handle(msg)
+            except Exception as e:  # noqa: BLE001 — a failing inference
+                # hook (bad obs shape, jax error) must surface to the
+                # client as an error reply, not kill this thread and hang
+                # the external env inside a recv with no timeout.
+                out = ("error", f"{type(e).__name__}: {e}")
+            try:
+                conn.send(out)
+            except (OSError, EOFError):
+                return
+
+    def _handle(self, msg: tuple):
+        kind = msg[0]
+        with self._lock:
+            if kind == "start_episode":
+                self._eid += 1
+                eid = f"ep-{self._eid}"
+                self._episodes[eid] = {"obs": None, "action": None, "reward": 0.0}
+                return eid
+            ep = self._episodes.get(msg[1])
+            if ep is None:
+                return ("error", f"unknown episode {msg[1]}")
+            if kind == "get_action":
+                obs = np.asarray(msg[2], np.float32)
+                if ep["obs"] is not None:
+                    self._record(ep, obs, 0.0)
+            elif kind == "log_returns":
+                ep["reward"] += float(msg[2])
+                return "ok"
+            elif kind == "end_episode":
+                if ep["obs"] is not None:
+                    # truncated episodes bootstrap (done=0): a time-limit
+                    # cut is not a terminal state (same convention as the
+                    # internal runners' `terminated`-only done flag).
+                    truncated = bool(msg[3]) if len(msg) > 3 else False
+                    self._record(
+                        ep, np.asarray(msg[2], np.float32),
+                        0.0 if truncated else 1.0,
+                    )
+                self._episodes.pop(msg[1], None)
+                return "ok"
+            else:
+                return ("error", f"unknown request {kind!r}")
+        # get_action inference runs OUTSIDE the lock: one slow forward (or
+        # the first-call jit compile) must not stall every other client's
+        # round-trip or the trainer's drain().  Episodes are driven
+        # sequentially by their own env process, so the unlocked window
+        # cannot interleave two actions of one episode.
+        action = int(self._compute(obs, bool(msg[3])))
+        with self._lock:
+            ep["obs"], ep["action"] = obs, action
+        return action
+
+    def close(self) -> None:
+        self._shutdown = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+class PolicyClient:
+    """Driven by the external environment process
+    (ray: rllib/env/policy_client.py:58 — same four-call surface)."""
+
+    def __init__(self, address: Tuple[str, int],
+                 authkey: bytes = b"raytpu-policy", timeout: float = 30.0):
+        from ray_tpu._private.object_plane import _connect_with_deadline
+
+        self._conn = _connect_with_deadline(tuple(address), authkey, timeout)
+        self._lock = threading.Lock()
+
+    def _call(self, *msg):
+        with self._lock:
+            self._conn.send(msg)
+            out = self._conn.recv()
+        if isinstance(out, tuple) and out and out[0] == "error":
+            raise RuntimeError(out[1])
+        return out
+
+    def start_episode(self) -> str:
+        return self._call("start_episode", None)
+
+    def get_action(self, episode_id: str, observation, explore: bool = True) -> int:
+        return self._call("get_action", episode_id, np.asarray(observation), explore)
+
+    def log_returns(self, episode_id: str, reward: float) -> None:
+        self._call("log_returns", episode_id, float(reward))
+
+    def end_episode(self, episode_id: str, observation,
+                    truncated: bool = False) -> None:
+        """truncated=True marks a time-limit cut (the final transition
+        bootstraps rather than terminating)."""
+        self._call("end_episode", episode_id, np.asarray(observation), truncated)
+
+    def close(self) -> None:
+        try:
+            self._conn.close()
+        except OSError:
+            pass

@@ -325,3 +325,25 @@ def test_pb2_gp_explore_within_bounds(tune_cluster):
         assert 0.05 <= t.config["lr"] <= 2.0
     scores = sorted(t.last_result["score"] for t in results.trials)
     assert scores[0] > 0.05 * 30  # the slow config alone reaches ~1.5
+
+
+def test_pb2_gp_targets_known_optimum(tune_cluster):
+    """Regression for the GP-bandit explore itself: given observations of
+    a deterministic improvement landscape peaking at lr*=0.5, PB2's UCB
+    choices must concentrate near the optimum far tighter than uniform
+    exploration — a silent regression to random picks fails this."""
+    import numpy as np
+
+    pb2 = tune.PB2(hyperparam_bounds={"lr": (0.0, 1.0)}, seed=7)
+    for x in np.linspace(0.0, 1.0, 40):
+        pb2._gp_data.append(([float(x)], float(-((x - 0.5) ** 2))))
+
+    picks = []
+    for _ in range(12):
+        choice = pb2._gp_choose()
+        assert choice is not None and 0.0 <= choice["lr"] <= 1.0
+        picks.append(choice["lr"])
+    gp_dist = float(np.mean([abs(p - 0.5) for p in picks]))
+    rng = np.random.default_rng(7)
+    uniform_dist = float(np.mean(np.abs(rng.uniform(0, 1, 200) - 0.5)))
+    assert gp_dist < uniform_dist * 0.5, (gp_dist, uniform_dist)
