@@ -6,8 +6,17 @@ MFU / 0.45 — the north-star target from BASELINE.json ("Llama-7B DDP at
 >=45% MFU"); the reference itself has no TPU numbers to compare against
 (SURVEY.md §6: GPU-only).
 
+The long-context sweep re-measures the SAME model at seq 2048 and 4096
+(constant tokens/step — batch halves as sequence doubles), the regime
+where the flash-attention backward and remat policy earn their keep:
+`seq_sweep` reports both the 6ND parameter-MFU (comparable to the
+headline; it does not credit the quadratic attention work) and an
+attention-inclusive MFU (adds 12*L*d*seq flops/token for the score/value
+matmuls, fwd+bwd).
+
 Model is scaled to fit one chip's HBM (the driver runs single-chip); the
-multi-chip path is exercised by __graft_entry__.dryrun_multichip.
+multi-chip path — including ring attention over a seq-sharded mesh — is
+exercised by __graft_entry__.dryrun_multichip and tests/test_ops_attention.
 """
 
 from __future__ import annotations
@@ -27,46 +36,14 @@ PEAK_BF16_FLOPS = {
 }
 
 
-def main():
+def _measure(cfg, mesh, batch_size: int, seq: int, steps: int, peak: float):
     import jax
     import jax.numpy as jnp
 
-    from ray_tpu.models import LMTrainContext, TransformerConfig
-    from ray_tpu.parallel import MeshSpec, build_mesh
+    from ray_tpu.models import LMTrainContext
 
-    dev = jax.devices()[0]
-    peak = next(
-        (v for k, v in PEAK_BF16_FLOPS.items() if dev.device_kind.startswith(k)),
-        197e12,
-    )
-
-    # ~940M params: the widest llama-family shape that fits v5e HBM (16G)
-    # with bf16 params + f32 adam moments.  d_model=2048 maps onto the MXU
-    # far better than deeper/narrower configs (measured: d1536/L24 -> 0.46
-    # MFU, d2048/L16 -> 0.51 on v5e).  remat saves post-rope q/k/v + the
-    # flash-attention output, recomputing only the cheap matmuls in bwd.
-    # bs16 x seq1024 beats bs8 x seq2048 at equal tokens/step (0.578 vs
-    # 0.518 measured): half the quadratic attention work per token, which
-    # the 6ND accounting below doesn't credit.  remat=False and larger
-    # batches OOM at this width.
-    cfg = TransformerConfig(
-        vocab_size=32000,
-        d_model=2048,
-        n_layers=16,
-        n_heads=16,
-        n_kv_heads=16,
-        d_ff=5504,
-        max_seq_len=1024,
-        param_dtype=jnp.bfloat16,
-        remat=True,
-        remat_policy="qkv_attn",
-    )
-    batch_size, seq = 16, 1024
-
-    mesh = build_mesh(MeshSpec(data=1), devices=[dev])
     ctx = LMTrainContext(cfg, mesh=mesh, strategy="dp")
     state = ctx.init_state(seed=0)
-
     key = jax.random.PRNGKey(1)
     toks = jax.random.randint(key, (batch_size, seq + 1), 0, cfg.vocab_size)
     batch = {"tokens": toks[:, :-1], "targets": toks[:, 1:]}
@@ -77,7 +54,6 @@ def main():
         state, metrics = ctx.train_step(state, batch)
     float(metrics["loss"])
 
-    steps = 10
     t0 = time.perf_counter()
     for _ in range(steps):
         state, metrics = ctx.train_step(state, batch)
@@ -89,19 +65,86 @@ def main():
     tokens_per_s = steps * batch_size * seq / dt
     n_params = cfg.num_params()
     # 6ND fwd+bwd (+remat recompute ≈ 8ND counted conservatively as 6ND)
-    model_flops = 6 * n_params * tokens_per_s
-    mfu = model_flops / peak
+    param_flops_per_tok = 6 * n_params
+    # score/value matmuls: 4*L*d*seq fwd per token, x3 for fwd+bwd
+    attn_flops_per_tok = 12 * cfg.n_layers * cfg.d_model * seq
+    del state
+    return {
+        "tokens_per_s": round(tokens_per_s, 1),
+        "mfu": round(param_flops_per_tok * tokens_per_s / peak, 4),
+        "mfu_attn_incl": round(
+            (param_flops_per_tok + attn_flops_per_tok) * tokens_per_s / peak, 4
+        ),
+    }
 
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import TransformerConfig
+    from ray_tpu.parallel import MeshSpec, build_mesh
+
+    t_start = time.perf_counter()
+    dev = jax.devices()[0]
+    peak = next(
+        (v for k, v in PEAK_BF16_FLOPS.items() if dev.device_kind.startswith(k)),
+        197e12,
+    )
+    mesh = build_mesh(MeshSpec(data=1), devices=[dev])
+
+    # ~940M params: the widest llama-family shape that fits v5e HBM (16G)
+    # with bf16 params + f32 adam moments.  d_model=2048 maps onto the MXU
+    # far better than deeper/narrower configs (measured: d1536/L24 -> 0.46
+    # MFU, d2048/L16 -> 0.51 on v5e).  remat saves post-rope q/k/v + the
+    # flash-attention output, recomputing only the cheap matmuls in bwd.
+    # bs16 x seq1024 beats bs8 x seq2048 at equal tokens/step (0.578 vs
+    # 0.518 measured): half the quadratic attention work per token, which
+    # the 6ND accounting doesn't credit (mfu_attn_incl does).
+    def make_cfg(seq_len: int) -> TransformerConfig:
+        return TransformerConfig(
+            vocab_size=32000,
+            d_model=2048,
+            n_layers=16,
+            n_heads=16,
+            n_kv_heads=16,
+            d_ff=5504,
+            max_seq_len=seq_len,
+            param_dtype=jnp.bfloat16,
+            remat=True,
+            remat_policy="qkv_attn",
+        )
+
+    head = _measure(make_cfg(1024), mesh, 16, 1024, steps=10, peak=peak)
+
+    # Long-context sweep: constant 16k tokens/step.  Guarded by wall-clock
+    # (the driver caps the bench run): skip remaining points if compiles
+    # already ate the budget.
+    sweep = {}
+    for bs, seq in ((8, 2048), (4, 4096)):
+        if time.perf_counter() - t_start > 420:
+            sweep[str(seq)] = {"skipped": "bench time budget"}
+            continue
+        try:
+            sweep[str(seq)] = _measure(
+                make_cfg(seq), mesh, bs, seq, steps=6, peak=peak
+            )
+        except Exception as e:  # noqa: BLE001 — a sweep point must not
+            # take down the headline number
+            sweep[str(seq)] = {"error": f"{type(e).__name__}: {e}"}
+
+    n_params = make_cfg(1024).num_params()
     print(
         json.dumps(
             {
                 "metric": "train_tokens_per_sec_per_chip",
-                "value": round(tokens_per_s, 1),
+                "value": head["tokens_per_s"],
                 "unit": "tokens/s",
-                "vs_baseline": round(mfu / 0.45, 4),
-                "mfu": round(mfu, 4),
+                "vs_baseline": round(head["mfu"] / 0.45, 4),
+                "mfu": head["mfu"],
                 "n_params": n_params,
                 "device": dev.device_kind,
+                "seq_sweep": sweep,
             }
         )
     )

@@ -20,7 +20,7 @@ from ray_tpu.parallel.collectives import (
     reducescatter,
     send,
 )
-from ray_tpu.parallel.pipeline import pipeline_apply
+from ray_tpu.parallel.pipeline import pipeline_apply, pipeline_train_step_1f1b
 from ray_tpu.parallel.mesh import (
     AXIS_ORDER,
     MeshSpec,
@@ -56,6 +56,7 @@ __all__ = [
     "logical_to_spec",
     "mesh_axis_sizes",
     "pipeline_apply",
+    "pipeline_train_step_1f1b",
     "pick_coordinator_address",
     "recv",
     "reducescatter",

@@ -239,8 +239,21 @@ class DQN:
             from ray_tpu.rllib.offline import OfflineData
 
             self.offline = OfflineData(config.offline_input)
-            self._obs_size = self.offline.obs_size
-            self._num_actions = self.offline.num_actions
+            if config.env is not None:
+                # The env's declared action space beats inference from the
+                # logged actions (a behavior policy that never emitted some
+                # action would silently shrink the Q head).
+                probe = make_vector_env(config.env, 1, seed=0)
+                self._obs_size = probe.observation_size
+                self._num_actions = probe.num_actions
+                if self.offline.obs_size != self._obs_size:
+                    raise ValueError(
+                        f"offline data obs dim {self.offline.obs_size} != "
+                        f"env obs dim {self._obs_size}"
+                    )
+            else:
+                self._obs_size = self.offline.obs_size
+                self._num_actions = self.offline.num_actions
         else:
             probe = make_vector_env(config.env, 1, seed=0)
             self._obs_size = probe.observation_size
@@ -256,6 +269,13 @@ class DQN:
             capacity = max(capacity, self.offline.size)
         self.buffer = ReplayBuffer(capacity, self._obs_size)
         self._rng = np.random.default_rng(config.seed)
+        # Serializes the shared RNG (and lazy jit init) between the train
+        # loop and PolicyServer inference threads — numpy Generators are
+        # not thread-safe.
+        import threading as _threading
+
+        self._action_lock = _threading.Lock()
+        self._single_apply = None
         self.runners = []
         if self.offline is None:
             Runner = ray_tpu.remote(_DQNRunner)
@@ -287,17 +307,26 @@ class DQN:
     def compute_single_action(self, obs, explore: bool = True) -> int:
         """One action for one observation (the PolicyServer inference
         hook; ray: Algorithm.compute_single_action).  explore=True applies
-        the current epsilon schedule."""
+        the current epsilon schedule.
+
+        Thread-safe: the PolicyServer calls this concurrently from its
+        connection threads while the training loop samples the replay
+        buffer — the shared numpy Generator (not thread-safe) and the lazy
+        jit init are serialized under a dedicated lock."""
         import jax
         import jax.numpy as jnp
 
-        if not hasattr(self, "_single_apply"):
-            from ray_tpu.rllib.policy import apply_policy
+        with self._action_lock:
+            if self._single_apply is None:
+                from ray_tpu.rllib.policy import apply_policy
 
-            self._single_apply = jax.jit(lambda p, o: apply_policy(p, o)[0])
-        if explore and self._rng.random() < self._epsilon():
-            return int(self._rng.integers(0, self._num_actions))
-        q = self._single_apply(self._state["params"], jnp.asarray(obs)[None, :])
+                self._single_apply = jax.jit(
+                    lambda p, o: apply_policy(p, o)[0]
+                )
+            if explore and self._rng.random() < self._epsilon():
+                return int(self._rng.integers(0, self._num_actions))
+            params = self._state["params"]
+        q = self._single_apply(params, jnp.asarray(obs)[None, :])
         return int(np.asarray(q)[0].argmax())
 
     def _epsilon(self) -> float:
@@ -328,11 +357,13 @@ class DQN:
         loss = 0.0
         if self.buffer.size >= c.learn_batch_size:
             # One stacked [U, B, ...] transfer + one scanned dispatch for
-            # the whole iteration's updates.
-            stacked = [
-                self.buffer.sample(c.learn_batch_size, self._rng)
-                for _ in range(c.updates_per_iteration)
-            ]
+            # the whole iteration's updates.  (RNG under the action lock:
+            # PolicyServer threads share this Generator.)
+            with self._action_lock:
+                stacked = [
+                    self.buffer.sample(c.learn_batch_size, self._rng)
+                    for _ in range(c.updates_per_iteration)
+                ]
             batches = tuple(
                 jnp.asarray(np.stack([s[i] for s in stacked])) for i in range(5)
             )
@@ -361,7 +392,7 @@ class DQN:
         env = env or self.config.env
         if env is None:
             raise ValueError("evaluate() needs an env (config.env or env=)")
-        if self._eval_runner is None or self._eval_env is not env:
+        if self._eval_runner is None or self._eval_env != env:
             if self._eval_runner is not None:
                 try:
                     ray_tpu.kill(self._eval_runner)

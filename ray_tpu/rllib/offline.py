@@ -63,6 +63,10 @@ class OfflineData:
     def _materialize(self) -> Dict[str, np.ndarray]:
         if self._cols is None:
             batches = list(self.dataset.iter_batches(batch_size=65536))
+            if not batches:
+                raise ValueError(
+                    "offline experience dataset is empty — nothing to train on"
+                )
             keys = batches[0].keys()
             merged = {
                 k: np.concatenate([np.asarray(b[k]) for b in batches])
