@@ -70,9 +70,10 @@ def attach(
         key = bytes.fromhex(authkey)
         port = int(port)
 
-    conn = Client((host, port), authkey=key)
+    from ray_tpu._private import wire
     from ray_tpu._private.netutil import set_nodelay
 
+    conn = wire.connect((host, port), key)
     set_nodelay(conn)
     did = ids._fresh("drv")
     conn.send(("driver", did, os.getpid()))

@@ -112,10 +112,11 @@ def main() -> None:
     log_dir = f"/tmp/raytpu-logs-{session}-{node_id}"
     send_lock = threading.Lock()
 
+    from ray_tpu._private import wire
     from ray_tpu._private.netutil import set_nodelay
 
     def connect():
-        c = Client((host, port), authkey=authkey)
+        c = wire.connect((host, port), authkey)
         set_nodelay(c)
         c.send(
             (

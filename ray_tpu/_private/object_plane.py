@@ -127,6 +127,7 @@ class ObjectServer:
 
     def _accept_loop(self) -> None:
         from ray_tpu._private.netutil import set_nodelay
+        from ray_tpu._private.wire import wrap
 
         while not self._shutdown:
             try:
@@ -135,9 +136,11 @@ class ObjectServer:
                 if self._shutdown:
                     return
                 continue
+            except Exception:
+                continue  # stranger failed the auth challenge
             set_nodelay(conn)
             threading.Thread(
-                target=self._serve_one, args=(conn,), daemon=True,
+                target=self._serve_one, args=(wrap(conn),), daemon=True,
                 name="raytpu-objserve-conn",
             ).start()
 
@@ -180,7 +183,9 @@ def _connect_with_deadline(endpoint: Tuple[str, int], authkey: bytes, timeout: f
     except BaseException:
         conn.close()
         raise
-    return conn
+    from ray_tpu._private.wire import wrap
+
+    return wrap(conn)
 
 
 def _raw_chunks(conn, total: int, deadline: float):

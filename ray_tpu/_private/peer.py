@@ -91,8 +91,10 @@ class PeerServer:
         peer) raises AuthenticationError — which must not kill the accept
         loop (that would silently disable this worker's direct transport
         for the rest of its life)."""
+        from ray_tpu._private.wire import wrap
+
         try:
-            return self.listener.accept()
+            return wrap(self.listener.accept())
         except (OSError, EOFError):
             raise
         except Exception:

@@ -1030,6 +1030,7 @@ class Runtime:
         # "ready" on its main conn, so a serial accept loop would deadlock
         # (blocked recv'ing the main conn's handshake while the fetch conn
         # waits for service).
+        from ray_tpu._private import wire
         from ray_tpu._private.netutil import set_nodelay
 
         while not self._shutdown:
@@ -1039,15 +1040,29 @@ class Runtime:
                 if self._shutdown:
                     return
                 continue
+            except Exception:
+                continue  # stranger failed the auth challenge
             set_nodelay(conn)
             threading.Thread(
-                target=self._handshake, args=(conn,), daemon=True,
+                target=self._handshake, args=(wire.wrap(conn),), daemon=True,
                 name="raytpu-handshake",
             ).start()
 
     def _handshake(self, conn) -> None:
+        from ray_tpu._private.wire import PROTOCOL_VERSION, ProtocolError
+
         try:
             first = conn.recv()
+        except ProtocolError as e:
+            # Version/schema mismatch: tell the peer WHY before closing —
+            # the clean rejection the raw-pickle plane never had
+            # (ray: gRPC status + proto version negotiation).
+            try:
+                conn.send(("protocol_error", PROTOCOL_VERSION, str(e)))
+            except OSError:
+                pass
+            conn.close()
+            return
         except (OSError, EOFError):
             conn.close()
             return

@@ -1,0 +1,198 @@
+"""Versioned, schema-validated control-plane framing.
+
+ray: src/ray/protobuf/*.proto — the reference's control plane is typed
+protobuf-over-gRPC with versioned services.  Rounds 1-3 here sent raw
+pickled tuples: no version negotiation (a mixed-version cluster fails
+with arbitrary unpickling errors mid-stream) and no message validation
+(any tuple off an authenticated socket was dispatched on faith).
+
+This module gives every control connection:
+
+  * a 4-byte frame header (magic + u16 protocol version) on EVERY frame —
+    a peer speaking a different protocol version fails at the first recv
+    with a clean ProtocolError naming both versions, instead of a pickle
+    traceback deep in a handler;
+  * a per-message schema registry: str-kinded control tuples are checked
+    for known kind, arity bounds, and leading field types at decode time —
+    unknown or malformed control messages are rejected at the boundary;
+  * pickle confined to the framed body (it still carries user payload
+    blobs and complex specs — the authkey HMAC gates the bytes before any
+    unpickling, as before), with raw passthrough (`send_bytes` /
+    `recv_bytes` / `fileno`) for the object-transfer body path, which is
+    not pickled at all.
+
+TypedConn wraps a multiprocessing.connection.Connection and preserves its
+surface (send/recv/poll/fileno/close), so `multiprocessing.connection
+.wait` and the recv_into fast path keep working unchanged.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, Dict, Optional, Tuple
+
+MAGIC = b"RT"
+PROTOCOL_VERSION = 1
+_HEADER = struct.pack("<2sH", MAGIC, PROTOCOL_VERSION)
+
+
+class ProtocolError(ConnectionError):
+    """Frame failed version or schema validation."""
+
+
+# kind -> (min_extra_fields, max_extra_fields, leading_field_types)
+# `None` in the types tuple = any.  Extra fields beyond the typed prefix
+# are unconstrained (payload positions).  max_extra None = unbounded.
+_S = None
+SCHEMAS: Dict[str, Tuple[int, Optional[int], tuple]] = {
+    # worker/driver -> head
+    "ready": (3, 4, (str, int)),
+    "env_failed": (2, 2, (str, str)),
+    "done": (3, 3, (str,)),
+    "refop": (2, 2, (str, str)),
+    "req": (3, 3, (int, str)),
+    "object_copied": (2, 2, (str, int)),
+    "actor_exit": (1, 1, (str,)),
+    "fence_ack": (1, 1, (str,)),
+    "direct_seal": (3, 3, (str, int)),
+    "promote": (3, 3, (str,)),
+    "promote_error": (2, 2, (str,)),
+    "seal_ow": (3, 3, (str, int)),
+    "put_ow": (3, 3, (str,)),
+    "task_events": (1, 1, (list,)),
+    "lease_return": (1, 1, (str,)),
+    "sync": (0, 1, ()),
+    "kv_fetch": (1, 1, (str,)),
+    "object_fetch": (1, 1, (str,)),
+    "driver": (2, 2, (str,)),
+    "driver_store": (2, 2, ()),
+    # head -> worker
+    "reply": (3, 3, (int,)),
+    "task": (2, 2, ()),
+    "create_actor": (2, 2, ()),
+    "fence": (1, 1, (str,)),
+    "kill": (0, 0, ()),
+    "shutdown": (0, 1, ()),
+    # daemon <-> head
+    "daemon": (3, 3, (str,)),
+    "heartbeat": (0, 1, ()),
+    "worker_exited": (1, 3, (str,)),
+    "worker_oom_killed": (1, None, (str,)),
+    "log_lines": (3, 3, (str, str, list)),
+    "spawn_worker": (1, None, (str,)),
+    "kill_worker": (1, 1, (str,)),
+    "delete_object": (1, 1, (str,)),
+    # peer transport
+    "pcall": (1, 2, ()),
+    "pcancel": (1, 1, (str,)),
+    "pdone": (3, 3, (str,)),
+    # transfer plane / handshake replies
+    "ok": (1, 1, (int,)),
+    "missing": (0, 0, ()),
+    "driver_ack": (1, 1, (dict,)),
+    "protocol_error": (1, 2, ()),
+    # external-env policy serving (rllib/policy_client.py)
+    "start_episode": (1, 1, ()),
+    "get_action": (3, 3, (str,)),
+    "log_returns": (2, 2, (str, float)),
+    "end_episode": (2, 3, (str,)),
+    "error": (1, 2, ()),
+}
+
+
+def _validate(obj: Any) -> None:
+    """Schema-check str-kinded control tuples; other values (one-shot
+    payload replies: kv bytes, ack dicts) pass through untyped."""
+    if not (isinstance(obj, tuple) and obj and isinstance(obj[0], str)):
+        return
+    spec = SCHEMAS.get(obj[0])
+    if spec is None:
+        raise ProtocolError(f"unknown control message kind {obj[0]!r}")
+    lo, hi, types = spec
+    n = len(obj) - 1
+    if n < lo or (hi is not None and n > hi):
+        raise ProtocolError(
+            f"control message {obj[0]!r} has {n} fields, expected "
+            f"[{lo}, {hi if hi is not None else 'inf'}]"
+        )
+    for i, t in enumerate(types):
+        if t is not None and not isinstance(obj[i + 1], t):
+            raise ProtocolError(
+                f"control message {obj[0]!r} field {i} is "
+                f"{type(obj[i + 1]).__name__}, expected {t.__name__}"
+            )
+
+
+def encode(obj: Any) -> bytes:
+    return _HEADER + pickle.dumps(obj, protocol=5)
+
+
+def decode(buf) -> Any:
+    if len(buf) < 4:
+        raise ProtocolError("short control frame")
+    magic, version = struct.unpack_from("<2sH", buf, 0)
+    if magic != MAGIC:
+        raise ProtocolError(
+            "peer is not speaking the ray_tpu control protocol "
+            f"(bad magic {magic!r})"
+        )
+    if version != PROTOCOL_VERSION:
+        raise ProtocolError(
+            f"protocol version mismatch: peer speaks v{version}, this "
+            f"process speaks v{PROTOCOL_VERSION} — upgrade the older side"
+        )
+    obj = pickle.loads(memoryview(buf)[4:])
+    _validate(obj)
+    return obj
+
+
+class TypedConn:
+    """Connection wrapper applying the framing to send/recv while keeping
+    the raw-byte surface for transfer bodies."""
+
+    __slots__ = ("_c",)
+
+    def __init__(self, conn):
+        self._c = conn
+
+    def send(self, obj: Any) -> None:
+        self._c.send_bytes(encode(obj))
+
+    def recv(self) -> Any:
+        return decode(self._c.recv_bytes())
+
+    # raw passthrough (object-transfer body, recv_into via fileno)
+    def send_bytes(self, b) -> None:
+        self._c.send_bytes(b)
+
+    def recv_bytes(self):
+        return self._c.recv_bytes()
+
+    def poll(self, timeout: float = 0.0) -> bool:
+        return self._c.poll(timeout)
+
+    def fileno(self) -> int:
+        return self._c.fileno()
+
+    def close(self) -> None:
+        self._c.close()
+
+    @property
+    def closed(self) -> bool:
+        return self._c.closed
+
+    def __repr__(self) -> str:
+        return f"TypedConn({self._c!r})"
+
+
+def wrap(conn) -> TypedConn:
+    return conn if isinstance(conn, TypedConn) else TypedConn(conn)
+
+
+def connect(address, authkey: bytes) -> TypedConn:
+    """Client-side connect + auth + wrap (the stdlib handshake runs on the
+    raw connection; framing starts with the first application message)."""
+    from multiprocessing.connection import Client
+
+    return TypedConn(Client(tuple(address), authkey=authkey))

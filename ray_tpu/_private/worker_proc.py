@@ -447,7 +447,7 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
 
             threading.Thread(target=_orphan_watch, daemon=True).start()
     global _runtime
-    from multiprocessing.connection import Client
+    from ray_tpu._private import wire
 
     # Watchdog: if the connect/auth handshake wedges (e.g. the driver
     # vanished between spawn and connect), die instead of lingering — the
@@ -459,7 +459,7 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
     )
     watchdog.daemon = True
     watchdog.start()
-    conn = Client(address, authkey=authkey)
+    conn = wire.connect(address, authkey)
     watchdog.cancel()
     from ray_tpu._private.netutil import set_nodelay
 
@@ -602,7 +602,7 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
         newconn = None
         while _time.monotonic() < deadline:
             try:
-                newconn = Client(address, authkey=authkey)
+                newconn = wire.connect(address, authkey)
                 set_nodelay(newconn)
                 break
             except Exception:
@@ -715,12 +715,10 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
     if renv_json:
         import json as _json
 
-        from multiprocessing.connection import Client as _Client
-
         from ray_tpu._private.runtime_env import apply_worker_runtime_env
 
         def _fetch(key):
-            c = _Client(address, authkey=authkey)
+            c = wire.connect(address, authkey)
             try:
                 c.send(("kv_fetch", key))
                 return c.recv()

@@ -251,6 +251,14 @@ class DQN:
                         f"offline data obs dim {self.offline.obs_size} != "
                         f"env obs dim {self._obs_size}"
                     )
+                if self.offline.num_actions > self._num_actions:
+                    # take_along_axis would silently CLAMP out-of-range
+                    # action indices — corrupt Q targets, no error.
+                    raise ValueError(
+                        f"offline data contains action ids up to "
+                        f"{self.offline.num_actions - 1}, but the env "
+                        f"declares only {self._num_actions} actions"
+                    )
             else:
                 self._obs_size = self.offline.obs_size
                 self._num_actions = self.offline.num_actions

@@ -78,9 +78,13 @@ class PolicyServer:
     # -- wire ----------------------------------------------------------------
 
     def _accept_loop(self) -> None:
+        from ray_tpu._private.wire import wrap
+
         while not self._shutdown:
             try:
-                conn = self._listener.accept()
+                # wire-framed like every other control conn (the client
+                # connects through the same versioned transport).
+                conn = wrap(self._listener.accept())
             except (OSError, EOFError):
                 if self._shutdown:
                     return
