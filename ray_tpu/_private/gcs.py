@@ -81,23 +81,20 @@ class GlobalState:
         self.kv: Dict[str, Dict[str, bytes]] = {}  # namespace -> {key: val}
         self.placement_groups: Dict[str, PlacementGroupInfo] = {}
         self.job_start_time = time.time()
-        # pub/sub-lite: listeners on cluster events
-        # (ray: src/ray/pubsub/publisher.h:298 -- collapsed to callbacks since
-        # all subscribers are in-process today)
-        self._listeners: Dict[str, List[Callable]] = {}
+        # Cluster-event channels on the SHARED pubsub abstraction
+        # (ray: src/ray/pubsub/publisher.h:298 — same Publisher the
+        # runtime's object-ready plane and serve's long-poll use).
+        from ray_tpu._private.pubsub import Publisher
+
+        self.publisher = Publisher()
 
     # -- events --------------------------------------------------------------
 
     def subscribe(self, channel: str, cb: Callable) -> None:
-        with self.lock:
-            self._listeners.setdefault(channel, []).append(cb)
+        self.publisher.subscribe(channel, None, cb)
 
     def publish(self, channel: str, *args) -> None:
-        for cb in self._listeners.get(channel, []):
-            try:
-                cb(*args)
-            except Exception:
-                pass
+        self.publisher.publish(channel, None, *args)
 
     # -- nodes ---------------------------------------------------------------
 

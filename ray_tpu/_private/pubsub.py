@@ -1,0 +1,141 @@
+"""One pub/sub channel abstraction for every push mechanism.
+
+ray: src/ray/pubsub/publisher.h:298 (Publisher with per-channel subscriber
+state) + subscriber.h:70 (long-poll delivery).  Rounds 1-3 grew three
+bespoke push mechanisms — object-ready wait tokens in the owner store,
+ad-hoc callback lists in the GCS tables, and a condition-variable long
+poll in the serve controller.  They are all subscriptions:
+
+  * `Publisher` — channels keyed by (channel, key); `once=True`
+    subscriptions fire on the next publish then drop (the parking
+    primitive behind get/wait/dep-resolution), persistent ones fire on
+    every publish (GCS event listeners, log fan-out).  `deferred=True`
+    marks callbacks the PUBLISHER'S CALLER must run after releasing its
+    own locks (a parked get's reply does store reads that must not run
+    under the runtime lock) — publish returns them instead of calling.
+  * `LongPollHost` — the blocking long-poll pattern over Publisher
+    (ray: serve _private/long_poll.py:185): callers park on a key until a
+    predicate turns true or their chunk timeout lapses.
+
+Everything is in-process today (the single-controller head owns all
+state); the channel names and delivery modes are the seam a cross-process
+subscriber transport would plug into.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class Subscription:
+    __slots__ = ("channel", "key", "cb", "once", "deferred", "active")
+
+    def __init__(self, channel: str, key: Any, cb: Callable, once: bool,
+                 deferred: bool):
+        self.channel = channel
+        self.key = key
+        self.cb = cb
+        self.once = once
+        self.deferred = deferred
+        self.active = True
+
+
+class Publisher:
+    """Thread-safe; inline callbacks run on the publishing thread (under
+    whatever locks the publisher's caller holds — subscribe with
+    deferred=True when the callback must not)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._subs: Dict[Tuple[str, Any], List[Subscription]] = {}
+
+    def subscribe(self, channel: str, key: Any, cb: Callable, *,
+                  once: bool = False, deferred: bool = False) -> Subscription:
+        sub = Subscription(channel, key, cb, once, deferred)
+        with self._lock:
+            self._subs.setdefault((channel, key), []).append(sub)
+        return sub
+
+    def unsubscribe(self, sub: Subscription) -> None:
+        sub.active = False
+        with self._lock:
+            lst = self._subs.get((sub.channel, sub.key))
+            if lst is not None:
+                try:
+                    lst.remove(sub)
+                except ValueError:
+                    pass
+                if not lst:
+                    self._subs.pop((sub.channel, sub.key), None)
+
+    def publish(self, channel: str, key: Any, *args) -> List[Callable]:
+        """Fire subscriptions for (channel, key).  Inline callbacks run
+        here (exceptions swallowed per-subscriber, as the reference's
+        publisher isolates subscriber failures); deferred callbacks are
+        RETURNED for the caller to invoke outside its locks."""
+        with self._lock:
+            lst = self._subs.get((channel, key))
+            if not lst:
+                return []
+            fired = [s for s in lst if s.active]
+            keep = [s for s in lst if s.active and not s.once]
+            if keep:
+                self._subs[(channel, key)] = keep
+            else:
+                self._subs.pop((channel, key), None)
+        deferred = []
+        for s in fired:
+            if s.deferred:
+                deferred.append(s.cb)
+            else:
+                try:
+                    s.cb(*args)
+                except Exception:
+                    import traceback
+
+                    traceback.print_exc()
+        return deferred
+
+    def num_subscribers(self, channel: str, key: Any = None) -> int:
+        with self._lock:
+            if key is not None:
+                return len(self._subs.get((channel, key), ()))
+            return sum(
+                len(v) for (c, _k), v in self._subs.items() if c == channel
+            )
+
+
+class LongPollHost:
+    """Blocking long-poll over Publisher (ray: LongPollHost.listen_for_change,
+    serve/_private/long_poll.py:185)."""
+
+    def __init__(self, publisher: Optional[Publisher] = None,
+                 channel: str = "longpoll"):
+        self._pub = publisher or Publisher()
+        self._channel = channel
+
+    def notify(self, key: Any, *args) -> None:
+        self._pub.publish(self._channel, key, *args)
+
+    def wait_for_change(self, key: Any, predicate: Callable[[], bool],
+                        timeout: float) -> bool:
+        """Park until predicate() is true or the timeout lapses; returns
+        the final predicate value.  Subscribe-then-recheck closes the race
+        between the check and a concurrent notify."""
+        deadline = time.monotonic() + timeout
+        while True:
+            if predicate():
+                return True
+            ev = threading.Event()
+            sub = self._pub.subscribe(
+                self._channel, key, lambda *a: ev.set(), once=True
+            )
+            if predicate():
+                self._pub.unsubscribe(sub)
+                return True
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or not ev.wait(remaining):
+                self._pub.unsubscribe(sub)
+                return predicate()

@@ -354,9 +354,13 @@ class Runtime:
         self.tasks: Dict[str, TaskRecord] = {}
         self.actors: Dict[str, ActorRuntime] = {}
         self.ready_queue = _ReadyQueue(self)
-        self.dep_waiters: Dict[str, Set[str]] = {}  # oid -> task_ids
-        self.parked_gets: Dict[str, List[Tuple[str, int]]] = {}  # oid -> [(worker, req)]
-        self.parked_waits: Dict[str, List[dict]] = {}  # oid -> wait tokens
+        # ONE pubsub plane for every push mechanism (parked gets, wait
+        # tokens, dep resolution here; GCS events and serve long-poll run
+        # their own Publisher instances of the same abstraction) —
+        # ray: src/ray/pubsub/publisher.h:298.
+        from ray_tpu._private.pubsub import Publisher
+
+        self.pubsub = Publisher()
         self.contained_map: Dict[str, List[str]] = {}  # oid -> contained oids
         # Object directory (ray: ownership_based_object_directory.h): which
         # NON-head nodes hold a sealed copy of each object.  Head-node
@@ -583,6 +587,10 @@ class Runtime:
                 wid, deque(maxlen=_config.get("worker_log_ring_lines"))
             )
         buf.extend(lines)
+        # Log channel on the shared pubsub plane (ray: the reference's log
+        # channel is a publisher channel too) — dashboards/CLIs can follow
+        # a worker's output push-style instead of polling get_logs.
+        self.pubsub.publish("logs", wid, stream, lines)
         if self.log_to_driver:
             prefix = f"({wid}" + (" .err) " if stream == "err" else ") ")
             out = "".join(prefix + ln + "\n" for ln in lines)
@@ -1788,10 +1796,29 @@ class Runtime:
                 self._release_peer_lease_locked(lease_id, return_worker=True)
                 self._reply(caller, req_id, True, ("busy",))
 
+    def _park_get(self, wid: str, req_id: int, oid: str) -> None:
+        """Caller holds self.lock: one once-subscription per parked get;
+        the reply runs DEFERRED (outside the runtime lock — it does store
+        reads and a conn send)."""
+        import functools
+
+        self.pubsub.subscribe(
+            "object_ready", oid,
+            functools.partial(self._serve_parked_get, wid, req_id),
+            once=True, deferred=True,
+        )
+
+    def _serve_parked_get(self, wid: str, req_id: int, oid: str) -> None:
+        try:
+            value = self._object_reply_value(oid, self._worker_node(wid))
+            self._reply(wid, req_id, True, value)
+        except Exception as e:  # noqa: BLE001 — reply with the error
+            self._reply(wid, req_id, False, e)
+
     def _req_get_object(self, wid: str, req_id: int, oid: str):
         with self.lock:
             if not self.store.is_ready(oid):
-                self.parked_gets.setdefault(oid, []).append((wid, req_id))
+                self._park_get(wid, req_id, oid)
                 return _PARKED
         try:
             return self._object_reply_value(oid, self._worker_node(wid))
@@ -1801,7 +1828,7 @@ class Runtime:
             # request behind the reconstructed producer.
             with self.lock:
                 if self._reconstruct(oid):
-                    self.parked_gets.setdefault(oid, []).append((wid, req_id))
+                    self._park_get(wid, req_id, oid)
                     return _PARKED
             raise
 
@@ -1819,6 +1846,8 @@ class Runtime:
                 return flags
             if timeout is not None and timeout <= 0:
                 return flags
+            import functools
+
             token = {
                 "need": num_returns - sum(flags),
                 "wid": wid,
@@ -1826,9 +1855,16 @@ class Runtime:
                 "oids": oids,
                 "done": False,
                 "timer": None,
+                "subs": [],
             }
             for o in pendings:
-                self.parked_waits.setdefault(o, []).append(token)
+                token["subs"].append(
+                    self.pubsub.subscribe(
+                        "object_ready", o,
+                        functools.partial(self._on_wait_oid_ready, token),
+                        once=True,
+                    )
+                )
             if timeout is not None:
                 t = threading.Timer(timeout, self._wait_token_timeout, args=(token,))
                 t.daemon = True
@@ -1837,24 +1873,24 @@ class Runtime:
             return _PARKED
 
     @_locked
+    def _on_wait_oid_ready(self, token, _oid: str) -> None:
+        # runs inline inside publish, under self.lock (_object_ready holds it)
+        token["need"] -= 1
+        if token["need"] <= 0:
+            self._wait_token_reply(token)
+
+    @_locked
     def _wait_token_reply(self, token) -> None:
-        """Caller holds self.lock.  Reply once and detach the token from
-        every oid list it is parked on (a timed-out token would otherwise
-        leak in parked_waits until its oids happen to become ready)."""
+        """Caller holds self.lock.  Reply once and drop the token's
+        remaining subscriptions (a timed-out token would otherwise leak
+        until its oids happen to become ready)."""
         if token["done"]:
             return
         token["done"] = True
         if token["timer"] is not None:
             token["timer"].cancel()
-        for o in token["oids"]:
-            lst = self.parked_waits.get(o)
-            if lst is not None:
-                try:
-                    lst.remove(token)
-                except ValueError:
-                    pass
-                if not lst:
-                    self.parked_waits.pop(o, None)
+        for sub in token["subs"]:
+            self.pubsub.unsubscribe(sub)
         flags = [self.store.is_ready(o) for o in token["oids"]]
         self._reply(token["wid"], token["req_id"], True, flags)
 
@@ -1989,22 +2025,24 @@ class Runtime:
     # ------------------------------------------------------------------
     # object readiness fan-out
 
+    @_locked
+    def _on_dep_ready(self, tid: str, _oid: str) -> None:
+        # runs inline inside publish, under self.lock (_object_ready holds it)
+        rec = self.tasks.get(tid)
+        if rec is None:
+            return
+        rec.unmet_deps -= 1
+        if rec.unmet_deps <= 0 and rec.state == "PENDING":
+            rec.state = "READY"
+            self.ready_queue.append(tid)
+
     def _object_ready(self, oid: str) -> None:
         with self.lock:
-            parked = self.parked_gets.pop(oid, [])
-            for token in self.parked_waits.pop(oid, []):
-                token["need"] -= 1
-                if token["need"] <= 0:
-                    self._wait_token_reply(token)
-            waiters = self.dep_waiters.pop(oid, set())
-            for tid in waiters:
-                rec = self.tasks.get(tid)
-                if rec is None:
-                    continue
-                rec.unmet_deps -= 1
-                if rec.unmet_deps <= 0 and rec.state == "PENDING":
-                    rec.state = "READY"
-                    self.ready_queue.append(tid)
+            # One publish fans out to every subscriber family: wait tokens
+            # and dep-resolution run inline (they mutate scheduler state
+            # under this lock); parked-get replies come back deferred and
+            # run after the lock drops.
+            deferred = self.pubsub.publish("object_ready", oid, oid)
             err = self.store.error_for(oid)
             if err is not None:
                 # Propagate the error to ALREADY-QUEUED dependents eagerly:
@@ -2032,12 +2070,8 @@ class Runtime:
                             if rec is not None:
                                 self._finish_with_error(rec, err, release=False)
             self._dispatch()
-        for wid, req_id in parked:
-            try:
-                value = self._object_reply_value(oid, self._worker_node(wid))
-                self._reply(wid, req_id, True, value)
-            except Exception as e:
-                self._reply(wid, req_id, False, e)
+        for cb in deferred:
+            cb(oid)
 
     # ------------------------------------------------------------------
     # submission (ray: CoreWorker::SubmitTask -> direct_task_transport.h:75)
@@ -2069,10 +2103,16 @@ class Runtime:
             self.tasks[spec.task_id] = rec
             for c in spec.contained_refs:
                 self.store.add_ref(c)  # arg borrow for the task's lifetime
+            import functools
+
             unmet = 0
             for d in set(spec.deps):
                 if not self.store.is_ready(d):
-                    self.dep_waiters.setdefault(d, set()).add(spec.task_id)
+                    self.pubsub.subscribe(
+                        "object_ready", d,
+                        functools.partial(self._on_dep_ready, spec.task_id),
+                        once=True,
+                    )
                     unmet += 1
             rec.unmet_deps = unmet
             if unmet == 0:

@@ -44,7 +44,6 @@ class ProtocolError(ConnectionError):
 # kind -> (min_extra_fields, max_extra_fields, leading_field_types)
 # `None` in the types tuple = any.  Extra fields beyond the typed prefix
 # are unconstrained (payload positions).  max_extra None = unbounded.
-_S = None
 SCHEMAS: Dict[str, Tuple[int, Optional[int], tuple]] = {
     # worker/driver -> head
     "ready": (3, 4, (str, int)),

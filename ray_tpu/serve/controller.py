@@ -64,10 +64,13 @@ class ServeController:
         self._lock = threading.RLock()
         self._version = 0
         # Long-poll push (ray: _private/long_poll.py:185 LongPollHost):
-        # routers park a listen_for_change call on this condition; every
-        # version bump notifies them, so membership/config changes reach
-        # the data plane in push latency, not poll-interval latency.
-        self._version_changed = threading.Condition(self._lock)
+        # routers park a listen_for_change call on the SHARED pubsub
+        # long-poll abstraction (pubsub.py — the same Publisher plane the
+        # runtime and GCS use); every version bump notifies them, so
+        # membership/config changes reach the data plane in push latency.
+        from ray_tpu._private.pubsub import LongPollHost
+
+        self._longpoll = LongPollHost()
         self._stop = threading.Event()
         self._period = reconcile_period_s
         self._thread = threading.Thread(
@@ -77,7 +80,7 @@ class ServeController:
 
     def _bump_version_locked(self) -> None:
         self._version += 1
-        self._version_changed.notify_all()
+        self._longpoll.notify("routing", self._version)
 
     def listen_for_change(
         self, known_version: int, timeout_s: float = 30.0
@@ -86,13 +89,13 @@ class ServeController:
         chunk timeout lapses — caller immediately re-listens).  Runs on one
         of the controller actor's concurrency slots
         (ray: LongPollHost.listen_for_change)."""
-        deadline = time.time() + timeout_s
-        with self._version_changed:
-            while self._version <= known_version and not self._stop.is_set():
-                remaining = deadline - time.time()
-                if remaining <= 0:
-                    return None
-                self._version_changed.wait(remaining)
+        moved = self._longpoll.wait_for_change(
+            "routing",
+            lambda: self._version > known_version or self._stop.is_set(),
+            timeout_s,
+        )
+        if not moved or self._version <= known_version:
+            return None
         return self.get_routing_table(known_version)
 
     # -- public control API (called by serve.api / routers) ----------------
