@@ -68,6 +68,22 @@ _DEFS: Dict[str, tuple] = {
         "chunk size for cross-node object pulls "
         "(ray: object_manager_default_chunk_size)",
     ),
+    "snapshot_inflight_max_blob_bytes": (
+        256 * 1024, int,
+        "in-flight tasks with args blobs over this size are not persisted "
+        "for head-restart re-drive (their argument objects would not "
+        "survive the head's store anyway)",
+    ),
+    "snapshot_inflight_max_tasks": (
+        10000, int,
+        "cap on in-flight task specs persisted per snapshot tick",
+    ),
+    "locality_min_bytes": (
+        1024 * 1024, int,
+        "dependency-locality scoring floor: tasks whose LARGEST per-node "
+        "local dep footprint is under this many bytes schedule by load "
+        "alone (pulling tiny args costs less than imbalance)",
+    ),
     "serve_proxy_max_connections": (
         2048, int,
         "max concurrent HTTP connections one serve proxy holds open; "

@@ -277,7 +277,7 @@ class _ReadyQueue:
 class TaskRecord:
     __slots__ = (
         "spec", "state", "node_id", "worker_id", "unmet_deps", "cancelled",
-        "pg", "start_time",
+        "pg", "start_time", "allow_pending",
     )
 
     def __init__(self, spec):
@@ -289,6 +289,10 @@ class TaskRecord:
         self.cancelled = False
         self.pg = None  # (pg_id, bundle_index) when resources come from a PG
         self.start_time = None  # wall time when dispatched (timeline)
+        # Re-driven tasks (head-restart recovery) PARK when infeasible —
+        # the cluster's daemon nodes rejoin seconds after restore, and
+        # failing fast there would defeat the re-drive.
+        self.allow_pending = False
 
 
 class ActorRuntime:
@@ -368,6 +372,11 @@ class Runtime:
         # means every seal/copy/free flows through this process, so the
         # directory needs no pubsub.
         self.object_locations: Dict[str, Set[str]] = {}
+        # Packed size per object with a sealed copy anywhere — feeds the
+        # BYTES-weighted locality scoring (ray: the hybrid policy's
+        # locality/load tradeoff weighs by object size, not count —
+        # hybrid_scheduling_policy.h:50 + locality-aware leasing).
+        self.object_sizes: Dict[str, int] = {}
         self.node_object_endpoints: Dict[str, Tuple[str, int]] = {}
         # Head-side outbound-transfer admission (the daemon ObjectServer
         # enforces the same bound for its node).
@@ -645,6 +654,28 @@ class Runtime:
                         "creation_spec": info.creation_spec,
                     }
                 )
+            # In-flight PLAIN task specs: a head crash mid-flight re-drives
+            # them on restart so their results still materialize for
+            # reconnected drivers (ray: lineage-based resubmission after
+            # GCS failover).  Actor work re-drives via the actor records;
+            # oversized arg blobs are skipped — their argument objects
+            # would not survive the head's store anyway.
+            from ray_tpu._private import config as _cfg
+
+            max_blob = _cfg.get("snapshot_inflight_max_blob_bytes")
+            max_tasks = _cfg.get("snapshot_inflight_max_tasks")
+            inflight = []
+            for rec in self.tasks.values():
+                spec = rec.spec
+                if (
+                    spec.actor_id is None
+                    and not spec.is_actor_creation
+                    and not rec.cancelled
+                    and len(spec.args_blob or b"") <= max_blob
+                ):
+                    inflight.append(spec)
+                    if len(inflight) >= max_tasks:
+                        break
             snap = {
                 "session": self.session_name,
                 "kv": {ns: dict(d) for ns, d in self.state.kv.items()},
@@ -658,6 +689,8 @@ class Runtime:
                 "object_locations": {
                     k: set(v) for k, v in self.object_locations.items()
                 },
+                "object_sizes": dict(self.object_sizes),
+                "inflight_tasks": inflight,
             }
         tmp = self.snapshot_path + ".tmp"
         with open(tmp, "wb") as f:
@@ -685,6 +718,11 @@ class Runtime:
         self.state.functions.update(snap.get("functions", {}))
         for oid, locs in snap.get("object_locations", {}).items():
             self.object_locations.setdefault(oid, set()).update(locs)
+            # Surviving node copies must satisfy gets on the restarted
+            # head: without the readiness mark, a get would park forever
+            # next to bytes the directory knows about.
+            self.store.mark_remote_sealed(oid)
+        self.object_sizes.update(snap.get("object_sizes", {}))
         for pid, (bundles, strategy, name, pstate) in snap.get(
             "placement_groups", {}
         ).items():
@@ -724,6 +762,39 @@ class Runtime:
             )
             t.daemon = True
             t.start()
+        # Re-drive tasks that were in flight at the crash: their results
+        # never sealed (or survive on a node — then the resubmit is
+        # skipped), so reconnected drivers' gets park until the re-run
+        # completes (ray: owner-side resubmission after failover).  Chains
+        # re-drive together (a dep produced by another re-driven task
+        # resolves when it runs); a dep with NO surviving copy and NO
+        # re-driven producer is unrecoverable — its task fails with
+        # ObjectLostError now instead of parking forever.  Infeasible
+        # shapes PARK (allow_pending) until the daemons rejoin.
+        inflight = snap.get("inflight_tasks", [])
+        will_produce = {o for s in inflight for o in s.return_ids()}
+        for spec in inflight:
+            if all(self.store.is_ready(o) for o in spec.return_ids()):
+                continue
+            lost = [
+                d for d in spec.deps
+                if not self.store.is_ready(d) and d not in will_produce
+            ]
+            if lost:
+                self.events.emit(
+                    "WARNING", "runtime",
+                    "re-driven task dropped: input lost with the old head",
+                    task=spec.name, missing=lost[0],
+                )
+                for oid in spec.return_ids():
+                    self.store.put_error(oid, ObjectLostError(lost[0]))
+                    self._object_ready(oid)
+                continue
+            spec.attempt = 0
+            try:
+                self.submit_task(spec, allow_pending=True)
+            except Exception:
+                continue  # malformed snapshot entry: skip, don't block boot
 
     def _respawn_unbound_actors(self) -> None:
         """Adoption grace expired: recreate restored actors whose worker
@@ -765,6 +836,7 @@ class Runtime:
                 entry = self.lineage.pop(oid, None)
                 if entry is not None:
                     self.lineage_bytes -= self._lineage_cost(entry)
+                self.object_sizes.pop(oid, None)
                 # Remote copies die with the ownership release (ray: the
                 # owner's directory drives eviction on every holder node).
                 locs = self.object_locations.pop(oid, None)
@@ -1517,6 +1589,7 @@ class Runtime:
                         self.store.shm.delete(oid)
                 elif self.store.is_ready(oid):
                     self.object_locations.setdefault(oid, set()).add(node)
+                    self.object_sizes.setdefault(oid, size)
                 else:
                     self._daemon_send(node, ("delete_object", oid))
         elif kind == "actor_exit":
@@ -1945,6 +2018,8 @@ class Runtime:
         seals land in the owner store's accounting; remote seals only enter
         the object directory (the bytes live on that node until pulled)."""
         node = self._worker_node(wid)
+        with self.lock:
+            self.object_sizes[oid] = size
         if node == self.head_node_id:
             self.store.mark_shm_sealed(oid, size)
             return
@@ -2020,6 +2095,7 @@ class Runtime:
         payload, bufs = ser.unpack(memoryview(packed))
         import pickle
 
+        self.object_sizes[oid] = len(packed)
         self.store.put_serialized(oid, bytes(payload), [pickle.PickleBuffer(b) for b in bufs])
 
     # ------------------------------------------------------------------
@@ -2076,7 +2152,7 @@ class Runtime:
     # ------------------------------------------------------------------
     # submission (ray: CoreWorker::SubmitTask -> direct_task_transport.h:75)
 
-    def submit_task(self, spec: TaskSpec) -> List[str]:
+    def submit_task(self, spec: TaskSpec, allow_pending: bool = False) -> List[str]:
         if (
             spec.runtime_env
             and not spec.runtime_env.get("_resolved")
@@ -2095,6 +2171,7 @@ class Runtime:
                 self.session_name,
             )
         rec = TaskRecord(spec)
+        rec.allow_pending = allow_pending
         return_ids = spec.return_ids()
         with self.lock:
             self.metrics["tasks_submitted"] += 1
@@ -2236,7 +2313,7 @@ class Runtime:
                     try:
                         node = self.scheduler.select_node(spec)
                     except ValueError as e:
-                        if self.allow_pending_infeasible:
+                        if self.allow_pending_infeasible or rec.allow_pending:
                             break
                         q.popleft()
                         self._finish_with_error(rec, e, release=False)
@@ -2462,16 +2539,28 @@ class Runtime:
 
     @_locked
     def _deps_locality(self, deps) -> Dict[str, int]:
-        """{node_id: count of dep objects whose bytes are local there} —
-        feeds the scheduler's locality preference (dispatch path; called
-        under self.lock via _dispatch)."""
-        counts: Dict[str, int] = {}
+        """{node_id: BYTES of dep objects local there} — feeds the
+        scheduler's locality preference (dispatch path; called under
+        self.lock via _dispatch).  Size-weighted, so a node holding one
+        100MB argument beats a node holding three 1KB ones (ray: the
+        hybrid policy's locality/load tradeoff weighs transfer cost);
+        tiny deps (everything under the locality_min_bytes knob in total)
+        yield no pull at all — spreading wins when the wire cost is noise."""
+        from ray_tpu._private import config as _config
+
+        scores: Dict[str, int] = {}
         for d in deps:
+            size = self.object_sizes.get(d, 1)
             for n in self.object_locations.get(d, ()):
-                counts[n] = counts.get(n, 0) + 1
+                scores[n] = scores.get(n, 0) + size
             if self.store.has_local(d):
-                counts[self.head_node_id] = counts.get(self.head_node_id, 0) + 1
-        return counts
+                scores[self.head_node_id] = (
+                    scores.get(self.head_node_id, 0) + size
+                )
+        floor = _config.get("locality_min_bytes")
+        if scores and max(scores.values()) < floor:
+            return {}
+        return scores
 
     @_locked
     def _fail_task_record(
@@ -2712,6 +2801,9 @@ class Runtime:
         self.metrics["objects_put"] += 1
         oid = ids.object_id()
         contained = self.store.put(oid, value)
+        size = self.store._in_shm.get(oid)
+        if size:
+            self.object_sizes[oid] = size  # locality scoring weight
         self._store_contained(oid, contained)
         self._object_ready(oid)
         return ObjectRef(oid)
