@@ -68,6 +68,12 @@ _DEFS: Dict[str, tuple] = {
         "chunk size for cross-node object pulls "
         "(ray: object_manager_default_chunk_size)",
     ),
+    "gcs_storage_backend": (
+        "file", str,
+        "control-plane snapshot backend: 'file' (atomic single file) or "
+        "'sqlite' (WAL-journaled, crash-safe) "
+        "(ray: gcs store_client in-memory vs redis backends)",
+    ),
     "snapshot_inflight_max_blob_bytes": (
         256 * 1024, int,
         "in-flight tasks with args blobs over this size are not persisted "

@@ -1,0 +1,135 @@
+"""Pluggable control-plane snapshot storage.
+
+ray: src/ray/gcs/store_client/ — the reference's GCS persists its tables
+through a StoreClient interface with in-memory and Redis backends
+(in_memory_store_client.h, redis_store_client.h).  Ours snapshots the
+metadata tables as one document per tick; this module makes WHERE that
+document lives pluggable:
+
+  * FileSnapshotStorage  — atomic tmp+rename single file (the default;
+    zero dependencies, good for one-host clusters and tests);
+  * SqliteSnapshotStorage — a WAL-mode sqlite database (crash-safe
+    journaled writes, multiple sessions per db file, the shape an external
+    durable store plugs into — the Redis-FT analogue without a Redis
+    dependency in this image).
+
+Selected by the gcs_storage_backend config knob (RAY_TPU_GCS_STORAGE_BACKEND).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict, Optional
+
+
+class SnapshotStorage:
+    """Interface: persist/load one session's snapshot document."""
+
+    def save(self, session: str, snap: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def load(self, session: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class FileSnapshotStorage(SnapshotStorage):
+    """One pickle file, atomically replaced per tick."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def save(self, session: str, snap: Dict[str, Any]) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(snap, f)
+        os.replace(tmp, self.path)
+
+    def load(self, session: str) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self.path, "rb") as f:
+                snap = pickle.load(f)
+        except (OSError, EOFError, pickle.UnpicklingError):
+            return None
+        # The file is session-scoped by its directory; a foreign session's
+        # snapshot must never replay (the caller also re-checks).
+        if snap.get("session") != session:
+            return None
+        return snap
+
+
+class SqliteSnapshotStorage(SnapshotStorage):
+    """WAL-journaled sqlite table keyed by session name.
+
+    One db can hold many sessions' snapshots; writes are transactional, so
+    a crash mid-save leaves the previous snapshot intact (the property the
+    reference gets from Redis persistence)."""
+
+    def __init__(self, path: str):
+        import sqlite3
+
+        self.path = path
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS snapshots ("
+            "session TEXT PRIMARY KEY, snap BLOB, updated REAL)"
+        )
+        self._conn.commit()
+        import threading
+
+        self._lock = threading.Lock()
+
+    def save(self, session: str, snap: Dict[str, Any]) -> None:
+        import time
+
+        blob = pickle.dumps(snap)
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO snapshots (session, snap, updated) "
+                "VALUES (?, ?, ?) ON CONFLICT(session) DO UPDATE SET "
+                "snap=excluded.snap, updated=excluded.updated",
+                (session, blob, time.time()),
+            )
+            self._conn.commit()
+
+    def load(self, session: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT snap FROM snapshots WHERE session=?", (session,)
+            ).fetchone()
+        if row is None:
+            return None
+        try:
+            snap = pickle.loads(row[0])
+        except (pickle.UnpicklingError, EOFError):
+            return None
+        if snap.get("session") != session:
+            return None
+        return snap
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._conn.close()
+            except Exception:
+                pass
+
+
+def make_snapshot_storage(path: str) -> SnapshotStorage:
+    """Backend per the gcs_storage_backend knob ('file' | 'sqlite')."""
+    from ray_tpu._private import config as _config
+
+    backend = _config.get("gcs_storage_backend")
+    if backend == "sqlite":
+        return SqliteSnapshotStorage(
+            path if path.endswith(".db") else path + ".db"
+        )
+    if backend != "file":
+        raise ValueError(
+            f"unknown gcs_storage_backend {backend!r} (want 'file' or 'sqlite')"
+        )
+    return FileSnapshotStorage(path)

@@ -127,12 +127,16 @@ def bench_actor_calls_async(n: int = 3000, window: int = 200) -> Dict:
     return out
 
 
-@ray_tpu.remote
+@ray_tpu.remote(num_cpus=0.05)
 class _Client:
     """Driving client hosted in a worker process — the reference's
     multi-client microbenchmarks also fan out from worker-side clients, so
     each client's calls ride its own core-worker transport (here: the
-    direct peer path, zero head messages per call)."""
+    direct peer path, zero head messages per call).
+
+    Near-zero CPU demand: a client spends its life blocked in get(), and
+    full-CPU clients on a small host would hold the very cores their leaf
+    tasks need (nested-resource deadlock)."""
 
     def run_actor_calls(self, handle, n, window):
         refs = []
@@ -233,8 +237,13 @@ def main(argv=None):
     out_path = None
     if "--json" in argv:
         out_path = argv[argv.index("--json") + 1]
-    ray_tpu.init(ignore_reinit_error=True)
-    results = []
+    import os as _os
+
+    # Logical-CPU headroom: the benches measure control-plane throughput,
+    # not core count; without it a small host can't place the n:n actor
+    # pairs at all (the reference runs these on 64-core machines).
+    ray_tpu.init(num_cpus=max(_os.cpu_count() or 1, 16), ignore_reinit_error=True)
+    results = [{"name": "host_note", "nproc": _os.cpu_count()}]
     for bench in ALL:
         r = bench()
         results.append(r)

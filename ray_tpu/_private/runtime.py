@@ -495,6 +495,12 @@ class Runtime:
         # gcs/store_client/redis_store_client.h — ours is a snapshot file):
         # named/detached actors, KV, functions, PGs, object directory.
         self.snapshot_path = snapshot_path
+        if snapshot_path:
+            from ray_tpu._private.gcs_storage import make_snapshot_storage
+
+            self._snapshot_storage = make_snapshot_storage(snapshot_path)
+        else:
+            self._snapshot_storage = None
         self._restored_actors: Set[str] = set()
         # Log pipeline (ray: log_monitor.py + driver print subscriber):
         # head workers' stdout/stderr redirect into per-worker files under
@@ -692,27 +698,17 @@ class Runtime:
                 "object_sizes": dict(self.object_sizes),
                 "inflight_tasks": inflight,
             }
-        tmp = self.snapshot_path + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(snap, f)
-        os.replace(tmp, self.snapshot_path)
+        self._snapshot_storage.save(self.session_name, snap)
 
     def _restore_snapshot(self) -> None:
         """Replay persisted control state on head restart: KV, exported
         functions, the object directory, PGs (re-reserved as nodes return),
         and named/detached actors (recreated from their creation specs;
         live-worker adoption upgrades this when the worker reconnects)."""
-        import pickle
-
-        try:
-            with open(self.snapshot_path, "rb") as f:
-                snap = pickle.load(f)
-        except (OSError, EOFError, pickle.UnpicklingError):
+        snap = self._snapshot_storage.load(self.session_name)
+        if snap is None:
             return
         from ray_tpu._private import config as _config
-
-        if snap.get("session") != self.session_name:
-            return  # someone else's session dir: never replay foreign state
         for ns, d in snap.get("kv", {}).items():
             self.state.kv.setdefault(ns, {}).update(d)
         self.state.functions.update(snap.get("functions", {}))
@@ -3019,6 +3015,8 @@ class Runtime:
         self._shutdown = True
         atexit.unregister(self.shutdown)
         set_ref_hooks(None, None)
+        if getattr(self, "_snapshot_storage", None) is not None:
+            self._snapshot_storage.close()
         if getattr(self, "_mem_monitor", None) is not None:
             self._mem_monitor.stop()
         # Final log drain: crash output written moments ago must reach the
