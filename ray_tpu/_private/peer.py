@@ -14,10 +14,14 @@ module removes that hop:
     whose endpoint rides the worker's "ready" handshake to the head, which
     thereby becomes the address directory;
   * a caller resolves an actor once (`resolve_actor` head op, cached
-    forever — direct eligibility requires max_restarts == 0, so the
-    actor→worker binding is immutable until death), then pushes calls as
-    ("pcall", spec) frames on a persistent peer connection and receives
-    ("pdone", task_id, results, err) frames on the same socket;
+    while the binding holds), then pushes calls as ("pcall", spec) frames
+    on a persistent peer connection and receives ("pdone", task_id,
+    results, err) frames on the same socket.  Restartable actors are
+    direct too: on peer death the route enters "recovering" — new calls
+    buffer in caller order, retry-eligible in-flight calls re-drive, and
+    a background resolver follows the head's restart FSM to the new
+    instance's endpoint (ray: direct_actor_task_submitter.h:67 +
+    sequential_actor_submit_queue.h resubmit across restarts);
   * ordering: per-caller order is the TCP FIFO; when a caller previously
     relayed calls through the head (actor was still PENDING_CREATION), the
     switch to direct mode is fenced — the head flushes a marker through the
@@ -147,9 +151,8 @@ class PeerConn:
     """Caller-side persistent connection to one peer worker.
 
     Owns a recv thread routing ("pdone", ...) frames to the transport's
-    completion callback.  On EOF every in-flight call fails with the
-    death callback (ActorDiedError semantics — the callee can only die,
-    never restart, on the direct path).
+    completion callback.  On EOF the death callback fails (or, for
+    restartable actors, re-drives) every in-flight call.
     """
 
     def __init__(self, endpoint: Tuple[str, int], authkey: bytes,
@@ -226,6 +229,27 @@ class DirectResult:
         self.promoted = False
 
 
+class ActorRoute:
+    """Caller-side routing state for one direct actor (ray:
+    direct_actor_task_submitter.h:67 keeps the same per-actor client
+    queue).  Non-restartable actors: state is "direct" until the peer
+    conn dies, then the route is dropped (in-flight fails ActorDiedError).
+    Restartable actors: conn death flips the route to "recovering" — new
+    calls buffer IN ORDER caller-side, retry-eligible in-flight calls are
+    prepended to that buffer, and a background resolver polls the head's
+    restart FSM until the new instance's endpoint arrives (then re-drives
+    the buffer) or the actor is declared dead (then fails it)."""
+
+    __slots__ = ("state", "conn", "restartable", "buffered", "recover_started")
+
+    def __init__(self, conn: "PeerConn", restartable: bool):
+        self.state = "direct"  # "direct" | "recovering"
+        self.conn: Optional[PeerConn] = conn
+        self.restartable = restartable
+        self.buffered: list = []  # specs queued while recovering
+        self.recover_started = False
+
+
 class Lease:
     """One head-granted worker lease (ray: direct_task_transport.h:75 —
     lease pooling keyed by SchedulingKey, reused across same-shape tasks)."""
@@ -255,9 +279,10 @@ _LEASE_IDLE_RETURN_S = 2.0
 class DirectTransport:
     """Caller-side state machine for direct calls (one per worker).
 
-    Actor calls: resolution cache is sticky — "direct" (endpoint) and
-    "head" (relay) are both terminal per actor, since mixing transports per
-    (caller, actor) would break per-caller call order.
+    Actor calls: resolution cache is sticky — an ActorRoute and "head"
+    (relay) are both terminal per actor, since mixing transports per
+    (caller, actor) would break per-caller call order.  A restartable
+    actor's route survives instance deaths by recovering in place.
 
     Plain tasks: the head grants reusable worker LEASES per scheduling key
     (resource shape); tasks push directly to leased workers, so per-task
@@ -271,7 +296,7 @@ class DirectTransport:
     def __init__(self, wr):
         self.wr = wr  # WorkerRuntime
         self.lock = threading.Lock()
-        self.routes: Dict[str, Any] = {}  # actor_id -> ("direct", PeerConn) | "head"
+        self.routes: Dict[str, Any] = {}  # actor_id -> ActorRoute | "head"
         self.conns: Dict[Tuple[str, int], PeerConn] = {}
         self.used_head_path: set = set()  # actor_ids relayed at least once
         # oid -> DirectResult for every in-flight or cached direct return.
@@ -287,35 +312,24 @@ class DirectTransport:
 
     # -- routing -------------------------------------------------------------
 
-    def route_for(self, actor_id: str):
-        """Returns a live PeerConn for direct mode, or None for head relay."""
-        with self.lock:
-            r = self.routes.get(actor_id)
-        if r == "head":
-            return None
-        if r is not None:
-            conn = r[1]
-            if not conn.dead:
-                return conn
-            with self.lock:
-                self.routes.pop(actor_id, None)
-        return self._resolve(actor_id)
-
-    def _resolve(self, actor_id: str):
+    def _resolve(self, actor_id: str) -> bool:
+        """Establish a route for an unresolved actor; False = relay."""
         need_fence = actor_id in self.used_head_path
         try:
-            status, _wid, endpoint = self.wr.request(
+            reply = self.wr.request(
                 "resolve_actor", (actor_id, need_fence), timeout=30.0
             )
+            status, endpoint = reply[0], reply[2]
+            restartable = bool(reply[3]) if len(reply) > 3 else False
         except queue.Empty:
             # Head slow: relay this call and retry resolve next time.  The
             # relay MUST be recorded — a later unfenced switch to direct
             # mode could overtake it (per-caller ordering violation).
             with self.lock:
                 self.used_head_path.add(actor_id)
-            return None
+            return False
         except Exception:
-            status, endpoint = "head", None
+            status, endpoint, restartable = "head", None, False
         if status != "direct":
             if status in ("ineligible", "dead"):
                 with self.lock:
@@ -323,16 +337,17 @@ class DirectTransport:
             # "pending": stay unresolved; relay and re-resolve on a later call
             with self.lock:
                 self.used_head_path.add(actor_id)
-            return None
+            return False
         conn = self._conn_to(tuple(endpoint))
         if conn is None:
             with self.lock:
                 self.routes[actor_id] = "head"
                 self.used_head_path.add(actor_id)
-            return None
+            return False
         with self.lock:
-            self.routes[actor_id] = ("direct", conn)
-        return conn
+            if not isinstance(self.routes.get(actor_id), ActorRoute):
+                self.routes[actor_id] = ActorRoute(conn, restartable)
+        return True
 
     def _conn_to(self, endpoint: Tuple[str, int]) -> Optional[PeerConn]:
         with self.lock:
@@ -370,23 +385,93 @@ class DirectTransport:
                 # under the caller's feet.
                 self.counts[oid] = 1
             self.inflight[spec.task_id] = (spec.actor_id, spec, conn, lease)
+        if lease is not None and self.wr.task_event_sink is not None:
+            # Caller-side RUNNING report (batched off the latency path) so
+            # lease-dispatched work shows in the head's task table (ray:
+            # task events flow through TaskEventBuffer the same way).
+            import time as _time
+
+            self.wr.task_event_sink(
+                {
+                    "task_id": spec.task_id,
+                    "name": spec.name,
+                    "state": "RUNNING",
+                    "worker_id": lease.worker_id,
+                    "actor_id": None,
+                    "parent_task_id": spec.parent_task_id,
+                    "attempt": spec.attempt,
+                    "start_time": _time.time(),
+                    "direct": True,
+                }
+            )
         return return_ids
 
     def submit(self, spec) -> Optional[list]:
-        """Try the direct actor path; returns return_ids or None (relay)."""
-        if spec.max_retries > 0:
-            return None  # retried calls keep head-side bookkeeping
-        conn = self.route_for(spec.actor_id)
-        if conn is None:
+        """Try the direct actor path; returns return_ids or None (relay).
+
+        Restartable actors ride the direct path too: a call landing while
+        the route is recovering buffers caller-side (never relays — a
+        relay could overtake the re-driven buffer, breaking per-caller
+        order) and is flushed onto the restarted instance's conn."""
+        aid = spec.actor_id
+        with self.lock:
+            r = self.routes.get(aid)
+        if r == "head":
             return None
-        return_ids = self._register(spec, conn)
+        if not isinstance(r, ActorRoute):
+            if not self._resolve(aid):
+                return None
+        reg = self._register_actor(spec)
+        if reg is None:
+            return None  # route vanished between resolve and register: relay
+        return_ids, conn = reg
+        if conn is None:
+            return return_ids  # buffered behind a restart in progress
         if not conn.send(("pcall", spec)):
-            # Connection died between resolve and push: fail like an actor
-            # death (no silent re-relay — the relay could double-execute).
+            # Connection died between resolve and push: recover (restartable)
+            # or fail like an actor death (no silent re-relay — the relay
+            # could double-execute).
             self._fail_inflight_on(conn)
             return return_ids
         self.calls_sent += 1
         return return_ids
+
+    def _register_actor(self, spec):
+        """Caller bookkeeping for one direct actor call.  Returns
+        (return_ids, conn) — conn None when buffered behind a recovery —
+        or None when the route vanished (caller relays instead)."""
+        # Borrow every arg ref BEFORE registering/pushing: the add must
+        # precede (same head conn, FIFO) any release the caller's own ref
+        # GC emits after this call returns.
+        for c in spec.contained_refs:
+            self.wr.borrow_ref(c)
+        return_ids = spec.return_ids()
+        aid = spec.actor_id
+        with self.lock:
+            r = self.routes.get(aid)
+            if isinstance(r, ActorRoute):
+                for oid in return_ids:
+                    self.results[oid] = DirectResult()
+                    # Pre-count the ObjectRef the caller is ABOUT to
+                    # construct (created with _count=False): if the callee
+                    # replies before that construction, a zero count would
+                    # release the entry under the caller's feet.
+                    self.counts[oid] = 1
+                dead_conn = r.conn is None or r.conn.dead
+                if (r.state == "recovering" or dead_conn) and r.restartable:
+                    # The death callback (or an already-running recovery)
+                    # owns the flush; per-caller order = buffer order.
+                    self.inflight[spec.task_id] = (aid, spec, None, None)
+                    r.buffered.append(spec)
+                    return return_ids, None
+                # Non-restartable dead conn: bind to it anyway — the send
+                # fails and the fail path lands ActorDiedError.
+                self.inflight[spec.task_id] = (aid, spec, r.conn, None)
+                return return_ids, r.conn
+        # Route vanished (non-restartable death raced us): balance borrows.
+        for c in spec.contained_refs:
+            self.wr.unborrow_ref(c)
+        return None
 
     # -- leased plain tasks --------------------------------------------------
 
@@ -592,7 +677,6 @@ class DirectTransport:
 
         if (
             err is not None
-            and lease is not None
             and spec.retry_exceptions
             and spec.attempt < spec.max_retries
             # A cancel is a user decision, not a failure: retrying it
@@ -600,8 +684,11 @@ class DirectTransport:
             and not isinstance(err, TaskCancelledError)
         ):
             spec.attempt += 1
-            if self._resend(spec):
-                return  # retried: the pending results land on a later pdone
+            if lease is not None:
+                if self._resend(spec):
+                    return  # retried: pending results land on a later pdone
+            elif _aid is not None and self._resend_actor(_aid, spec):
+                return
         for oid in spec.return_ids():
             value = None
             if err is None:
@@ -646,19 +733,52 @@ class DirectTransport:
     def _fail_inflight_on(self, conn: PeerConn) -> None:
         from ray_tpu.exceptions import ActorDiedError, WorkerCrashedError
 
+        recover_aids = []
         with self.lock:
             doomed = [
                 (tid, e) for tid, e in self.inflight.items() if e[2] is conn
             ]
             for tid, _ in doomed:
                 self.inflight.pop(tid, None)
-            routes_dead = [
-                aid for aid, r in self.routes.items()
-                if r != "head" and r[1] is conn
-            ]
-            for aid in routes_dead:
-                self.routes.pop(aid, None)
-        for _tid, (aid, spec, _c, lease) in doomed:
+            for aid, r in list(self.routes.items()):
+                if isinstance(r, ActorRoute) and r.conn is conn:
+                    if r.restartable:
+                        # Restart FSM owns this death: buffer instead of
+                        # fail (ray: direct_actor_task_submitter.h:67
+                        # resubmits across restarts).
+                        r.state = "recovering"
+                        r.conn = None
+                        if not r.recover_started:
+                            r.recover_started = True
+                            recover_aids.append(aid)
+                    else:
+                        self.routes.pop(aid, None)
+            # Retry-eligible actor calls on a recovering route are
+            # PREPENDED to the route's buffer inside this same lock hold:
+            # a submit racing the death may already have buffered newer
+            # calls, and the in-flight ones must re-drive first
+            # (per-caller order).
+            resubmits: Dict[str, list] = {}
+            kept: set = set()
+            for tid, (aid, spec, _c, lease) in doomed:
+                if lease is not None or aid is None:
+                    continue
+                r = self.routes.get(aid)
+                if (
+                    isinstance(r, ActorRoute)
+                    and r.state == "recovering"
+                    and spec.attempt < spec.max_retries
+                ):
+                    spec.attempt += 1
+                    self.inflight[tid] = (aid, spec, None, None)
+                    resubmits.setdefault(aid, []).append(spec)
+                    kept.add(tid)
+            for aid, specs in resubmits.items():
+                r = self.routes.get(aid)
+                r.buffered[:0] = specs
+        for tid, (aid, spec, _c, lease) in doomed:
+            if tid in kept:
+                continue
             if lease is not None:
                 with self.lock:
                     lease.inflight -= 1
@@ -677,6 +797,120 @@ class DirectTransport:
                 self._land(oid, err, None)
             for c in spec.contained_refs:
                 self.wr.unborrow_ref(c)
+        for aid in recover_aids:
+            threading.Thread(
+                target=self._recover_actor, args=(aid,), daemon=True,
+                name="raytpu-actor-recover",
+            ).start()
+
+    def _recover_actor(self, aid: str) -> None:
+        """Poll the head's restart FSM until the actor is ALIVE again (then
+        re-drive the route's buffer onto the new endpoint, in order) or
+        DEAD (then fail the buffer).  Never relays: per-caller order across
+        the restart is preserved entirely caller-side (ray:
+        sequential_actor_submit_queue.h rebuilds its queue the same way)."""
+        import time as _time
+
+        backoff = 0.05
+        ineligible_deadline = None
+        while True:
+            try:
+                reply = self.wr.request("resolve_actor", (aid, False), timeout=30.0)
+                status = reply[0]
+            except queue.Empty:
+                status = "pending"
+            except Exception:
+                # Head unreachable (restarting?): keep polling — the worker
+                # process itself dies if the head never comes back, and
+                # declaring the ACTOR dead on a HEAD hiccup would be wrong.
+                status = "pending"
+            if status == "ineligible":
+                # ALIVE but momentarily unroutable (worker conn/peer
+                # endpoint gap during the restart hand-off): retry like
+                # "pending", but bounded — a worker whose peer listener
+                # failed to bind stays ineligible forever.
+                if ineligible_deadline is None:
+                    ineligible_deadline = _time.monotonic() + 60.0
+                if _time.monotonic() < ineligible_deadline:
+                    status = "pending"
+            else:
+                ineligible_deadline = None
+            if status == "pending":
+                _time.sleep(backoff)
+                backoff = min(backoff * 2, 0.5)
+                continue
+            if status == "direct":
+                conn = self._conn_to(tuple(reply[2]))
+                if conn is None:
+                    _time.sleep(backoff)
+                    backoff = min(backoff * 2, 0.5)
+                    continue
+                # Flush + route flip under ONE lock hold: flipping to
+                # "direct" before the buffer drains would let a racing
+                # submit() push a newer call ahead of the re-driven backlog
+                # (per-caller order violation).  conn.send is a leaf (it
+                # takes only the frame lock), so sending under the
+                # transport lock cannot deadlock.
+                send_failed = False
+                with self.lock:
+                    r = self.routes.get(aid)
+                    if not isinstance(r, ActorRoute):
+                        return
+                    to_send = r.buffered
+                    r.buffered = []
+                    for spec in to_send:
+                        self.inflight[spec.task_id] = (aid, spec, conn, None)
+                    for spec in to_send:
+                        if not conn.send(("pcall", spec)):
+                            send_failed = True
+                            break
+                        self.calls_sent += 1
+                    r.conn = conn
+                    r.state = "direct"
+                    r.recover_started = False
+                if send_failed:
+                    self._fail_inflight_on(conn)  # re-enters recovery
+                return
+            # dead / ineligible: the actor is gone for good
+            with self.lock:
+                r = self.routes.get(aid)
+                if not isinstance(r, ActorRoute):
+                    return
+                self.routes[aid] = "head"  # future calls relay (head errors them)
+                buffered = r.buffered
+                for spec in buffered:
+                    self.inflight.pop(spec.task_id, None)
+            from ray_tpu.exceptions import ActorDiedError
+
+            err = ActorDiedError(aid)
+            for spec in buffered:
+                for oid in spec.return_ids():
+                    self._land(oid, err, None)
+                for c in spec.contained_refs:
+                    self.wr.unborrow_ref(c)
+            return
+
+    def _resend_actor(self, aid: str, spec) -> bool:
+        """Re-push a retry-eligible failed actor call on the actor's
+        current route (same instance — app-exception retry), keeping the
+        existing (still-pending) result registrations."""
+        with self.lock:
+            r = self.routes.get(aid)
+            if not isinstance(r, ActorRoute):
+                return False
+            if r.state == "recovering" or r.conn is None:
+                self.inflight[spec.task_id] = (aid, spec, None, None)
+                r.buffered.append(spec)
+                return True
+            conn = r.conn
+            self.inflight[spec.task_id] = (aid, spec, conn, None)
+        if conn.send(("pcall", spec)):
+            return True
+        # This retry never ran: un-charge it so the death path's own
+        # re-charge doesn't bill two attempts for one observable failure.
+        spec.attempt -= 1
+        self._fail_inflight_on(conn)  # owns the outcome (recover or fail)
+        return True
 
     def _on_conn_death(self, conn: PeerConn) -> None:
         self._fail_inflight_on(conn)
@@ -689,15 +923,34 @@ class DirectTransport:
         actor rides ray_tpu.kill, not cancel).  Returns True when the oid
         belongs to a direct call this transport is tracking (cancelled or
         already finished — either way the head has nothing to do)."""
+        doomed = None
         with self.lock:
             target = None
             for tid, entry in self.inflight.items():
                 if oid in entry[1].return_ids():
-                    target = (tid, entry[2])
+                    target = (tid, entry)
                     break
             if target is None:
                 return oid in self.results  # finished (or never direct)
-        target[1].send(("pcancel", target[0]))
+            tid, (aid, spec, conn, _lease) = target
+            if conn is None:
+                # Buffered behind an actor recovery: queued-drop semantics
+                # apply caller-side — the call never reached any executor.
+                self.inflight.pop(tid, None)
+                r = self.routes.get(aid)
+                if isinstance(r, ActorRoute) and spec in r.buffered:
+                    r.buffered.remove(spec)
+                doomed = spec
+        if doomed is not None:
+            from ray_tpu.exceptions import TaskCancelledError
+
+            err = TaskCancelledError(doomed.name)
+            for o in doomed.return_ids():
+                self._land(o, err, None)
+            for c in doomed.contained_refs:
+                self.wr.unborrow_ref(c)
+            return True
+        conn.send(("pcancel", tid))
         return True
 
     # -- ownership -----------------------------------------------------------

@@ -441,6 +441,13 @@ class Runtime:
         # Lease grants awaiting a spawning worker's ready handshake:
         # worker_id -> [(caller, req_id, lease_id)].
         self._parked_peer_leases: Dict[str, list] = {}
+        # Lease-dispatched tasks currently running (caller-reported via
+        # batched task_events with state RUNNING): task table visibility
+        # for work the head never dispatched (ray: GcsTaskManager fed by
+        # TaskEventBuffer, gcs_task_manager.h:61).
+        self.direct_running: Dict[str, dict] = {}
+        self._direct_done_recent: set = set()
+        self._direct_done_order: deque = deque()
 
         from multiprocessing.connection import Listener
 
@@ -1597,15 +1604,48 @@ class Runtime:
         elif kind == "task_events":
             # Batched task-state reports for peer-executed (direct) tasks:
             # restores state-API/metrics visibility without a per-task
-            # head message on the latency path.
+            # head message on the latency path.  RUNNING events come from
+            # the CALLER at lease dispatch; completion events come from the
+            # EXECUTOR — different processes, so a completion may arrive
+            # first (the recent-done set keeps such entries from sticking
+            # as RUNNING forever).
             with self.lock:
                 for e in msg[1]:
+                    tid = e.get("task_id")
+                    if e.get("state") == "RUNNING":
+                        if tid not in self._direct_done_recent:
+                            # Bounded: crashes on BOTH sides of a direct
+                            # call can orphan an entry (no terminal event
+                            # ever arrives), so cap with FIFO eviction.
+                            while len(self.direct_running) >= 4096:
+                                self.direct_running.pop(
+                                    next(iter(self.direct_running))
+                                )
+                            self.direct_running[tid] = e
+                        continue
+                    self.direct_running.pop(tid, None)
+                    if len(self._direct_done_recent) >= 4096:
+                        self._direct_done_recent.discard(
+                            self._direct_done_order.popleft()
+                        )
+                    self._direct_done_recent.add(tid)
+                    self._direct_done_order.append(tid)
                     self.metrics["tasks_submitted"] += 1
                     self.metrics[
                         "tasks_finished" if e.get("state") == "FINISHED"
                         else "tasks_failed"
                     ] += 1
                     self.task_events.append(e)
+        elif kind == "direct_lineage":
+            # A lease-dispatched task produced shm results: remember its
+            # spec so the head can re-execute the producer if the bytes are
+            # later lost (ray: task_manager.h:90 keeps lineage for ALL
+            # direct tasks, not just relayed ones).
+            spec = msg[1]
+            if spec.actor_id is None:  # actor outputs are never re-executed
+                with self.lock:
+                    for rid in spec.return_ids():
+                        self._lineage_record(rid, spec)
         elif kind == "lease_return":
             with self.lock:
                 self._release_peer_lease_locked(msg[1], return_worker=True)
@@ -1613,8 +1653,8 @@ class Runtime:
             with self.lock:
                 ent = self._pending_fences.pop(msg[1], None)
             if ent is not None:
-                caller, req_id, awid, ep = ent
-                self._reply(caller, req_id, True, ("direct", awid, ep))
+                caller, req_id, awid, ep, restartable = ent
+                self._reply(caller, req_id, True, ("direct", awid, ep, restartable))
         elif kind == "direct_seal":
             # A direct call's large result, sealed in the callee's node
             # store: enter it in the directory/accounting and hold the
@@ -1720,6 +1760,7 @@ class Runtime:
                 info.actor_id,
                 spec.actor_method_names or [],
                 getattr(spec, "actor_max_concurrency", 1),
+                getattr(spec, "actor_max_task_retries", 0),
             )
         if op == "actor_state":
             info = self.state.get_actor(payload)
@@ -1765,32 +1806,33 @@ class Runtime:
                            need_fence: bool):
         """Directory lookup for the direct transport (peer.py).
 
-        Replies ("direct", worker_id, endpoint) only for actors whose
-        worker binding is immutable (max_restarts == 0) — a restartable
-        actor's calls keep the head path so the restart FSM sees them.
-        When the caller previously relayed calls (need_fence), the reply is
-        parked until a marker flushed through the actor worker's control
-        conn is acked: every relayed call is then provably in the executor
-        queue, so the caller's first direct push cannot overtake one.
+        Replies ("direct", worker_id, endpoint, restartable).  Restartable
+        actors are direct-eligible too — the caller's transport follows
+        the restart FSM through "pending" replies while RESTARTING and
+        re-resolves the new instance's endpoint (ray:
+        direct_actor_task_submitter.h:67).  When the caller previously
+        relayed calls (need_fence), the reply is parked until a marker
+        flushed through the actor worker's control conn is acked: every
+        relayed call is then provably in the executor queue, so the
+        caller's first direct push cannot overtake one.
         """
         with self.lock:
             info = self.state.get_actor(actor_id)
             ar = self.actors.get(actor_id)
             if info is None or ar is None or info.state == DEAD:
-                return ("dead", None, None)
-            if (info.max_restarts or 0) != 0:
-                return ("ineligible", None, None)
+                return ("dead", None, None, False)
+            restartable = (info.max_restarts or 0) != 0
             if info.state != ALIVE or not ar.worker_id:
-                return ("pending", None, None)
+                return ("pending", None, None, restartable)
             ep = self.worker_peer_endpoints.get(ar.worker_id)
             h = self.workers.get(ar.worker_id)
             if ep is None or h is None or h.conn is None:
-                return ("ineligible", None, None)
+                return ("ineligible", None, None, restartable)
             if not need_fence:
-                return ("direct", ar.worker_id, ep)
+                return ("direct", ar.worker_id, ep, restartable)
             self._fence_counter += 1
             fid = f"f{self._fence_counter}"
-            self._pending_fences[fid] = (wid, req_id, ar.worker_id, ep)
+            self._pending_fences[fid] = (wid, req_id, ar.worker_id, ep, restartable)
             self._send(h, ("fence", fid))
             return _PARKED
 
@@ -1970,6 +2012,21 @@ class Runtime:
     @staticmethod
     def _lineage_cost(spec) -> int:
         return len(spec.args_blob or b"") + 256  # blob + record overhead
+
+    @_locked
+    def _lineage_record(self, oid: str, spec) -> None:
+        """Caller holds self.lock.  Remember oid's producer spec for
+        lineage reconstruction, within the LRU budget (ray:
+        task_manager.h:97-104 lineage footprint accounting)."""
+        if oid not in self.lineage:
+            self.lineage_bytes += self._lineage_cost(spec)
+        self.lineage[oid] = spec
+        while self.lineage and (
+            len(self.lineage) > self.lineage_max
+            or self.lineage_bytes > self.lineage_max_bytes
+        ):
+            _, old = self.lineage.popitem(last=False)
+            self.lineage_bytes -= self._lineage_cost(old)
 
     @_locked
     def _reconstruct(self, oid: str) -> bool:
@@ -2411,15 +2468,7 @@ class Runtime:
                     self._put_packed(oid, data)
                 ready_ids.append(oid)
                 if spec.actor_id is None:
-                    if oid not in self.lineage:
-                        self.lineage_bytes += self._lineage_cost(spec)
-                    self.lineage[oid] = spec
-                    while self.lineage and (
-                        len(self.lineage) > self.lineage_max
-                        or self.lineage_bytes > self.lineage_max_bytes
-                    ):
-                        _, old = self.lineage.popitem(last=False)
-                        self.lineage_bytes -= self._lineage_cost(old)
+                    self._lineage_record(oid, spec)
             if spec.is_actor_creation:
                 self._on_actor_alive(spec.actor_id)
         else:
@@ -2459,8 +2508,23 @@ class Runtime:
         spec = rec.spec
         spec.attempt += 1
         self.metrics["tasks_retried"] += 1
-        if spec.actor_id is None:
-            self._release_for(rec)
+        if spec.actor_id is not None and not spec.is_actor_creation:
+            # Relayed actor-call retry: re-push to the actor's executor
+            # (the plain ready queue would lease a stateless worker and
+            # run the method without the actor instance).
+            ar = self.actors.get(spec.actor_id)
+            info = self.state.get_actor(spec.actor_id)
+            if ar is None or info is None or info.state == DEAD:
+                self._finish_with_error(rec, ActorDiedError(spec.actor_id),
+                                        release=False)
+                return
+            self.tasks[spec.task_id] = rec
+            if info.state == ALIVE and ar.worker_id:
+                self._push_actor_task(ar, rec)
+            else:
+                ar.queued.append(spec.task_id)
+            return
+        self._release_for(rec)
         if h is not None and h.state == "busy":
             self._return_worker(h)
         rec.state = "READY"
@@ -2595,12 +2659,22 @@ class Runtime:
         oom = self._oom_kills.pop(wid, None)
         env_fail = self._env_failures.pop(wid, None)
         self.worker_peer_endpoints.pop(wid, None)
+        # Lease-dispatched tasks running ON this worker die with it; their
+        # executors can never send the terminal event that would clear the
+        # RUNNING entry (the caller's retry, if any, re-reports).
+        for tid, e in list(self.direct_running.items()):
+            if e.get("worker_id") == wid:
+                self.direct_running.pop(tid, None)
         # Fences routed through this worker can never ack: fail them so the
         # caller falls back to the head path instead of hanging.
         for fid, ent in list(self._pending_fences.items()):
             if ent[2] == wid:
                 self._pending_fences.pop(fid, None)
-                self._reply(ent[0], ent[1], True, ("dead", None, None))
+                # Restartable actor: "pending" keeps the caller relaying
+                # until the new instance resolves; "dead" would pin the
+                # relay path forever.
+                verdict = "pending" if ent[4] else "dead"
+                self._reply(ent[0], ent[1], True, (verdict, None, None, ent[4]))
         # Leases die with the worker they lease (callers see the peer conn
         # EOF and retry) and with the CALLER that held them (its workers
         # return to the pool).
@@ -2742,16 +2816,6 @@ class Runtime:
             f"actor {actor_id} died"
             + (" (killed)" if ar.expected_death else " unexpectedly")
         )
-        # in-flight calls fail (ray: RayActorError for in-flight on death)
-        for tid in list(ar.in_flight):
-            rec = self.tasks.pop(tid, None)
-            if rec is not None:
-                for oid in rec.spec.return_ids():
-                    self.store.put_error(oid, err)
-                    self._object_ready(oid)
-                for c in rec.spec.contained_refs:
-                    self._decref_local(c)
-        ar.in_flight.clear()
         can_restart = (
             not ar.no_restart
             and not ar.expected_death
@@ -2759,6 +2823,29 @@ class Runtime:
                 info.max_restarts == -1 or info.num_restarts < info.max_restarts
             )
         )
+        # In-flight relayed calls: retry-budgeted ones re-queue onto the
+        # restarted instance (same semantics as the direct path's recovery
+        # re-drive; ray: max_task_retries); the rest fail ActorDiedError.
+        requeue: List[str] = []
+        for tid in list(ar.in_flight):
+            rec = self.tasks.get(tid)
+            if rec is None:
+                continue
+            if can_restart and rec.spec.attempt < rec.spec.max_retries:
+                rec.spec.attempt += 1
+                self.metrics["tasks_retried"] += 1
+                requeue.append(tid)
+                continue
+            self.tasks.pop(tid, None)
+            for oid in rec.spec.return_ids():
+                self.store.put_error(oid, err)
+                self._object_ready(oid)
+            for c in rec.spec.contained_refs:
+                self._decref_local(c)
+        ar.in_flight.clear()
+        if requeue:
+            # Prepend in order: these predate anything already queued.
+            ar.queued.extendleft(reversed(requeue))
         if can_restart:
             info.num_restarts += 1
             self.metrics["actor_restarts"] += 1

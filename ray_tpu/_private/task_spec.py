@@ -44,6 +44,9 @@ class TaskSpec:
     # named-actor lookups return it so a get_actor() handle schedules onto
     # the same executor as the creator's handle).
     actor_max_concurrency: int = 1
+    # Default per-method retry budget across actor restarts (ray:
+    # max_task_retries on @ray.remote actor classes).
+    actor_max_task_retries: int = 0
     max_restarts: int = 0
     is_async_actor: bool = False
     # "detached": the actor outlives its creating driver (ray: actor

@@ -55,6 +55,7 @@ SCHEMAS: Dict[str, Tuple[int, Optional[int], tuple]] = {
     "actor_exit": (1, 1, (str,)),
     "fence_ack": (1, 1, (str,)),
     "direct_seal": (3, 3, (str, int)),
+    "direct_lineage": (1, 1, ()),
     "promote": (3, 3, (str,)),
     "promote_error": (2, 2, (str,)),
     "seal_ow": (3, 3, (str, int)),

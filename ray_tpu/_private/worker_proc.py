@@ -71,6 +71,9 @@ class WorkerRuntime:
         self._fn_cache: Dict[str, Any] = {}
         self.current_actor = None  # instance, when this worker hosts an actor
         self.current_actor_id: Optional[str] = None
+        # Batched task-event reporter (installed by worker_main): the
+        # direct transport records lease-dispatch RUNNING events here.
+        self.task_event_sink = None
         self.async_loop = None
         self._async_loop_lock = threading.Lock()
 
@@ -547,6 +550,15 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
         if full:
             flush_task_events()
 
+    def _sink_event(e: dict) -> None:
+        with events_lock:
+            events_buf.append(e)
+            full = len(events_buf) >= 64
+        if full:
+            flush_task_events()
+
+    rt.task_event_sink = _sink_event
+
     def _events_ticker() -> None:
         import time as _time
 
@@ -695,6 +707,19 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
         # nothing — they are caller-owned, and the serialize-time guard
         # doubles as the caller-cache borrow.
         _task_id, results, err_blob = done[1], done[2], done[3]
+        if (
+            err_blob is None
+            and spec.actor_id is None
+            and any(item[1] == "shm" for item in results)
+        ):
+            # Sealed PLAIN-task results are reconstructable: ship the spec
+            # so the head keeps lineage for this lease-dispatched task
+            # (ray: task_manager.h:90 — owner-side lineage regardless of
+            # transport; actor-method outputs are excluded exactly like the
+            # relayed path — re-running a stateful method is not recovery).
+            # Must precede the direct_seal below (same FIFO) so lineage
+            # exists before the object is ever resolvable.
+            rt.oneway(("direct_lineage", spec))
         for item in results:
             oid, kind, data, contained = item
             if kind == "shm":

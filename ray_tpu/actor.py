@@ -27,17 +27,28 @@ def _public_methods(cls) -> List[str]:
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1,
+                 max_task_retries: Optional[int] = None,
+                 retry_exceptions: bool = False):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._max_task_retries = max_task_retries  # None = actor default
+        self._retry_exceptions = retry_exceptions
 
     def options(self, **opts) -> "ActorMethod":
-        return ActorMethod(self._handle, self._name, opts.get("num_returns", 1))
+        return ActorMethod(
+            self._handle, self._name,
+            opts.get("num_returns", self._num_returns),
+            opts.get("max_task_retries", self._max_task_retries),
+            bool(opts.get("retry_exceptions", self._retry_exceptions)),
+        )
 
     def remote(self, *args, **kwargs):
         return self._handle._actor_method_call(
-            self._name, args, kwargs, num_returns=self._num_returns
+            self._name, args, kwargs, num_returns=self._num_returns,
+            max_task_retries=self._max_task_retries,
+            retry_exceptions=self._retry_exceptions,
         )
 
     def __call__(self, *args, **kwargs):
@@ -48,10 +59,12 @@ class ActorMethod:
 
 
 class ActorHandle:
-    def __init__(self, actor_id: str, method_names: List[str], max_concurrency: int = 1):
+    def __init__(self, actor_id: str, method_names: List[str],
+                 max_concurrency: int = 1, max_task_retries: int = 0):
         self._actor_id = actor_id
         self._method_names = list(method_names)
         self._max_concurrency = max_concurrency
+        self._max_task_retries = max_task_retries
 
     @property
     def _id(self) -> str:
@@ -66,8 +79,13 @@ class ActorHandle:
             )
         return ActorMethod(self, name)
 
-    def _actor_method_call(self, method: str, args, kwargs, num_returns: int = 1):
+    def _actor_method_call(self, method: str, args, kwargs, num_returns: int = 1,
+                           max_task_retries: Optional[int] = None,
+                           retry_exceptions: bool = False):
         blob, contained, deps = build_args_blob(args, kwargs)
+        retries = (
+            self._max_task_retries if max_task_retries is None else max_task_retries
+        )
         spec = TaskSpec(
             task_id=ids.task_id(),
             name=f"{self._actor_id}.{method}",
@@ -80,6 +98,8 @@ class ActorHandle:
             actor_id=self._actor_id,
             method_name=method,
             max_concurrency=self._max_concurrency,
+            max_retries=int(retries or 0),
+            retry_exceptions=retry_exceptions,
         )
         refs = client.submit_actor_task(spec)
         if num_returns == 0:
@@ -89,7 +109,8 @@ class ActorHandle:
         return refs
 
     def __reduce__(self):
-        return (ActorHandle, (self._actor_id, self._method_names, self._max_concurrency))
+        return (ActorHandle, (self._actor_id, self._method_names,
+                              self._max_concurrency, self._max_task_retries))
 
     def __repr__(self) -> str:
         return f"ActorHandle({self._actor_id})"
@@ -129,8 +150,8 @@ class ActorClass:
         name = o.get("name")
         if name and o.get("get_if_exists"):
             try:
-                aid, methods, mc = client.get_named_actor(name, o.get("namespace"))
-                return ActorHandle(aid, methods, mc)
+                aid, methods, mc, mtr = client.get_named_actor(name, o.get("namespace"))
+                return ActorHandle(aid, methods, mc, mtr)
             except Exception:
                 pass
         cls_id = self._ensure_exported()
@@ -165,12 +186,14 @@ class ActorClass:
             max_restarts=int(o.get("max_restarts", 0)),
             max_concurrency=1,  # creation itself is ordered
             actor_max_concurrency=max_concurrency,
+            actor_max_task_retries=int(o.get("max_task_retries", 0)),
             scheduling_strategy=o.get("scheduling_strategy"),
             runtime_env=o.get("runtime_env"),
             lifetime=o.get("lifetime"),
         )
         client.create_actor(spec)
-        return ActorHandle(spec.actor_id, spec.actor_method_names, max_concurrency)
+        return ActorHandle(spec.actor_id, spec.actor_method_names, max_concurrency,
+                           spec.actor_max_task_retries)
 
 
 def exit_actor():
@@ -186,8 +209,8 @@ def exit_actor():
 
 
 def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
-    aid, methods, mc = client.get_named_actor(name, namespace)
+    aid, methods, mc, mtr = client.get_named_actor(name, namespace)
     # Carry the actor's real concurrency: calls through a looked-up handle
     # must land on the same executor as the creator's (a long-poll parked
     # on a 1-slot FIFO would serialize every other caller behind it).
-    return ActorHandle(aid, methods, mc)
+    return ActorHandle(aid, methods, mc, mtr)

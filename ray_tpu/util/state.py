@@ -36,6 +36,9 @@ def list_tasks(*, include_finished: bool = True, limit: int = 1000) -> List[Dict
                     "attempt": rec.spec.attempt,
                 }
             )
+        # Lease-dispatched tasks the head never scheduled (caller-reported
+        # RUNNING via batched task events — ray: gcs_task_manager.h:61).
+        out.extend(dict(e) for e in rt.direct_running.values())
         if include_finished:
             out.extend(dict(e) for e in rt.task_events)
     return out[:limit]

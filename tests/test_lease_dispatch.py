@@ -182,3 +182,78 @@ def test_ineligible_shapes_relay(ray_start_regular):
         return a, b
 
     assert ray_tpu.get(driver_task.remote(), timeout=120) == ("m1", "none")
+
+
+def test_leased_task_lost_result_reconstructs(ray_start_regular):
+    """A lease-dispatched task's sealed result survives byte loss: the
+    callee shipped its spec (direct_lineage), so the head re-executes the
+    producer when the segment vanishes (VERDICT r4 item 1b)."""
+    import numpy as np
+
+    @ray_tpu.remote
+    def produce(k):
+        return np.full((1 << 16,), k, dtype=np.int64)  # > inline threshold
+
+    @ray_tpu.remote
+    def driver_task():
+        r = produce.remote(9)  # nested: rides a lease
+        ray_tpu.get(r)  # materialized (sealed in the node store)
+        return r  # the ref escapes to the driver
+
+    ref = ray_tpu.get(driver_task.remote(), timeout=90)
+    from ray_tpu._private.runtime import get_runtime
+
+    rt = get_runtime()
+    # The head must have lineage for the leased task's result by the time
+    # its seal registered (direct_lineage precedes direct_seal in FIFO).
+    deadline = time.time() + 10
+    while ref.id not in rt.lineage and time.time() < deadline:
+        time.sleep(0.05)
+    assert ref.id in rt.lineage, "leased task's spec never reached lineage"
+    # Lose the bytes (simulates eviction past spill / segment corruption).
+    rt.store.shm.delete(ref.id)
+    with rt.store._available:
+        rt.store._in_shm.pop(ref.id, None)
+    arr = ray_tpu.get(ref, timeout=60)
+    assert int(arr.sum()) == 9 * (1 << 16)
+
+
+def test_leased_tasks_visible_in_task_table(ray_start_regular):
+    """Lease-dispatched tasks appear in the state API while RUNNING and
+    land in the finished history afterwards."""
+
+    @ray_tpu.remote
+    def slow(i):
+        time.sleep(1.2)
+        return i
+
+    @ray_tpu.remote
+    def driver_task(n):
+        return ray_tpu.get([slow.remote(i) for i in range(n)])
+
+    fut = driver_task.remote(3)
+    from ray_tpu.util.state import list_tasks
+
+    seen_running = False
+    deadline = time.time() + 30
+    while time.time() < deadline and not seen_running:
+        entries = [
+            t for t in list_tasks()
+            if t.get("name") == "slow" and t.get("state") == "RUNNING"
+            and t.get("direct")
+        ]
+        seen_running = bool(entries)
+        time.sleep(0.1)
+    assert ray_tpu.get(fut, timeout=90) == [0, 1, 2]
+    assert seen_running, "leased tasks never showed RUNNING in the task table"
+    deadline = time.time() + 10
+    done = []
+    while time.time() < deadline:
+        done = [
+            t for t in list_tasks()
+            if t.get("name") == "slow" and t.get("state") == "FINISHED"
+        ]
+        if len(done) >= 3:
+            break
+        time.sleep(0.2)
+    assert len(done) >= 3
