@@ -49,6 +49,11 @@ _DEFS: Dict[str, tuple] = {
         "warm worker-pool size prestarted at init (capped by node CPUs; "
         "ray: worker pool prestart)",
     ),
+    "use_zygote": (
+        1, int,
+        "1 = spawn local workers by forking the pre-warmed zygote "
+        "(~2ms); 0 = exec a fresh interpreter per worker (zygote.py)",
+    ),
     "worker_handshake_timeout_s": (
         60.0, float,
         "a spawned worker that hasn't connected within this window dies "

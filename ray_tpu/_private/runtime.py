@@ -144,6 +144,68 @@ class _PopenHandle:
         return self._p.pid
 
 
+class _ZygoteProcHandle:
+    """Handle for a worker forked by the zygote (not our child: no
+    waitpid — liveness via kill(pid, 0), termination via signals).  The
+    pid lands asynchronously with the zygote's ("forked", ...) reply; a
+    handle whose pid never arrives (zygote died mid-request) reads as
+    dead after a grace window so the reaper reschedules its lease."""
+
+    __slots__ = ("_pid", "_created", "_zygote")
+
+    def __init__(self, zygote_proc=None):
+        self._pid = None
+        self._created = time.monotonic()
+        self._zygote = zygote_proc
+
+    def set_pid(self, pid: int) -> None:
+        self._pid = pid
+
+    def _signal(self, sig) -> None:
+        if self._pid is not None:
+            try:
+                os.kill(self._pid, sig)
+            except (OSError, ProcessLookupError):
+                pass
+
+    def terminate(self):
+        import signal
+
+        self._signal(signal.SIGTERM)
+
+    def kill(self):
+        import signal
+
+        self._signal(signal.SIGKILL)
+
+    def join(self, timeout=None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self.is_alive():
+            if deadline is not None and time.monotonic() >= deadline:
+                return
+            time.sleep(0.05)
+
+    def is_alive(self):
+        if self._pid is None:
+            # Fork request in flight: while the zygote itself lives the
+            # fork will land (pid attribution may lag under load — a
+            # fixed grace here once mis-declared slow-boot storms dead,
+            # cascading into retry storms); a dead zygote means the
+            # request is lost after a short grace.
+            if self._zygote is not None and self._zygote.poll() is None:
+                return True
+            return time.monotonic() - self._created < 20.0
+        try:
+            os.kill(self._pid, 0)
+            return True
+        except (OSError, ProcessLookupError):
+            return False
+
+    @property
+    def pid(self):
+        return self._pid
+
+
 class _RemoteProcHandle:
     """Process facade for a worker owned by a node daemon: liveness comes
     from the worker's connection state; terminate routes through the daemon."""
@@ -221,6 +283,7 @@ class WorkerHandle:
         "known_fns",
         "pid",
         "spawn_ts",
+        "idle_since",
     )
 
     def __init__(self, worker_id, node_id, env_key, env_vars, proc):
@@ -237,6 +300,7 @@ class WorkerHandle:
         self.known_fns: Set[str] = set()
         self.pid = None
         self.spawn_ts = time.monotonic()
+        self.idle_since = 0.0
 
 
 class _ReadyQueue:
@@ -441,6 +505,20 @@ class Runtime:
         # Lease grants awaiting a spawning worker's ready handshake:
         # worker_id -> [(caller, req_id, lease_id)].
         self._parked_peer_leases: Dict[str, list] = {}
+        # Adaptive prestart (ray: worker_pool.h:156): pool-miss bursts
+        # raise the target; 5 quiet seconds halve it.  Topped up from the
+        # io-loop tick.
+        self._prestart_target = 0
+        self._prestart_miss_t = 0.0
+        self._prestart_decay_t = 0.0
+        # Zygote fork server (zygote.py): spawned lazily on first local
+        # worker spawn; until its handshake lands, spawns exec fresh
+        # interpreters.
+        self._zygote_conn = None
+        self._zygote_proc = None
+        self._zygote_spawning = False
+        self._zygote_axon_hook: Optional[str] = None
+        self._zygote_env: Optional[Dict[str, str]] = None
         # Lease-dispatched tasks currently running (caller-reported via
         # batched task_events with state RUNNING): task table visibility
         # for work the head never dispatched (ray: GcsTaskManager fed by
@@ -461,13 +539,15 @@ class Runtime:
         # worker then blocks forever in its auth recv).
         # Loopback by default; RAY_TPU_BIND_HOST=0.0.0.0 exposes the driver
         # to daemons on OTHER machines (required for cloud node providers).
+        # No authkey HERE: accept() must not run the challenge inline (it
+        # would serialize every connect behind the accept thread) — the
+        # per-conn handshake thread runs it (_auth_and_handshake).
         bind_host = _config.get("bind_host")
-        self.listener = Listener(
-            (bind_host, listen_port), backlog=128, authkey=self._authkey
-        )
+        self.listener = Listener((bind_host, listen_port), backlog=128)
         self.address = self.listener.address
         self._shutdown = False
         self._conn_to_worker: Dict[Any, str] = {}
+        self._conns_version = 0
         # Multi-host plane: per-node daemon processes owning remote worker
         # pools (ray: raylet main.cc) — node_id -> daemon conn, plus the
         # reverse map for EOF (= node death) detection in the io loop.
@@ -1020,8 +1100,9 @@ class Runtime:
         # re-runs unguarded user scripts (and fork would inherit the driver's
         # threads + live XLA client).  Matches the reference, whose raylet
         # execs default_worker.py (ray: src/ray/raylet/worker_pool.h:156,
-        # python/ray/_private/workers/default_worker.py).
-        import json
+        # python/ray/_private/workers/default_worker.py).  When the zygote
+        # fork server is up, spawns fork from its pre-imported interpreter
+        # instead (~2ms vs ~250ms) — see zygote.py.
         import subprocess
         import sys
 
@@ -1042,25 +1123,27 @@ class Runtime:
             "RAY_TPU_STORE_DIR": self.store.shm.dir,
             **worker_env_entries(renv),
         }
-        env = self._child_env(extra)
-        # runtime_env vars must exist at interpreter start (sitecustomize may
-        # import jax before worker_main applies them).
-        env.update({k: str(v) for k, v in env_vars.items()})
-        from ray_tpu._private.log_monitor import open_worker_logs
+        proc = self._zygote_fork(wid, extra, env_vars)
+        if proc is None:
+            env = self._child_env(extra)
+            # runtime_env vars must exist at interpreter start (sitecustomize
+            # may import jax before worker_main applies them).
+            env.update({k: str(v) for k, v in env_vars.items()})
+            from ray_tpu._private.log_monitor import open_worker_logs
 
-        outf, errf = open_worker_logs(self.log_dir, wid)
-        try:
-            popen = subprocess.Popen(
-                [sys.executable, "-m", "ray_tpu._private.worker_proc"],
-                env=env,
-                close_fds=True,
-                stdout=outf,
-                stderr=errf,
-            )
-        finally:
-            outf.close()  # the child holds its own dups; files outlive it
-            errf.close()
-        proc = _PopenHandle(popen)
+            outf, errf = open_worker_logs(self.log_dir, wid)
+            try:
+                popen = subprocess.Popen(
+                    [sys.executable, "-m", "ray_tpu._private.worker_proc"],
+                    env=env,
+                    close_fds=True,
+                    stdout=outf,
+                    stderr=errf,
+                )
+            finally:
+                outf.close()  # the child holds its own dups; files outlive it
+                errf.close()
+            proc = _PopenHandle(popen)
         handle = WorkerHandle(wid, node_id, env_key, renv, proc)
         self.workers[wid] = handle
         if prestart:
@@ -1068,6 +1151,80 @@ class Runtime:
             # is handed straight to its task.
             self.starting_pool.setdefault((node_id, env_key), []).append(wid)
         return handle
+
+    def _zygote_fork(self, wid: str, extra: Dict[str, str], env_vars) -> Optional[_ZygoteProcHandle]:
+        """Request a worker fork from the zygote; None = use the exec path
+        (zygote not up yet / just died — it is (re)spawned in the
+        background so the NEXT spawn forks)."""
+        from ray_tpu._private import config as _config
+
+        if not _config.get("use_zygote"):
+            return None
+        conn = self._zygote_conn
+        if conn is None:
+            self._ensure_zygote()
+            return None
+        # Start from the driver-env delta since the zygote's spawn: the
+        # exec path re-snapshots os.environ per spawn, and fork-served
+        # workers must not silently diverge (e.g. a token exported after
+        # init must reach both kinds of worker).
+        base = self._zygote_env or {}
+        overrides = {
+            k: v for k, v in os.environ.items() if base.get(k) != v
+        }
+        overrides.update(extra)
+        overrides.update({k: str(v) for k, v in (env_vars or {}).items()})
+        # The axon sitecustomize hook was stripped from the zygote's env
+        # (it would import jax there, and forking a live XLA client is
+        # undefined); restore it for the child so first jax use in the
+        # worker still reaches the TPU.
+        if self._zygote_axon_hook is not None:
+            overrides.setdefault("PALLAS_AXON_POOL_IPS", self._zygote_axon_hook)
+        from ray_tpu._private.log_monitor import worker_log_paths
+
+        os.makedirs(self.log_dir, exist_ok=True)
+        out_path, err_path = worker_log_paths(self.log_dir, wid)
+        try:
+            conn.send(("fork", wid, overrides, out_path, err_path))
+        except OSError:
+            self._zygote_conn = None
+            self._ensure_zygote()
+            return None
+        return _ZygoteProcHandle(self._zygote_proc)
+
+    def _ensure_zygote(self) -> None:
+        """Spawn the fork server (once; respawned if it dies).  Never
+        blocks: callers fall back to exec'ed workers until the zygote's
+        handshake lands."""
+        import subprocess
+        import sys
+
+        if self._shutdown:
+            return
+        if self._zygote_spawning:
+            # Pending spawn — unless it died before ever handshaking
+            # (import crash): then respawn.
+            if not (
+                self._zygote_conn is None
+                and self._zygote_proc is not None
+                and self._zygote_proc.poll() is not None
+            ):
+                return
+        self._zygote_spawning = True
+        env = self._child_env({"PYTHONUNBUFFERED": "1"})
+        # Keep jax out of the zygote (see zygote.py docstring).
+        self._zygote_axon_hook = env.pop("PALLAS_AXON_POOL_IPS", None)
+        self._zygote_env = dict(env)  # per-fork overrides diff against this
+        try:
+            self._zygote_proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.zygote"],
+                env=env,
+                close_fds=True,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+        except OSError:
+            self._zygote_spawning = False
 
     def _lease_worker(self, node_id: str, spec: TaskSpec) -> WorkerHandle:
         renv = spec.runtime_env or None
@@ -1086,6 +1243,15 @@ class Runtime:
             h = self.workers.get(wid)
             if h is not None and h.state == "starting":
                 return h
+        if env_key is None and node_id == self.head_node_id:
+            # Pool miss under default env: learn the burst size so the
+            # next wave binds to prestarted workers instead of paying a
+            # boot on the critical path (ray: worker_pool.h:156 prestart;
+            # the io-loop tick tops the pool back up to this target while
+            # the driver waits on results — converting barrier idle time
+            # into worker boots).
+            self._prestart_target = min(self._prestart_target + 1, 256)
+            self._prestart_miss_t = time.monotonic()
         return self._spawn_worker(node_id, env_key, renv)
 
     def _return_worker(self, h: WorkerHandle) -> None:
@@ -1093,6 +1259,7 @@ class Runtime:
             return
         h.state = "idle"
         h.current_task = None
+        h.idle_since = time.monotonic()
         self.idle_pool.setdefault((h.node_id, h.env_key), []).append(h.worker_id)
 
     def _send(self, h: WorkerHandle, msg: tuple) -> None:
@@ -1124,12 +1291,36 @@ class Runtime:
                     return
                 continue
             except Exception:
-                continue  # stranger failed the auth challenge
+                continue  # accept-level failure; keep serving
             set_nodelay(conn)
+            # The authkey challenge runs on the per-conn thread, NOT here:
+            # inline challenges serialize every connect behind one thread —
+            # at a 200-worker burst that was a measured ~16ms × N accept
+            # queue (the head's own connect RTT to a busy fresh child).
             threading.Thread(
-                target=self._handshake, args=(wire.wrap(conn),), daemon=True,
+                target=self._auth_and_handshake, args=(conn,), daemon=True,
                 name="raytpu-handshake",
             ).start()
+
+    def _auth_and_handshake(self, rawconn) -> None:
+        """Mutual HMAC challenge (what Listener(authkey=...) ran inline in
+        accept), then the application handshake.  Same order as the stdlib
+        server side — deliver first, answer second — so unchanged clients
+        (multiprocessing.connection.Client with authkey) interoperate."""
+        from multiprocessing.connection import answer_challenge, deliver_challenge
+
+        from ray_tpu._private import wire
+
+        try:
+            deliver_challenge(rawconn, self._authkey)
+            answer_challenge(rawconn, self._authkey)
+        except Exception:  # stranger failed the auth challenge
+            try:
+                rawconn.close()
+            except OSError:
+                pass
+            return
+        self._handshake(wire.wrap(rawconn))
 
     def _handshake(self, conn) -> None:
         from ray_tpu._private.wire import PROTOCOL_VERSION, ProtocolError
@@ -1212,6 +1403,7 @@ class Runtime:
                 )
                 self.driver_refs[did] = {}
                 self._conn_to_driver[conn] = did
+                self._conns_version += 1
             return
         if first[0] == "daemon":
             # Node daemon registration: ("daemon", node_id, cfg, pid).
@@ -1230,12 +1422,23 @@ class Runtime:
                     self.node_object_endpoints[node_id] = tuple(ep)
                 self.node_daemons[node_id] = conn
                 self._conn_to_daemon[conn] = node_id
+                self._conns_version += 1
                 self.events.emit("INFO", "node", "node registered", node_id=node_id)
                 # Fresh liveness clock: a stale entry from a previous
                 # incarnation of this node_id would instantly time the
                 # reconnected daemon out before its first heartbeat.
                 self._daemon_heartbeats[node_id] = time.monotonic()
                 self._dispatch()
+            return
+        if first[0] == "zygote":
+            # Fork server up: route subsequent local spawns through it.
+            with self.lock:
+                self._zygote_conn = conn
+                self._zygote_spawning = False
+            threading.Thread(
+                target=self._zygote_loop, args=(conn,), daemon=True,
+                name="raytpu-zygote",
+            ).start()
             return
         if first[0] == "env_failed":
             # The worker's runtime-env setup failed BEFORE it could serve:
@@ -1274,11 +1477,13 @@ class Runtime:
             h.pending_sends = []
             if h.state == "starting":
                 h.state = "idle"
+                h.idle_since = time.monotonic()
                 sp = self.starting_pool.get((h.node_id, h.env_key))
                 if sp and wid in sp:
                     sp.remove(wid)
                 self.idle_pool.setdefault((h.node_id, h.env_key), []).append(wid)
             self._conn_to_worker[conn] = wid
+            self._conns_version += 1
             self._grant_parked_leases(wid)
         with self.lock:
             self._dispatch()
@@ -1308,6 +1513,7 @@ class Runtime:
         h.pid = pid
         self.workers[wid] = h
         self._conn_to_worker[conn] = wid
+        self._conns_version += 1
         bound = None
         for aid, ar in self.actors.items():
             if ar.info.worker_id == wid and ar.info.state == RESTARTING:
@@ -1323,16 +1529,24 @@ class Runtime:
             self._on_actor_alive(bound)
         else:
             h.state = "idle"
+            # Stamp idleness NOW: the constructor default of 0.0 reads as
+            # idle-since-boot and the reaper would kill the adoptee on its
+            # next tick — destroying what adoption exists to preserve.
+            h.idle_since = time.monotonic()
             self.idle_pool.setdefault((nid, None), []).append(wid)
         self._dispatch()
         return h
 
     def _io_loop(self):
-        from multiprocessing.connection import wait as conn_wait
+        import selectors
 
         from ray_tpu._private import config as _cfg
 
+        sel = selectors.DefaultSelector()
+        registered: set = set()
+        registered_version = -1
         last_reap = 0.0
+        last_topup = 0.0
         while not self._shutdown:
             # Reap workers that died before ever connecting (spawn failure,
             # import crash): conn-EOF detection can't see them.
@@ -1365,6 +1579,28 @@ class Runtime:
                             h = self.workers.get(wid)
                             if h is not None and h.state != "dead":
                                 self._on_worker_crash(wid)
+                    # Idle-worker reaping (ray: worker_pool idle killing):
+                    # default-env head workers beyond the prestart floor
+                    # that sat idle >60s exit, so a burst's pool shrinks
+                    # back instead of holding memory forever.
+                    floor = max(
+                        _cfg.get("worker_prestart_count"), self._prestart_target
+                    )
+                    pool = self.idle_pool.get((self.head_node_id, None))
+                    if pool and len(pool) > floor:
+                        killed = 0
+                        for wid in list(pool):
+                            if len(pool) <= floor or killed >= 8:
+                                break
+                            h = self.workers.get(wid)
+                            if h is None:
+                                pool.remove(wid)
+                                continue
+                            if h.state == "idle" and now - h.idle_since > 60.0:
+                                pool.remove(wid)
+                                killed += 1
+                                self._expected_worker_stops.add(wid)
+                                self._send(h, ("kill",))
                     # Heartbeat timeouts: a hung (not dead) daemon or a
                     # half-open conn keeps the socket alive but stops
                     # heartbeating — declare the node dead so its leased
@@ -1385,23 +1621,64 @@ class Runtime:
                                     node_id=nid, silent_s=round(now - last, 1),
                                 )
                                 self._conn_to_daemon.pop(dconn, None)
+                                self._conns_version += 1
                                 self._daemon_heartbeats.pop(nid, None)
                                 try:
                                     dconn.close()
                                 except OSError:
                                     pass
                                 self._on_daemon_death(nid)
-            with self.lock:
-                conns = (
-                    list(self._conn_to_worker.keys())
-                    + list(self._conn_to_daemon.keys())
-                    + list(self._conn_to_driver.keys())
-                )
-            if not conns:
+            if self._prestart_target > 0 and now - last_topup > 0.05:
+                # Throttled: an every-iteration lock acquire here convoys
+                # with the hot message path during drains.
+                last_topup = now
+                with self.lock:
+                    t = self._prestart_target
+                    if now - self._prestart_miss_t > 5.0:
+                        if now - self._prestart_decay_t > 5.0:
+                            self._prestart_target = t // 2
+                            self._prestart_decay_t = now
+                    else:
+                        key = (self.head_node_id, None)
+                        have = len(self.idle_pool.get(key) or ()) + len(
+                            self.starting_pool.get(key) or ()
+                        )
+                        # ≤8 spawns per tick bounds the lock hold; the loop
+                        # runs ≥20Hz so a 50-wide burst refills within a
+                        # wave's barrier.
+                        for _ in range(min(t - have, 8)):
+                            self._spawn_worker(
+                                self.head_node_id, None, None, prestart=True
+                            )
+            # Persistent epoll registration (diffed, not rebuilt): the old
+            # per-iteration `multiprocessing.connection.wait` constructed a
+            # poll set of ALL conns on EVERY wakeup — O(live workers) per
+            # message, the measured collapse at 800+ live actors (ray:
+            # asio's reactor keeps persistent registrations the same way).
+            if self._conns_version != registered_version:
+                with self.lock:
+                    registered_version = self._conns_version
+                    current = (
+                        set(self._conn_to_worker)
+                        | set(self._conn_to_daemon)
+                        | set(self._conn_to_driver)
+                    )
+                for conn in registered - current:  # removals FIRST (fd reuse)
+                    try:
+                        sel.unregister(conn)
+                    except (KeyError, ValueError, OSError):
+                        pass
+                for conn in current - registered:
+                    try:
+                        sel.register(conn, selectors.EVENT_READ)
+                    except (KeyError, ValueError, OSError):
+                        current.discard(conn)
+                registered = current
+            if not registered:
                 time.sleep(0.02)
                 continue
             try:
-                readable = conn_wait(conns, timeout=0.05)
+                readable = [key.fileobj for key, _ in sel.select(timeout=0.05)]
             except OSError:
                 continue
             # Daemon conns first: an OOM-kill report must be applied before
@@ -1416,6 +1693,7 @@ class Runtime:
                     except (EOFError, OSError):
                         with self.lock:
                             self._conn_to_daemon.pop(conn, None)
+                            self._conns_version += 1
                             self._on_daemon_death(nid)
                         continue
                     if isinstance(dmsg, tuple) and dmsg and dmsg[0] == "log_lines":
@@ -1476,6 +1754,7 @@ class Runtime:
                     except (EOFError, OSError):
                         with self.lock:
                             self._conn_to_driver.pop(conn, None)
+                            self._conns_version += 1
                         self._on_driver_death(did)
                         continue
                     try:
@@ -1507,6 +1786,7 @@ class Runtime:
                 if eof:
                     with self.lock:
                         self._conn_to_worker.pop(conn, None)
+                        self._conns_version += 1
                         h = self.workers.get(wid)
                         if (
                             h is not None
@@ -1906,6 +2186,44 @@ class Runtime:
                 # state "peer_leased" forever, invisible to the scheduler.
                 self._release_peer_lease_locked(lease_id, return_worker=True)
                 self._reply(caller, req_id, True, ("busy",))
+
+    def _zygote_loop(self, conn) -> None:
+        """Recv loop for the zygote's conn: pid attributions for forked
+        workers and exit reports for reaped ones (boot crashes that never
+        produced a worker conn to EOF)."""
+        while True:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                break
+            if msg[0] == "forked":
+                wid, pid = msg[1], msg[2]
+                with self.lock:
+                    h = self.workers.get(wid)
+                    if h is not None and isinstance(h.proc, _ZygoteProcHandle):
+                        h.proc.set_pid(pid)
+            elif msg[0] == "worker_exited":
+                wid = msg[1]
+                with self.lock:
+                    h = self.workers.get(wid)
+                    if h is None or h.state == "dead":
+                        continue
+                    if (
+                        h.conn is None
+                        and h.state == "starting"
+                        and wid not in self._env_failures
+                        and wid not in self._deferred_crashes
+                    ):
+                        # Boot crash: give a possible env_failed hello
+                        # (separate conn) a beat before classifying, like
+                        # the reaper does.
+                        self._deferred_crashes[wid] = time.monotonic() + 2.0
+                    else:
+                        self._on_worker_crash(wid)
+        with self.lock:
+            if self._zygote_conn is conn:
+                self._zygote_conn = None
+                self._zygote_spawning = False
 
     def _park_get(self, wid: str, req_id: int, oid: str) -> None:
         """Caller holds self.lock: one once-subscription per parked get;
@@ -3123,6 +3441,11 @@ class Runtime:
         for proc in self._daemon_procs.values():
             try:
                 proc.terminate()
+            except OSError:
+                pass
+        if self._zygote_proc is not None:
+            try:
+                self._zygote_proc.terminate()
             except OSError:
                 pass
         for h in list(self.workers.values()):

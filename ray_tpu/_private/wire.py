@@ -74,6 +74,10 @@ SCHEMAS: Dict[str, Tuple[int, Optional[int], tuple]] = {
     "fence": (1, 1, (str,)),
     "kill": (0, 0, ()),
     "shutdown": (0, 1, ()),
+    # zygote fork server (zygote.py)
+    "zygote": (1, 1, (int,)),
+    "fork": (4, 4, (str, dict, str, str)),
+    "forked": (2, 2, (str, int)),
     # daemon <-> head
     "daemon": (3, 3, (str,)),
     "heartbeat": (0, 1, ()),

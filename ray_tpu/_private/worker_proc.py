@@ -422,6 +422,17 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
     # JAX_PLATFORMS / XLA_FLAGS take effect in this process).
     if env_vars:
         os.environ.update(env_vars)
+    if os.environ.get("RAY_TPU_BOOT_TRACE"):
+        import time as _t
+
+        _boot_t0 = _t.monotonic()
+
+        def _tr(label):
+            print(f"BOOT {label} +{1000*(_t.monotonic()-_boot_t0):.1f}ms", flush=True)
+    else:
+        def _tr(label):
+            pass
+    _tr("start")
     if os.environ.get("RAY_TPU_PDEATHSIG"):
         # Daemon-owned worker: die when the node daemon dies, even on
         # SIGKILL of the daemon (node-failure semantics — a raylet's
@@ -464,12 +475,14 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
     watchdog.start()
     conn = wire.connect(address, authkey)
     watchdog.cancel()
+    _tr("connected")
     from ray_tpu._private.netutil import set_nodelay
 
     set_nodelay(conn)
     conn_lock = threading.Lock()
     rt = WorkerRuntime(conn, conn_lock, session_name, worker_id, authkey=authkey)
     _runtime = rt
+    _tr("runtime")
 
     # Install ObjectRef refcount hooks: proxy to owner (oneway, FIFO with the
     # task's own completion message so no use-after-free races).
@@ -598,6 +611,7 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
     except OSError:
         peer_server, peer_endpoint = None, None  # no direct path; head relays
     rt.direct = DirectTransport(rt)
+    _tr("peer_server")
 
     def try_reconnect() -> bool:
         """Head conn lost: in head-split mode (reconnect window > 0) retry
@@ -763,6 +777,7 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
                 pass
             sys.exit(1)
 
+    _tr("pre_ready")
     with conn_lock:
         conn.send(("ready", worker_id, os.getpid(), node_id, peer_endpoint))
 
