@@ -35,6 +35,18 @@ def _peer_host() -> str:
     return _config.get("node_ip")
 
 
+def _apply_pythonpath(env: Dict[str, str]) -> None:
+    """Stamp PYTHONPATH so children resolve ray_tpu + the daemon's own
+    module search path (one implementation: worker env AND zygote env)."""
+    pkg_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    paths = [pkg_root] + [p for p in sys.path if p] + (
+        env.get("PYTHONPATH", "").split(os.pathsep) if env.get("PYTHONPATH") else []
+    )
+    env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(paths))
+
+
 def _build_worker_env(
     wid: str, host: str, port: int, authkey_hex: str, session: str, renv,
     store_dir: str, node_id: str,
@@ -71,13 +83,7 @@ def _build_worker_env(
     # Workers must die with their daemon even on SIGKILL (a raylet's workers
     # don't outlive node death): worker_main arms PR_SET_PDEATHSIG.
     env["RAY_TPU_PDEATHSIG"] = "1"
-    pkg_root = os.path.dirname(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    )
-    paths = [pkg_root] + [p for p in sys.path if p] + (
-        env.get("PYTHONPATH", "").split(os.pathsep) if env.get("PYTHONPATH") else []
-    )
-    env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(paths))
+    _apply_pythonpath(env)
     return env
 
 
@@ -165,6 +171,64 @@ def main() -> None:
 
     children: Dict[str, subprocess.Popen] = {}
     spawn_ts: Dict[str, float] = {}
+    # Zygote fork server for this node's workers (zygote.py): ~2ms forks
+    # from a pre-imported interpreter instead of ~250ms interpreter boots
+    # — and forked workers inherit numpy/cloudpickle already imported, so
+    # a cold broadcast pull doesn't pay a numpy import inside the
+    # unpickle (measured ~0.9s per worker on a contended host).
+    zyg: Dict[str, object] = {"conn": None, "proc": None, "env": None}
+    zpids: Dict[str, int] = {}  # zygote-forked wid -> pid
+
+    def start_zygote() -> None:
+        if not _config.get("use_zygote"):
+            return
+        from multiprocessing.connection import Pipe
+
+        parent, child = Pipe()
+        env = os.environ.copy()
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # no jax in the zygote
+        env["PYTHONUNBUFFERED"] = "1"
+        env["RAY_TPU_ZYGOTE_FD"] = str(child.fileno())
+        _apply_pythonpath(env)
+        try:
+            p = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.zygote"],
+                env=env,
+                pass_fds=[child.fileno()],
+                close_fds=True,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+        except OSError:
+            parent.close()
+            child.close()
+            return
+        child.close()
+        zyg["conn"] = wire.wrap(parent)
+        zyg["proc"] = p
+        zyg["env"] = env
+
+    def zygote_fork(wid: str, full_env: Dict[str, str]) -> bool:
+        zc = zyg["conn"]
+        if zc is None:
+            return False
+        base = zyg["env"] or {}
+        overrides = {k: v for k, v in full_env.items() if base.get(k) != v}
+        from ray_tpu._private.log_monitor import worker_log_paths
+
+        os.makedirs(log_dir, exist_ok=True)
+        out_path, err_path = worker_log_paths(log_dir, wid)
+        try:
+            zc.send(("fork", wid, overrides, out_path, err_path))
+        except OSError:
+            zyg["conn"] = None
+            start_zygote()
+            return False
+        zpids[wid] = -1  # pid lands with the ("forked", ...) reply
+        import time as _time
+
+        spawn_ts[wid] = _time.monotonic()
+        return True
 
     # OOM protection (ray: memory_monitor.h:52 + worker_killing_policy.h):
     # under memory pressure, kill ONE worker (retriable error head-side)
@@ -175,17 +239,22 @@ def main() -> None:
         # list() snapshot: the monitor thread iterates while the main loop
         # spawns/reaps; mutating a dict mid-iteration raises and the beat
         # would be silently skipped exactly during post-kill churn.
-        return {
+        out = {
             wid: (p.pid, spawn_ts.get(wid, 0.0))
             for wid, p in list(children.items())
             if p.poll() is None
         }
+        for wid, pid in list(zpids.items()):
+            if pid > 0:
+                out[wid] = (pid, spawn_ts.get(wid, 0.0))
+        return out
 
     oom_killed: Dict[str, tuple] = {}
 
     def _oom_kill(wid: str, rss: int, used: int, limit: int) -> None:
         p = children.get(wid)
-        if p is None:
+        zpid = zpids.get(wid)
+        if p is None and not (zpid and zpid > 0):
             return
         # Record + tell the head FIRST so the crash is classified as OOM,
         # then SIGKILL — a graceful terminate could block on the very
@@ -199,7 +268,10 @@ def main() -> None:
         except OSError:
             pass
         try:
-            p.kill()
+            if p is not None:
+                p.kill()
+            else:
+                os.kill(zpid, signal.SIGKILL)
         except OSError:
             pass
 
@@ -219,6 +291,17 @@ def main() -> None:
     def shutdown(*_a):
         if mem_monitor is not None:
             mem_monitor.stop()
+        if zyg["proc"] is not None:
+            try:
+                zyg["proc"].terminate()  # forked workers follow (pdeathsig)
+            except OSError:
+                pass
+        for pid in zpids.values():
+            if pid > 0:
+                try:
+                    os.kill(pid, signal.SIGTERM)
+                except OSError:
+                    pass
         for p in children.values():
             try:
                 p.terminate()
@@ -274,8 +357,54 @@ def main() -> None:
     # dead on timeout instead of trusting EOF alone.
     import time as _time
 
+    from multiprocessing.connection import wait as conn_wait
+
+    start_zygote()
     hb_period = _config.get("health_check_period_ms") / 1000.0
     last_hb = 0.0
+
+    pending_kills: set = set()  # kill_worker raced a fork in flight
+
+    def _report_exited(wid: str, rc) -> None:
+        zpids.pop(wid, None)
+        spawn_ts.pop(wid, None)
+        pending_kills.discard(wid)
+        try:
+            with send_lock:
+                conn.send(("worker_exited", wid, rc, oom_killed.pop(wid, None)))
+        except OSError:
+            pass
+
+    def drain_zygote() -> None:
+        zc = zyg["conn"]
+        while zc is not None:
+            try:
+                if not zc.poll(0):
+                    return
+                zmsg = zc.recv()
+            except (EOFError, OSError):
+                # Zygote died.  Its forked workers die with it (pdeathsig
+                # chains zygote -> worker) and fork requests in flight are
+                # lost — report every zygote worker exited so the head
+                # reschedules instead of waiting on a reply that will
+                # never come.
+                zyg["conn"] = None  # respawned on the next spawn request
+                for wid in list(zpids):
+                    _report_exited(wid, -1)
+                return
+            if zmsg[0] == "forked":
+                wid, pid = zmsg[1], zmsg[2]
+                zpids[wid] = pid
+                if wid in pending_kills:
+                    # A kill_worker landed while the fork was in flight:
+                    # apply it now instead of silently dropping it.
+                    pending_kills.discard(wid)
+                    try:
+                        os.kill(pid, signal.SIGTERM)
+                    except OSError:
+                        pass
+            elif zmsg[0] == "worker_exited":
+                _report_exited(zmsg[1], zmsg[2])
 
     while True:
         if stop_flag["stop"]:
@@ -290,13 +419,16 @@ def main() -> None:
             except OSError:
                 pass  # EOF path below handles reconnection
         try:
-            has_msg = conn.poll(0.5)
+            waitset = [conn] + ([zyg["conn"]] if zyg["conn"] is not None else [])
+            ready = conn_wait(waitset, timeout=0.5)
+            has_msg = conn in ready
         except (EOFError, OSError):
             conn = reconnect()
             if conn is None:
                 shutdown()
                 return
             continue
+        drain_zygote()
         reap()
         if not has_msg:
             continue
@@ -316,29 +448,41 @@ def main() -> None:
             env = _build_worker_env(
                 wid, host, port, authkey_hex, session, renv, store_dir, node_id
             )
-            outf, errf = open_worker_logs(log_dir, wid)
-            try:
-                children[wid] = subprocess.Popen(
-                    [sys.executable, "-m", "ray_tpu._private.worker_proc"],
-                    env=env,
-                    close_fds=True,
-                    stdout=outf,
-                    stderr=errf,
-                )
-                import time as _time
-
-                spawn_ts[wid] = _time.monotonic()
-            finally:
-                outf.close()
-                errf.close()
+            if zyg["conn"] is None:
+                start_zygote()  # died/never started: next spawn forks
+            if not zygote_fork(wid, env):
+                outf, errf = open_worker_logs(log_dir, wid)
+                try:
+                    children[wid] = subprocess.Popen(
+                        [sys.executable, "-m", "ray_tpu._private.worker_proc"],
+                        env=env,
+                        close_fds=True,
+                        stdout=outf,
+                        stderr=errf,
+                    )
+                    spawn_ts[wid] = _time.monotonic()
+                finally:
+                    outf.close()
+                    errf.close()
         elif kind == "kill_worker":
             p = children.get(msg[1])
+            zpid = zpids.get(msg[1])
             if p is not None:
                 try:
                     p.terminate()
                 except OSError:
                     pass
                 # reap() collects and reports it next cycle
+            elif zpid is not None and zpid > 0:
+                try:
+                    os.kill(zpid, signal.SIGTERM)
+                except OSError:
+                    pass
+                # the zygote reaps and reports it
+            elif zpid == -1:
+                # Fork in flight: remember the kill for the ("forked",
+                # pid) reply instead of dropping it.
+                pending_kills.add(msg[1])
         elif kind == "delete_object":
             # Owner freed the object (refcount hit zero): drop this node's
             # copy (ray: the raylet's local object manager eviction on

@@ -200,7 +200,8 @@ class WorkerRuntime:
             if obj is not None:
                 return obj
             n = pull_from_any(
-                endpoints, self.authkey, object_id, self.shm.create_from_chunks,
+                endpoints, self.authkey, object_id,
+                create_stream=self.shm.create_from_stream,
                 timeout=timeout,
             )
             if n is None:

@@ -79,11 +79,10 @@ def _child_entry(overrides: dict, out_path: str, err_path: str) -> None:
 
 def main() -> None:
     _arm_pdeathsig()
-    addr = (
-        os.environ["RAY_TPU_DRIVER_HOST"],
-        int(os.environ["RAY_TPU_DRIVER_PORT"]),
-    )
-    authkey = bytes.fromhex(os.environ["RAY_TPU_AUTHKEY"])
+    # Two attachment modes: an inherited pipe fd (daemon-owned zygotes —
+    # RAY_TPU_ZYGOTE_FD) or a connect-back to the head's listener (head
+    # runtime's zygote).
+    inherited_fd = os.environ.get("RAY_TPU_ZYGOTE_FD")
     # Pre-import the worker runtime + serialization stack.  Everything
     # here must be thread-free and fork-safe; jax/torch are NOT on this
     # list by design.
@@ -103,14 +102,24 @@ def main() -> None:
     import ray_tpu.exceptions  # noqa: F401
     from ray_tpu._private import wire
 
-    conn = wire.connect(addr, authkey)
+    if inherited_fd is not None:
+        from multiprocessing.connection import Connection
+
+        conn = wire.wrap(Connection(int(inherited_fd)))
+    else:
+        addr = (
+            os.environ["RAY_TPU_DRIVER_HOST"],
+            int(os.environ["RAY_TPU_DRIVER_PORT"]),
+        )
+        authkey = bytes.fromhex(os.environ["RAY_TPU_AUTHKEY"])
+        conn = wire.connect(addr, authkey)
     conn.send(("zygote", os.getpid()))
     children: dict = {}  # pid -> wid
 
     def reap() -> None:
         while children:
             try:
-                pid, _status = os.waitpid(-1, os.WNOHANG)
+                pid, status = os.waitpid(-1, os.WNOHANG)
             except ChildProcessError:
                 children.clear()
                 return
@@ -119,7 +128,11 @@ def main() -> None:
             wid = children.pop(pid, None)
             if wid is not None:
                 try:
-                    conn.send(("worker_exited", wid, pid))
+                    rc = os.waitstatus_to_exitcode(status)
+                except ValueError:
+                    rc = -1
+                try:
+                    conn.send(("worker_exited", wid, rc))
                 except OSError:
                     os._exit(0)
 

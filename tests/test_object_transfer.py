@@ -156,3 +156,56 @@ def test_node_death_then_reconstruction(two_isolated_nodes):
     time.sleep(1.0)
     arr = ray_tpu.get(ref, timeout=120)  # reconstructed via lineage
     assert int(arr.sum()) == 1024 * 1024
+
+
+def test_broadcast_staggers_pulls_across_sources(ray_start_regular):
+    """8-node broadcast of one object: pull grants are capped at the
+    number of source copies, excess pullers park until a new copy
+    registers, and every node still lands the full bytes (VERDICT r4
+    item 6 — the 1 GiB x 50-node scalability row's topology fix)."""
+    import numpy as np
+
+    from ray_tpu._private.runtime import get_runtime
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    rt = get_runtime()
+    nids = [rt.add_daemon_node(num_cpus=1) for _ in range(8)]
+    payload = np.arange(1 << 20, dtype=np.int64)  # 8MB
+    ref = ray_tpu.put(payload)
+
+    @ray_tpu.remote
+    def land(x):
+        return int(x.sum())
+
+    @ray_tpu.remote
+    def warm():
+        return 1
+
+    ray_tpu.get(
+        [
+            warm.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(n)
+            ).remote()
+            for n in nids
+        ],
+        timeout=300,
+    )
+    before_parks = rt.metrics["pull_parks"]
+    outs = ray_tpu.get(
+        [
+            land.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(n)
+            ).remote(ref)
+            for n in nids
+        ],
+        timeout=300,
+    )
+    expect = int(payload.sum())
+    assert outs == [expect] * 8
+    # 8 simultaneous pullers vs 1 initial source: someone must have parked.
+    assert rt.metrics["pull_parks"] > before_parks
+    # Every node registered its copy (the directory grew to all 8).
+    locs = rt.object_locations.get(ref.id, set())
+    assert len(locs) == 8, locs
+    for nid in nids:
+        rt.remove_node(nid)
