@@ -6,13 +6,14 @@ MFU / 0.45 — the north-star target from BASELINE.json ("Llama-7B DDP at
 >=45% MFU"); the reference itself has no TPU numbers to compare against
 (SURVEY.md §6: GPU-only).
 
-The long-context sweep re-measures the SAME model at seq 2048 and 4096
-(constant tokens/step — batch halves as sequence doubles), the regime
-where the flash-attention backward and remat policy earn their keep:
-`seq_sweep` reports both the 6ND parameter-MFU (comparable to the
-headline; it does not credit the quadratic attention work) and an
-attention-inclusive MFU (adds 12*L*d*seq flops/token for the score/value
-matmuls, fwd+bwd).
+The long-context sweep re-measures the same model shape at seq 2048,
+4096, 8192 and 16384 (constant tokens/step — batch halves as sequence
+doubles), the regime where the flash-attention backward and remat
+policy earn their keep.  The 16k point switches to full per-layer
+recompute (remat_policy=None) because the qkv_attn stash overflows
+single-chip HBM there — its extra recompute flops are NOT credited, so
+compare points via `mfu_attn_incl` (adds 12*L*d*seq flops/token for
+the score/value matmuls, fwd+bwd), not the 6ND parameter-MFU.
 
 Model is scaled to fit one chip's HBM (the driver runs single-chip); the
 multi-chip path — including ring attention over a seq-sharded mesh — is
@@ -112,16 +113,24 @@ def main():
             max_seq_len=seq_len,
             param_dtype=jnp.bfloat16,
             remat=True,
-            remat_policy="qkv_attn",
+            # 16k: the qkv_attn stash (~5 GB) overflows v5e HBM — switch
+            # to full per-layer recompute (remat_policy=None), the
+            # blockwise/remat long-seq mode (SURVEY §5.7); shorter points
+            # keep the faster policy.
+            remat_policy=None if seq_len >= 16384 else "qkv_attn",
         )
 
     head = _measure(make_cfg(1024), mesh, 16, 1024, steps=10, peak=peak)
 
-    # Long-context sweep: constant 16k tokens/step.  Guarded by wall-clock
-    # (the driver caps the bench run): skip remaining points if compiles
-    # already ate the budget.
+    # Long-context sweep to 16k: constant 16k tokens/step (batch halves as
+    # sequence doubles) — SURVEY §5.7, the axis the reference doesn't
+    # have.  The flash kernel streams K/V blocks, so HBM stays flat and
+    # no ring/offload switch is needed single-chip through 16k (the
+    # seq-sharded ring path is exercised by dryrun_multichip).  Guarded
+    # by wall-clock (the driver caps the bench run): skip remaining
+    # points if compiles already ate the budget.
     sweep = {}
-    for bs, seq in ((8, 2048), (4, 4096)):
+    for bs, seq in ((8, 2048), (4, 4096), (2, 8192), (1, 16384)):
         if time.perf_counter() - t_start > 420:
             sweep[str(seq)] = {"skipped": "bench time budget"}
             continue

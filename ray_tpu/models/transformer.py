@@ -281,6 +281,9 @@ def _remat_policy(config: TransformerConfig):
     if config.remat_policy == "qkv_attn":
         return jax.checkpoint_policies.save_only_these_names("q", "k", "v", "attn")
     if config.remat_policy is None:
+        # Save nothing per layer (full recompute in bwd) — the minimum-
+        # memory mode long-context configs rely on (at 16k the qkv_attn
+        # stash alone is ~5 GB on the bench model, past v5e HBM).
         return None
     raise ValueError(
         f"unknown remat_policy {config.remat_policy!r}; "
