@@ -240,7 +240,12 @@ class CoreClient:
     def pg_create(self, bundles, strategy, name=None) -> str:
         wr = self._wr()
         if wr is not None:
-            return wr.request("pg_create", (bundles, strategy, name))
+            # Mint the id CLIENT-side: a request retried across a head
+            # bounce then dedupes instead of double-reserving bundles.
+            from ray_tpu._private import ids as _ids
+
+            pg_id = _ids.placement_group_id()
+            return wr.request("pg_create", (bundles, strategy, name, pg_id))
         return self._rt().create_placement_group(bundles, strategy, name).pg_id
 
     def pg_state(self, pg_id: str) -> Optional[str]:

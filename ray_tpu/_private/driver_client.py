@@ -103,6 +103,8 @@ def attach(
     )
     rt.owns_store_dir = not shared_store
     rt.force_inline_puts = not shared_store
+    rt.reconnect_window_override = float(meta.get("reconnect_window_s") or 0)
+    rt._attach_info = (host, port, key, did, bool(shared_store))
     worker_proc._runtime = rt
 
     from ray_tpu._private import refs as refs_mod
@@ -123,12 +125,78 @@ def attach(
     return rt
 
 
+def _try_reconnect(rt) -> bool:
+    """Head conn lost: re-attach to the head's FIXED address within its
+    reconnect window (a restarted head re-registers this driver and its
+    requests re-send — ray: client reconnect after GCS failover)."""
+    import time as _time
+
+    from ray_tpu._private import wire
+    from ray_tpu._private.netutil import set_nodelay
+
+    window = rt.reconnect_window_override or 0
+    if window <= 0 or getattr(rt, "_detaching", False):
+        return False
+    host, port, key, did, shared = rt._attach_info
+    deadline = _time.monotonic() + window
+    while _time.monotonic() < deadline:
+        if getattr(rt, "_detaching", False):
+            return False
+        try:
+            c = wire.connect((host, port), key)
+            set_nodelay(c)
+            c.send(("driver", did, os.getpid()))
+            ack = c.recv()
+            if not (isinstance(ack, tuple) and ack and ack[0] == "driver_ack"):
+                c.close()
+                _time.sleep(0.5)
+                continue
+            c.send(("driver_store", did, shared))
+        except Exception:
+            _time.sleep(0.5)
+            continue
+        flushed = True
+        with rt.conn_lock:
+            try:
+                rt.conn.close()
+            except OSError:
+                pass
+            rt.conn = c
+            with rt._backlog_lock:
+                backlog, rt._oneway_backlog = rt._oneway_backlog, []
+            try:
+                while backlog:
+                    rt.conn.send(backlog[0])
+                    backlog.pop(0)
+            except OSError:
+                # Head bounced again mid-flush: restore the unsent tail
+                # and RETRY within the window (there is no outer loop to
+                # re-enter — giving up here would strand the driver while
+                # most of the window remains).
+                with rt._backlog_lock:
+                    rt._oneway_backlog[:0] = backlog
+                flushed = False
+        if not flushed:
+            _time.sleep(0.5)
+            continue
+        err = ConnectionError("head connection was reset (head restart)")
+        for req_id in list(rt._pending):
+            q = rt._pending.pop(req_id, None)
+            if q is not None:
+                q.put((False, err))
+        return True
+    return False
+
+
 def _recv_loop(rt) -> None:
     while True:
         try:
             msg = rt.conn.recv()
         except (EOFError, OSError):
-            # Head gone: fail every in-flight request instead of hanging.
+            if _try_reconnect(rt):
+                continue
+            # Head gone for good: fail every in-flight request instead of
+            # hanging.
             err = ConnectionError("lost connection to ray_tpu head")
             for req_id, q in list(rt._pending.items()):
                 rt._pending.pop(req_id, None)
@@ -149,6 +217,7 @@ def detach() -> None:
     if rt is None:
         return
     _attached = None
+    rt._detaching = True  # the recv loop must not reconnect a detach
     from ray_tpu._private import refs as refs_mod
     from ray_tpu._private import runtime as runtime_mod
     from ray_tpu._private import worker_proc

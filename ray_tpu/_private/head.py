@@ -73,6 +73,10 @@ def main() -> None:
     # The head's cluster is restart-survivable: daemons/workers retry the
     # head's FIXED address for this window instead of dying on conn EOF.
     os.environ.setdefault("RAY_TPU_RECONNECT_WINDOW_S", "30")
+    # Standalone heads default to the crash-safe journaled backend — a
+    # restart is this process's reason to exist (ray: GCS FT requires the
+    # Redis-backed store; sqlite is our dependency-free analogue).
+    os.environ.setdefault("RAY_TPU_GCS_STORAGE_BACKEND", "sqlite")
 
     # Reuse the previous incarnation's port + authkey (same session) so
     # surviving daemons/workers can find and authenticate to the restarted

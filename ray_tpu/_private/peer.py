@@ -1024,6 +1024,16 @@ class DirectTransport:
         dr.promoted = True
         return True
 
+    def replay_promotions(self) -> None:
+        """After a head restart: re-send every landed, already-promoted
+        caller-owned result — the old head's memory store died with it,
+        and borrowers elsewhere still hold the refs (ray: workers
+        re-registering state with a restarted GCS)."""
+        with self.lock:
+            for oid, dr in list(self.results.items()):
+                if dr.promoted and dr.event.is_set():
+                    self._send_promotion(oid, dr)
+
     def _send_promotion(self, oid: str, dr: DirectResult) -> None:
         """Upload an owned object's bytes (inline) or error to the head.
         shm results were already registered by the callee's direct_seal —

@@ -1386,6 +1386,8 @@ class Runtime:
             # reads) or stays remote (ray://-style: conn + transfer plane).
             _, did, _pid = first
             try:
+                from ray_tpu._private import config as _config
+
                 conn.send(
                     (
                         "driver_ack",
@@ -1393,6 +1395,10 @@ class Runtime:
                             "session": self.session_name,
                             "namespace": self.namespace,
                             "store_dir": self.store.shm.dir,
+                            # Clients adopt the HEAD's reconnect window (the
+                            # env knob lives in the head process, not in
+                            # every attaching driver).
+                            "reconnect_window_s": _config.get("reconnect_window_s"),
                         },
                     )
                 )
@@ -1402,11 +1408,23 @@ class Runtime:
                 return
             shared = bool(second[2]) if second[0] == "driver_store" else False
             with self.lock:
+                old = self.drivers.get(did)
+                if old is not None and old is not conn:
+                    # Reconnect over a LIVE head (transient TCP reset): the
+                    # old conn's pending EOF must clean only itself — not
+                    # declare the reconnected driver dead (the EOF handler
+                    # checks drivers[did] identity) — and the borrow counts
+                    # this driver still holds must survive.
+                    self._conn_to_driver.pop(old, None)
+                    try:
+                        old.close()
+                    except OSError:
+                        pass
                 self.drivers[did] = conn
                 self.driver_nodes[did] = (
                     self.head_node_id if shared else f"drvnode-{did}"
                 )
-                self.driver_refs[did] = {}
+                self.driver_refs.setdefault(did, {})
                 self._conn_to_driver[conn] = did
                 self._conns_version += 1
             return
@@ -1760,7 +1778,12 @@ class Runtime:
                         with self.lock:
                             self._conn_to_driver.pop(conn, None)
                             self._conns_version += 1
-                        self._on_driver_death(did)
+                            superseded = self.drivers.get(did) is not conn
+                        if not superseded:
+                            # A re-handshaken driver (newer conn for the
+                            # same did) is alive: this EOF is only the OLD
+                            # socket dying.
+                            self._on_driver_death(did)
                         continue
                     try:
                         self._handle_msg(did, msg)
@@ -2082,8 +2105,9 @@ class Runtime:
         if op == "kv_keys":
             return self.state.kv_keys(*payload)
         if op == "pg_create":
-            bundles, strategy, name = payload
-            return self.create_placement_group(bundles, strategy, name).pg_id
+            bundles, strategy, name = payload[0], payload[1], payload[2]
+            pg_id = payload[3] if len(payload) > 3 else None
+            return self.create_placement_group(bundles, strategy, name, pg_id).pg_id
         if op == "pg_state":
             pg = self.state.placement_groups.get(payload)
             return pg.state if pg else None
@@ -2634,6 +2658,15 @@ class Runtime:
         rec.allow_pending = allow_pending
         return_ids = spec.return_ids()
         with self.lock:
+            # Idempotent by task id: a client retrying across a head bounce
+            # (its reply was lost) must not double-register the task
+            # (ray: GCS dedupes re-registrations after failover the same
+            # way).  Already-running: same record; already-finished: the
+            # results are in the store.
+            if spec.task_id in self.tasks or (
+                return_ids and all(self.store.is_ready(o) for o in return_ids)
+            ):
+                return return_ids
             self.metrics["tasks_submitted"] += 1
             if spec.is_actor_creation:
                 self.metrics["actors_created"] += 1
@@ -2659,6 +2692,9 @@ class Runtime:
         return return_ids
 
     def create_actor(self, spec: TaskSpec, owner_did: Optional[str] = None) -> str:
+        with self.lock:
+            if spec.actor_id in self.actors:
+                return spec.actor_id  # client retry across a head bounce
         info = ActorInfo(
             actor_id=spec.actor_id,
             name=spec.actor_name,
@@ -2677,6 +2713,10 @@ class Runtime:
     def submit_actor_task(self, spec: TaskSpec) -> List[str]:
         return_ids = spec.return_ids()
         with self.lock:
+            if spec.task_id in self.tasks or (
+                return_ids and all(self.store.is_ready(o) for o in return_ids)
+            ):
+                return return_ids  # client retry across a head bounce
             ar = self.actors.get(spec.actor_id)
             info = self.state.get_actor(spec.actor_id)
             if ar is None or info is None or info.state == DEAD:
@@ -3424,9 +3464,17 @@ class Runtime:
 
     # -- placement groups ----------------------------------------------------
 
-    def create_placement_group(self, bundles, strategy, name=None) -> PlacementGroupInfo:
+    def create_placement_group(
+        self, bundles, strategy, name=None, pg_id: Optional[str] = None
+    ) -> PlacementGroupInfo:
+        """pg_id may be CLIENT-minted so a request retried across a head
+        bounce dedupes instead of creating (and leaking the reservations
+        of) a second group."""
+        with self.lock:
+            if pg_id is not None and pg_id in self.state.placement_groups:
+                return self.state.placement_groups[pg_id]
         pg = PlacementGroupInfo(
-            pg_id=ids.placement_group_id(),
+            pg_id=pg_id or ids.placement_group_id(),
             bundles=[{k: float(v) for k, v in b.items()} for b in bundles],
             strategy=strategy,
             name=name,

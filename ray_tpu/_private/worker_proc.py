@@ -74,30 +74,84 @@ class WorkerRuntime:
         # Batched task-event reporter (installed by worker_main): the
         # direct transport records lease-dispatch RUNNING events here.
         self.task_event_sink = None
+        # Oneways that failed during a head bounce, flushed on reconnect.
+        self._oneway_backlog: list = []
+        self._backlog_lock = threading.Lock()
+        # Attached drivers adopt the head's window (their own env may not
+        # carry the knob); None = read the local config.
+        self.reconnect_window_override: Optional[float] = None
         self.async_loop = None
         self._async_loop_lock = threading.Lock()
 
     # -- request/reply to driver --------------------------------------------
 
+    def _reconnect_window(self) -> float:
+        if self.reconnect_window_override is not None:
+            return self.reconnect_window_override
+        from ray_tpu._private import config as _config
+
+        return _config.get("reconnect_window_s")
+
     def request(self, op: str, payload: Any, timeout: Optional[float] = None) -> Any:
+        """Request/reply to the owner.  In head-split mode a request that
+        dies with the head conn is RE-SENT on the reconnected one (the
+        restarted head's ops are idempotent by task/actor id), so a get()
+        blocked across a head bounce resolves instead of erroring —
+        ray: gcs_failover_worker_reconnect_timeout semantics."""
+        import time as _time
+
+        deadline = None
+        last_err = None
+        while True:
+            try:
+                return self._request_once(op, payload, timeout)
+            except ConnectionError:
+                window = self._reconnect_window()
+                if window <= 0:
+                    raise
+                now = _time.monotonic()
+                # A fresh INCIDENT (no failure within the last window)
+                # gets a fresh budget: a request that rode out one bounce
+                # hours ago must not be left with zero window at the next.
+                if last_err is None or now - last_err > window + 10.0:
+                    deadline = now + window + 10.0
+                last_err = now
+                if now > deadline:
+                    raise
+                _time.sleep(0.2)  # recv thread is swapping the conn
+
+    def _request_once(self, op: str, payload: Any, timeout: Optional[float]) -> Any:
         with self._req_lock:
             self._req_counter += 1
             req_id = self._req_counter
             q: queue.Queue = queue.Queue(1)
             self._pending[req_id] = q
-        with self.conn_lock:
-            self.conn.send(("req", req_id, op, payload))
+        try:
+            with self.conn_lock:
+                self.conn.send(("req", req_id, op, payload))
+        except OSError as e:
+            self._pending.pop(req_id, None)
+            raise ConnectionError("head connection lost mid-send") from e
         ok, value = q.get(timeout=timeout)
         if not ok:
             raise value
         return value
 
     def oneway(self, msg: tuple) -> None:
-        try:
-            with self.conn_lock:
+        with self.conn_lock:
+            try:
                 self.conn.send(msg)
-        except OSError:
-            pass
+            except OSError:
+                # Head away (restart window): hold the message — seals,
+                # refops, and promotions carry ownership state the
+                # restarted head must still learn.  Appended INSIDE the
+                # conn_lock hold: the reconnect flush (also under
+                # conn_lock) can't interleave, so a failed send can never
+                # strand its message behind an already-finished flush.
+                if self._reconnect_window() > 0:
+                    with self._backlog_lock:
+                        if len(self._oneway_backlog) < 4096:
+                            self._oneway_backlog.append(msg)
 
     def _on_reply(self, req_id: int, ok: bool, value: Any) -> None:
         q = self._pending.pop(req_id, None)
@@ -639,7 +693,9 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
         # Swap AND send the hello under ONE conn_lock hold: a concurrent
         # oneway/done send slipping between them would become the new
         # conn's first message and the head's handshake (which expects
-        # "ready") would drop the conn.
+        # "ready") would drop the conn.  The bounce-window backlog flushes
+        # inside the same hold, so held oneways (seals, refops) precede
+        # anything other threads send on the fresh conn.
         with conn_lock:
             try:
                 rt.conn.close()
@@ -650,15 +706,33 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
                 rt.conn.send(
                     ("ready", worker_id, os.getpid(), node_id, peer_endpoint)
                 )
+                with rt._backlog_lock:
+                    backlog, rt._oneway_backlog = rt._oneway_backlog, []
+                try:
+                    while backlog:
+                        rt.conn.send(backlog[0])
+                        backlog.pop(0)
+                except OSError:
+                    # Head bounced again mid-flush: the UNSENT tail goes
+                    # back (ownership state must survive repeated bounces).
+                    with rt._backlog_lock:
+                        rt._oneway_backlog[:0] = backlog
+                    return False  # outer recv loop re-enters
             except OSError:
                 return False  # head bounced again; outer loop re-enters
-        # In-flight request replies died with the old conn: fail them so
-        # blocked callers raise instead of hanging forever.
+        # In-flight request replies died with the old conn: fail them with
+        # ConnectionError — request() re-sends on this new conn (the
+        # restarted head's ops are idempotent by id).
         err = ConnectionError("head connection was reset (head restart)")
         for req_id in list(rt._pending):
             q = rt._pending.pop(req_id, None)
             if q is not None:
                 q.put((False, err))
+        # Caller-owned direct results the OLD head learned of (promotions)
+        # died with its memory: re-teach the new head so other processes
+        # still resolve those refs.
+        if rt.direct is not None:
+            rt.direct.replay_promotions()
         return True
 
     def recv_loop():

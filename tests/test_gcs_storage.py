@@ -81,3 +81,50 @@ def test_head_restart_replays_via_sqlite(tmp_path, monkeypatch):
     finally:
         rt2.shutdown()
     config._values.pop("gcs_storage_backend", None)
+
+
+def test_snapshot_version_mismatch_refuses_restore(tmp_path, capsys):
+    """A version-bumped document must refuse LOUDLY, not silently clean-
+    boot (the wire got versioning in r4; the snapshot document now too)."""
+    from ray_tpu._private import gcs_storage as gs
+
+    path = str(tmp_path / "snap.pkl")
+    st = gs.FileSnapshotStorage(path)
+    st.save("s1", {"session": "s1", "kv": {}})
+    snap = st.load("s1")
+    assert snap is not None and snap["snapshot_version"] == gs.SNAPSHOT_VERSION
+
+    # Forge a future-version document.
+    import pickle
+
+    with open(path, "wb") as f:
+        pickle.dump({"session": "s1", "snapshot_version": 999}, f)
+    assert st.load("s1") is None
+    err = capsys.readouterr().err
+    assert "REFUSING snapshot restore" in err
+    import os
+    assert os.path.exists(path + ".refused"), "refused doc must be kept aside"
+
+
+def test_snapshot_corrupt_file_set_aside(tmp_path, capsys):
+    from ray_tpu._private import gcs_storage as gs
+    import os
+
+    path = str(tmp_path / "snap.pkl")
+    with open(path, "wb") as f:
+        f.write(b"not a pickle at all")
+    st = gs.FileSnapshotStorage(path)
+    assert st.load("s1") is None
+    err = capsys.readouterr().err
+    assert "unreadable" in err
+    assert os.path.exists(path + ".corrupt"), "evidence must be kept aside"
+
+
+def test_sqlite_version_stamp(tmp_path):
+    from ray_tpu._private import gcs_storage as gs
+
+    st = gs.SqliteSnapshotStorage(str(tmp_path / "snaps.db"))
+    st.save("s2", {"session": "s2"})
+    snap = st.load("s2")
+    assert snap is not None and snap["snapshot_version"] == gs.SNAPSHOT_VERSION
+    st.close()

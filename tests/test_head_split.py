@@ -339,3 +339,58 @@ def test_head_restart_redrives_inflight_tasks(tmp_path):
         os.environ.pop("RAY_TPU_PDEATHSIG", None)
         if proc.poll() is None:
             proc.kill()
+
+
+def test_head_kill9_live_driver_and_inflight_survive(tmp_path):
+    """kill -9 the head mid-flight (VERDICT r4 item 4): the ATTACHED
+    driver holds its session through the bounce (reconnect window +
+    request re-send), a get() blocked on an in-flight task resolves
+    (snapshot re-drive + idempotent re-registration), and a detached
+    actor keeps serving on the same driver connection — no re-init."""
+    proc, head_json = launch_head_subprocess(
+        str(tmp_path), num_cpus=4, session="hlive"
+    )
+    try:
+        ray_tpu.init(address=head_json)
+
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def incr(self):
+                self.n += 1
+                return self.n
+
+        a = ray_tpu.remote(Counter).options(
+            name="live", lifetime="detached"
+        ).remote()
+        assert ray_tpu.get(a.incr.remote(), timeout=60) == 1
+
+        @ray_tpu.remote
+        def slow():
+            import time as _t
+
+            _t.sleep(6)
+            return "done"
+
+        ref = slow.remote()
+        time.sleep(2.0)  # dispatched + captured by a snapshot tick
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10)
+        proc2, _ = launch_head_subprocess(
+            str(tmp_path), num_cpus=4, session="hlive"
+        )
+        try:
+            # SAME attached session — the driver was never re-initialized.
+            assert ray_tpu.get(ref, timeout=120) == "done"
+            assert ray_tpu.get(a.incr.remote(), timeout=90) >= 2
+        finally:
+            ray_tpu.shutdown()
+            proc2.terminate()
+            try:
+                proc2.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc2.kill()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
