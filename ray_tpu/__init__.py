@@ -54,6 +54,7 @@ def init(
     _system_config: Optional[Dict[str, Any]] = None,
     address: Optional[str] = None,
     _authkey: Optional[str] = None,
+    log_to_driver: bool = True,
     **_unused,
 ):
     """Start the per-host runtime (driver mode), or ATTACH to a standalone
@@ -89,9 +90,17 @@ def init(
     if address is not None:
         from ray_tpu._private import driver_client
 
-        driver_client.attach(address, authkey=_authkey, namespace=namespace)
+        driver_client.attach(
+            address, authkey=_authkey, namespace=namespace,
+            log_to_driver=log_to_driver,
+        )
         return
-    rt.init_runtime(num_cpus=num_cpus, resources=resources, namespace=namespace)
+    runtime = rt.init_runtime(
+        num_cpus=num_cpus, resources=resources, namespace=namespace
+    )
+    # Honor the flag in LOCAL driver mode too (the runtime's default comes
+    # from the log_to_driver config knob).
+    runtime.log_to_driver = bool(log_to_driver) and runtime.log_to_driver
 
 
 def shutdown():

@@ -35,6 +35,7 @@ def attach(
     authkey: Optional[str] = None,
     namespace: str = "default",
     shared_store: Optional[bool] = None,
+    log_to_driver: bool = True,
 ):
     """Connect to a head.  `address` is a path to head.json (or its session
     dir), or a "host:port" string with `authkey` passed explicitly."""
@@ -121,8 +122,24 @@ def attach(
     )
     t.start()
     rt._recv_thread = t
+    if log_to_driver:
+        # Worker output streams to this driver push-style over the
+        # control conn (cross-process pubsub — ray: the driver's print
+        # subscriber on the GCS log channel, _private/worker.py).
+        rt.subscribe("logs", "*", _print_log_lines)
     _attached = rt
     return rt
+
+
+def _print_log_lines(wid, stream, lines) -> None:
+    import sys as _sys
+
+    prefix = f"({wid}" + (" .err) " if stream == "err" else ") ")
+    try:
+        _sys.stdout.write("".join(prefix + ln + "\n" for ln in lines))
+        _sys.stdout.flush()
+    except (OSError, ValueError):
+        pass  # driver stdout closed
 
 
 def _try_reconnect(rt) -> bool:
@@ -155,36 +172,13 @@ def _try_reconnect(rt) -> bool:
         except Exception:
             _time.sleep(0.5)
             continue
-        flushed = True
-        with rt.conn_lock:
-            try:
-                rt.conn.close()
-            except OSError:
-                pass
-            rt.conn = c
-            with rt._backlog_lock:
-                backlog, rt._oneway_backlog = rt._oneway_backlog, []
-            try:
-                while backlog:
-                    rt.conn.send(backlog[0])
-                    backlog.pop(0)
-            except OSError:
-                # Head bounced again mid-flush: restore the unsent tail
-                # and RETRY within the window (there is no outer loop to
-                # re-enter — giving up here would strand the driver while
-                # most of the window remains).
-                with rt._backlog_lock:
-                    rt._oneway_backlog[:0] = backlog
-                flushed = False
-        if not flushed:
-            _time.sleep(0.5)
-            continue
-        err = ConnectionError("head connection was reset (head restart)")
-        for req_id in list(rt._pending):
-            q = rt._pending.pop(req_id, None)
-            if q is not None:
-                q.put((False, err))
-        return True
+        # Shared recovery (hello already exchanged above): swap, flush the
+        # backlog, fail in-flight requests, replay subscriptions.  On a
+        # second bounce mid-recovery, RETRY within the window — there is
+        # no outer loop to re-enter here, unlike the worker recv loop.
+        if rt.reconnect_recover(c, lambda _c: None):
+            return True
+        _time.sleep(0.5)
     return False
 
 
@@ -207,6 +201,8 @@ def _recv_loop(rt) -> None:
             return
         if msg[0] == "reply":
             rt._on_reply(msg[1], msg[2], msg[3])
+        elif msg[0] == "pub":
+            rt._on_pub(msg[1], msg[2], msg[3])
         # tasks are never pushed to a driver client
 
 

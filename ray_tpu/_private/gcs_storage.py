@@ -164,21 +164,27 @@ class SqliteSnapshotStorage(SnapshotStorage):
             ).fetchone()
         if row is None:
             return None  # genuinely clean boot
+        def _aside(suffix: str) -> None:
+            with self._lock:
+                self._conn.execute(
+                    "UPDATE snapshots SET session=? WHERE session=?",
+                    (session + suffix, session),
+                )
+                self._conn.commit()
+
         try:
             snap = pickle.loads(row[0])
         except Exception as e:  # noqa: BLE001 — unreadable ≠ absent
             _corrupt_note(f"{self.path}:{session}", e)
+            try:
+                _aside(".corrupt")  # next save tick must not destroy it
+            except Exception:
+                pass
             return None
-
-        def _aside():
-            with self._lock:
-                self._conn.execute(
-                    "UPDATE snapshots SET session=? WHERE session=?",
-                    (session + ".refused", session),
-                )
-                self._conn.commit()
-
-        return _check(snap, session, f"{self.path}:{session}", set_aside=_aside)
+        return _check(
+            snap, session, f"{self.path}:{session}",
+            set_aside=lambda: _aside(".refused"),
+        )
 
     def close(self) -> None:
         with self._lock:

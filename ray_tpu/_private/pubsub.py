@@ -17,9 +17,13 @@ poll in the serve controller.  They are all subscriptions:
     (ray: serve _private/long_poll.py:185): callers park on a key until a
     predicate turns true or their chunk timeout lapses.
 
-Everything is in-process today (the single-controller head owns all
-state); the channel names and delivery modes are the seam a cross-process
-subscriber transport would plug into.
+Delivery is in-process for head-side subscribers, and CROSS-PROCESS via
+`remote_hook`: the head's Runtime installs a hook that fans every publish
+out to workers/drivers that sent a ("subscribe", channel, key) frame —
+pushes ride the existing framed control conns as ("pub", channel, key,
+args) (ray: subscriber.h:70 long-polls the publisher over the network;
+ours pushes over the already-open conn, same delivery guarantee, one
+less round trip).
 """
 
 from __future__ import annotations
@@ -50,6 +54,10 @@ class Publisher:
     def __init__(self):
         self._lock = threading.Lock()
         self._subs: Dict[Tuple[str, Any], List[Subscription]] = {}
+        # Cross-process fan-out: called as remote_hook(channel, key, args)
+        # on EVERY publish, after local dispatch (installed by the head's
+        # Runtime; None in workers/tests).
+        self.remote_hook = None
 
     def subscribe(self, channel: str, key: Any, cb: Callable, *,
                   once: bool = False, deferred: bool = False) -> Subscription:
@@ -75,6 +83,14 @@ class Publisher:
         here (exceptions swallowed per-subscriber, as the reference's
         publisher isolates subscriber failures); deferred callbacks are
         RETURNED for the caller to invoke outside its locks."""
+        hook = self.remote_hook
+        if hook is not None:
+            try:
+                hook(channel, key, args)
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
         with self._lock:
             lst = self._subs.get((channel, key))
             if not lst:

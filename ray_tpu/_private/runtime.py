@@ -428,7 +428,23 @@ class Runtime:
         # ray: src/ray/pubsub/publisher.h:298.
         from ray_tpu._private.pubsub import Publisher
 
+        # Cross-process pubsub (ray: subscriber.h:70): (channel, key) ->
+        # {worker/driver id: once} for ids that asked for pushes; "*" key
+        # = wildcard (log streaming).  Fan-out rides the control conns.
+        self.remote_subs: Dict[Tuple[str, Any], Dict[str, bool]] = {}
+        # Drivers whose conn reset on a live head: death deferred briefly
+        # so their reconnect can win the race (did -> deadline).
+        self._driver_death_grace: Dict[str, float] = {}
         self.pubsub = Publisher()
+        import queue as _queue
+
+        # Cross-process delivery queue + sender thread: created BEFORE the
+        # hook is installed (snapshot restore publishes during __init__).
+        self._pub_queue: "_queue.Queue" = _queue.Queue(maxsize=10000)
+        threading.Thread(
+            target=self._pub_sender_loop, daemon=True, name="raytpu-pubsend"
+        ).start()
+        self.pubsub.remote_hook = self._remote_publish
         self.contained_map: Dict[str, List[str]] = {}  # oid -> contained oids
         # Object directory (ray: ownership_based_object_directory.h): which
         # NON-head nodes hold a sealed copy of each object.  Head-node
@@ -963,6 +979,7 @@ class Runtime:
         with self.lock:
             self.drivers.pop(did, None)
             self.driver_nodes.pop(did, None)
+            self._drop_remote_subs(did)
             refs = self.driver_refs.pop(did, {})
             doomed = [
                 aid
@@ -1421,6 +1438,7 @@ class Runtime:
                     except OSError:
                         pass
                 self.drivers[did] = conn
+                self._driver_death_grace.pop(did, None)  # reconnect won
                 self.driver_nodes[did] = (
                     self.head_node_id if shared else f"drvnode-{did}"
                 )
@@ -1602,6 +1620,15 @@ class Runtime:
                             h = self.workers.get(wid)
                             if h is not None and h.state != "dead":
                                 self._on_worker_crash(wid)
+                    # Drivers whose conn reset on a live head and never
+                    # re-handshook within the grace: now they're dead.
+                    for did, deadline in list(self._driver_death_grace.items()):
+                        if now >= deadline:
+                            self._driver_death_grace.pop(did, None)
+                            if did in self.drivers and self.drivers[
+                                did
+                            ] not in self._conn_to_driver:
+                                self._on_driver_death(did)
                     # Idle-worker reaping (ray: worker_pool idle killing):
                     # default-env head workers beyond the prestart floor
                     # that sat idle >60s exit, so a burst's pool shrinks
@@ -1780,10 +1807,19 @@ class Runtime:
                             self._conns_version += 1
                             superseded = self.drivers.get(did) is not conn
                         if not superseded:
-                            # A re-handshaken driver (newer conn for the
-                            # same did) is alive: this EOF is only the OLD
-                            # socket dying.
-                            self._on_driver_death(did)
+                            window = _cfg.get("reconnect_window_s")
+                            if window > 0:
+                                # Transient reset on a LIVE head: give the
+                                # driver's reconnect loop a beat before
+                                # freeing its refs and killing its actors
+                                # (a same-millisecond EOF would otherwise
+                                # always beat the re-handshake).
+                                with self.lock:
+                                    self._driver_death_grace[did] = (
+                                        time.monotonic() + min(window, 5.0)
+                                    )
+                            else:
+                                self._on_driver_death(did)
                         continue
                     try:
                         self._handle_msg(did, msg)
@@ -1965,6 +2001,17 @@ class Runtime:
                 with self.lock:
                     for rid in spec.return_ids():
                         self._lineage_record(rid, spec)
+        elif kind == "subscribe":
+            once = bool(msg[3]) if len(msg) > 3 else False
+            with self.lock:
+                self.remote_subs.setdefault((msg[1], msg[2]), {})[wid] = once
+        elif kind == "unsubscribe":
+            with self.lock:
+                subs = self.remote_subs.get((msg[1], msg[2]))
+                if subs is not None:
+                    subs.pop(wid, None)
+                    if not subs:
+                        self.remote_subs.pop((msg[1], msg[2]), None)
         elif kind == "lease_return":
             with self.lock:
                 self._release_peer_lease_locked(msg[1], return_worker=True)
@@ -2028,6 +2075,64 @@ class Runtime:
                 return
             if result is not _PARKED:
                 self._reply(wid, req_id, True, result)
+
+    @_locked
+    def _drop_remote_subs(self, wid: str) -> None:
+        for ck, subs in list(self.remote_subs.items()):
+            subs.pop(wid, None)
+            if not subs:
+                self.remote_subs.pop(ck, None)
+
+    def _remote_publish(self, channel: str, key: Any, args: tuple) -> None:
+        """Publisher hook: push this publish to remote subscribers over
+        their control conns (pubsub.py remote delivery).  Exact-key and
+        wildcard ("*") subscriptions both fire; the frame carries the key
+        so wildcard subscribers can route.
+
+        Delivery is ASYNC via a dedicated sender thread: publishes run
+        under the runtime lock, and a subscriber that stops draining its
+        conn would otherwise block the send — and with it the whole
+        control plane (the same reason in-process subscribers have
+        deferred=True)."""
+        if not self.remote_subs:
+            return
+        with self.lock:
+            entries = self.remote_subs.get((channel, key))
+            wildcard = self.remote_subs.get((channel, "*"))
+            targets = dict(wildcard or ())
+            if entries:
+                targets.update(entries)
+                # once-subscriptions consume on this publish
+                for wid in [w for w, once in entries.items() if once]:
+                    entries.pop(wid, None)
+                if not entries:
+                    self.remote_subs.pop((channel, key), None)
+        for wid in targets:
+            try:
+                self._pub_queue.put_nowait((wid, ("pub", channel, key, args)))
+            except Exception:
+                pass  # full: push dropped (subscriber is hopelessly behind)
+
+    def _pub_sender_loop(self) -> None:
+        while not getattr(self, "_shutdown", False):
+            try:
+                wid, msg = self._pub_queue.get(timeout=1.0)
+            except Exception:
+                continue
+            self._reply_raw(wid, msg)
+
+    def _reply_raw(self, wid: str, msg: tuple) -> None:
+        with self.lock:
+            h = self.workers.get(wid)
+            if h is not None:
+                self._send(h, msg)
+                return
+            conn = self.drivers.get(wid)
+        if conn is not None:
+            try:
+                conn.send(msg)
+            except OSError:
+                pass
 
     def _reply(self, wid: str, req_id: int, ok: bool, value: Any) -> None:
         with self.lock:
@@ -3112,6 +3217,7 @@ class Runtime:
         for tid, e in list(self.direct_running.items()):
             if e.get("worker_id") == wid:
                 self.direct_running.pop(tid, None)
+        self._drop_remote_subs(wid)
         # Fences routed through this worker can never ack: fail them so the
         # caller falls back to the head path instead of hanging.
         for fid, ent in list(self._pending_fences.items()):

@@ -77,9 +77,13 @@ class WorkerRuntime:
         # Oneways that failed during a head bounce, flushed on reconnect.
         self._oneway_backlog: list = []
         self._backlog_lock = threading.Lock()
+        self._backlog_dropped = 0
         # Attached drivers adopt the head's window (their own env may not
         # carry the knob); None = read the local config.
         self.reconnect_window_override: Optional[float] = None
+        # Cross-process pubsub subscriptions: (channel, key) -> [cb].
+        self._subs: Dict[tuple, list] = {}
+        self._subs_lock = threading.Lock()
         self.async_loop = None
         self._async_loop_lock = threading.Lock()
 
@@ -152,11 +156,116 @@ class WorkerRuntime:
                     with self._backlog_lock:
                         if len(self._oneway_backlog) < 4096:
                             self._oneway_backlog.append(msg)
+                        else:
+                            # Overflow is ownership-state LOSS: say so
+                            # (once per burst) instead of silently eating
+                            # seals/refops the restarted head needed.
+                            self._backlog_dropped += 1
+                            if self._backlog_dropped == 1:
+                                print(
+                                    "[ray_tpu] head-bounce backlog full: "
+                                    "dropping control messages (seals/"
+                                    "refops) — objects produced during "
+                                    "this outage may be unresolvable",
+                                    file=sys.stderr,
+                                    flush=True,
+                                )
 
     def _on_reply(self, req_id: int, ok: bool, value: Any) -> None:
         q = self._pending.pop(req_id, None)
         if q is not None:
             q.put((ok, value))
+
+    # -- cross-process pubsub (pubsub.py remote delivery) --------------------
+
+    def subscribe(self, channel: str, key, cb, once: bool = False) -> None:
+        """Receive pushes for (channel, key) from the head's Publisher —
+        key "*" = every key on the channel.  One head message per
+        subscription, then events arrive push-style on this conn (no
+        round trip per event; ray: subscriber.h:70).  once=True drops the
+        subscription — on BOTH sides — after the first event (per-object
+        channels like object_ready would otherwise accumulate forever)."""
+        with self._subs_lock:
+            self._subs.setdefault((channel, key), []).append((cb, once))
+        self.oneway(("subscribe", channel, key, once))
+
+    def unsubscribe(self, channel: str, key, cb=None) -> None:
+        with self._subs_lock:
+            lst = self._subs.get((channel, key))
+            if lst is not None:
+                if cb is None:
+                    lst.clear()
+                else:
+                    lst[:] = [e for e in lst if e[0] is not cb]
+                if not lst:
+                    self._subs.pop((channel, key), None)
+        self.oneway(("unsubscribe", channel, key))
+
+    def _on_pub(self, channel: str, key, args: tuple) -> None:
+        with self._subs_lock:
+            exact = self._subs.get((channel, key), [])
+            fired = list(exact) + list(self._subs.get((channel, "*"), ()))
+            exact[:] = [e for e in exact if not e[1]]  # consume once-subs
+            if not exact:
+                self._subs.pop((channel, key), None)
+        for cb, _once in fired:
+            try:
+                cb(key, *args)
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+
+    def reconnect_recover(self, newconn, send_hello) -> bool:
+        """ONE implementation of post-bounce session recovery (worker AND
+        attached-driver reconnects): swap to the freshly-connected conn,
+        send the re-registration hello, flush the oneway backlog (unsent
+        tail restored on a second bounce), fail in-flight requests with
+        the retriable ConnectionError, replay promotions + subscriptions.
+        Returns False when the head bounced again mid-recovery (caller
+        retries within its window)."""
+        with self.conn_lock:
+            try:
+                self.conn.close()
+            except OSError:
+                pass
+            self.conn = newconn
+            try:
+                send_hello(newconn)
+            except OSError:
+                return False
+            with self._backlog_lock:
+                backlog, self._oneway_backlog = self._oneway_backlog, []
+            try:
+                while backlog:
+                    newconn.send(backlog[0])
+                    backlog.pop(0)
+            except OSError:
+                # Unsent tail goes back: ownership state must survive
+                # repeated bounces.
+                with self._backlog_lock:
+                    self._oneway_backlog[:0] = backlog
+                return False
+        err = ConnectionError("head connection was reset (head restart)")
+        for req_id in list(self._pending):
+            q = self._pending.pop(req_id, None)
+            if q is not None:
+                q.put((False, err))
+        if self.direct is not None:
+            self.direct.replay_promotions()
+        self._replay_subscriptions()
+        return True
+
+    def _replay_subscriptions(self) -> None:
+        """After a head bounce: the restarted head's registry is empty."""
+        with self._subs_lock:
+            entries = [
+                (ck, all(once for _cb, once in lst))
+                for ck, lst in self._subs.items()
+                if lst
+            ]
+        for (channel, key), once in entries:
+            self.oneway(("subscribe", channel, key, once))
 
     # -- object plane --------------------------------------------------------
 
@@ -690,50 +799,15 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
                 _time.sleep(0.5)
         if newconn is None:
             return False
-        # Swap AND send the hello under ONE conn_lock hold: a concurrent
-        # oneway/done send slipping between them would become the new
-        # conn's first message and the head's handshake (which expects
-        # "ready") would drop the conn.  The bounce-window backlog flushes
-        # inside the same hold, so held oneways (seals, refops) precede
-        # anything other threads send on the fresh conn.
-        with conn_lock:
-            try:
-                rt.conn.close()
-            except OSError:
-                pass
-            rt.conn = newconn
-            try:
-                rt.conn.send(
-                    ("ready", worker_id, os.getpid(), node_id, peer_endpoint)
-                )
-                with rt._backlog_lock:
-                    backlog, rt._oneway_backlog = rt._oneway_backlog, []
-                try:
-                    while backlog:
-                        rt.conn.send(backlog[0])
-                        backlog.pop(0)
-                except OSError:
-                    # Head bounced again mid-flush: the UNSENT tail goes
-                    # back (ownership state must survive repeated bounces).
-                    with rt._backlog_lock:
-                        rt._oneway_backlog[:0] = backlog
-                    return False  # outer recv loop re-enters
-            except OSError:
-                return False  # head bounced again; outer loop re-enters
-        # In-flight request replies died with the old conn: fail them with
-        # ConnectionError — request() re-sends on this new conn (the
-        # restarted head's ops are idempotent by id).
-        err = ConnectionError("head connection was reset (head restart)")
-        for req_id in list(rt._pending):
-            q = rt._pending.pop(req_id, None)
-            if q is not None:
-                q.put((False, err))
-        # Caller-owned direct results the OLD head learned of (promotions)
-        # died with its memory: re-teach the new head so other processes
-        # still resolve those refs.
-        if rt.direct is not None:
-            rt.direct.replay_promotions()
-        return True
+        # Swap + hello + backlog flush + request-fail + replays run in ONE
+        # shared implementation (WorkerRuntime.reconnect_recover — the
+        # attached-driver path uses the same one).
+        return rt.reconnect_recover(
+            newconn,
+            lambda c: c.send(
+                ("ready", worker_id, os.getpid(), node_id, peer_endpoint)
+            ),
+        )
 
     def recv_loop():
         while True:
@@ -746,6 +820,8 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
             kind = msg[0]
             if kind == "reply":
                 rt._on_reply(msg[1], msg[2], msg[3])
+            elif kind == "pub":
+                rt._on_pub(msg[1], msg[2], msg[3])
             elif kind in ("task", "create_actor"):
                 route_task(msg, None)
             elif kind == "fence":

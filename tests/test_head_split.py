@@ -394,3 +394,26 @@ def test_head_kill9_live_driver_and_inflight_survive(tmp_path):
     finally:
         if proc.poll() is None:
             proc.kill()
+
+
+def test_attached_driver_streams_worker_logs(head, capsys):
+    """Worker prints reach the ATTACHED driver's stdout push-style over
+    the control conn (cross-process pubsub log fan-out — ray: the
+    driver's print subscriber on the GCS log channel)."""
+    _proc, head_json, _dir = head
+    ray_tpu.init(address=head_json)
+
+    @ray_tpu.remote
+    def chatty():
+        print("hello-from-remote-worker", flush=True)
+        return 1
+
+    assert ray_tpu.get(chatty.remote(), timeout=60) == 1
+    deadline = time.time() + 15
+    out = ""
+    while time.time() < deadline:
+        out += capsys.readouterr().out
+        if "hello-from-remote-worker" in out:
+            break
+        time.sleep(0.2)
+    assert "hello-from-remote-worker" in out
