@@ -134,9 +134,10 @@ def attach(
 def _print_log_lines(wid, stream, lines) -> None:
     import sys as _sys
 
-    prefix = f"({wid}" + (" .err) " if stream == "err" else ") ")
+    from ray_tpu._private.log_monitor import format_log_lines
+
     try:
-        _sys.stdout.write("".join(prefix + ln + "\n" for ln in lines))
+        _sys.stdout.write(format_log_lines(wid, stream, lines))
         _sys.stdout.flush()
     except (OSError, ValueError):
         pass  # driver stdout closed

@@ -26,6 +26,13 @@ import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
 
+def format_log_lines(wid: str, stream: str, lines) -> str:
+    """The one spelling of the driver-facing log prefix (head echo AND
+    attached-driver streaming use it — they must not drift)."""
+    prefix = f"({wid}" + (" .err) " if stream == "err" else ") ")
+    return "".join(prefix + ln + "\n" for ln in lines)
+
+
 def worker_log_paths(log_dir: str, wid: str) -> Tuple[str, str]:
     return (
         os.path.join(log_dir, f"worker-{wid}.out"),

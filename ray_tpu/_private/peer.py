@@ -492,27 +492,29 @@ class DirectTransport:
     def submit_plain(self, spec) -> Optional[list]:
         """Push a plain task to a head-leased worker; None = relay.
 
-        Tradeoffs vs the head path, by design: the task is invisible to the
-        head's task table/lineage (results are non-reconstructable, like
-        actor results), and arg-locality node scoring does not apply — the
-        win is zero per-task head requests.  Crash retries run caller-side
-        against a fresh lease (same at-least-once semantics)."""
+        Tradeoffs vs the head path, by design: arg-locality node scoring
+        does not apply — the win is zero per-task head requests.  Crash
+        retries run caller-side against a fresh lease (same at-least-once
+        semantics); sealed results are lineage-reconstructable via
+        direct_lineage."""
         if not self._plain_eligible(spec):
             return None
         # Deadlock guard: the head path dep-gates BEFORE occupying a
         # worker; a direct push occupies the leased worker through arg
-        # resolution.  A task whose dep is still being produced could
+        # resolution.  A task whose dep is still BEING PRODUCED could
         # therefore park leased workers while the producer starves for the
         # very resources those leases hold.  Only push when every dep is
-        # already materialized (caller-owned and landed — promoted on the
-        # escape into these args — or sealed in this node's store);
-        # anything else takes the dep-gated head path.
+        # provably materialized: caller-owned and landed, sealed in this
+        # node's store, or seen by this process (known_materialized) — a
+        # produced-but-remote dep is safe, the executor stages the bytes
+        # over the transfer plane at arg resolution (ray:
+        # dependency_manager.h:51 pulls deps node-locally the same way).
         for d in spec.deps:
             r = self.ready_local(d)
             if r is False:
                 return None  # ours, still in flight
-            if r is None and not self.wr.shm.contains(d):
-                return None  # not locally provable: let the head gate it
+            if r is None and not self.wr.known_materialized(d):
+                return None  # not provably produced: let the head gate it
         lease = self._acquire_lease(self._lease_key(spec), spec)
         if lease is None:
             return None

@@ -715,8 +715,9 @@ class Runtime:
         # a worker's output push-style instead of polling get_logs.
         self.pubsub.publish("logs", wid, stream, lines)
         if self.log_to_driver:
-            prefix = f"({wid}" + (" .err) " if stream == "err" else ") ")
-            out = "".join(prefix + ln + "\n" for ln in lines)
+            from ray_tpu._private.log_monitor import format_log_lines
+
+            out = format_log_lines(wid, stream, lines)
             try:
                 import sys
 
@@ -2004,7 +2005,10 @@ class Runtime:
         elif kind == "subscribe":
             once = bool(msg[3]) if len(msg) > 3 else False
             with self.lock:
-                self.remote_subs.setdefault((msg[1], msg[2]), {})[wid] = once
+                subs = self.remote_subs.setdefault((msg[1], msg[2]), {})
+                # A persistent subscription must never be downgraded by a
+                # later once-subscribe from the same process.
+                subs[wid] = subs.get(wid, once) and once
         elif kind == "unsubscribe":
             with self.lock:
                 subs = self.remote_subs.get((msg[1], msg[2]))
@@ -2102,16 +2106,25 @@ class Runtime:
             targets = dict(wildcard or ())
             if entries:
                 targets.update(entries)
-                # once-subscriptions consume on this publish
-                for wid in [w for w, once in entries.items() if once]:
-                    entries.pop(wid, None)
-                if not entries:
-                    self.remote_subs.pop((channel, key), None)
-        for wid in targets:
+        delivered = []
+        for wid, once in targets.items():
             try:
                 self._pub_queue.put_nowait((wid, ("pub", channel, key, args)))
             except Exception:
-                pass  # full: push dropped (subscriber is hopelessly behind)
+                # Full: push dropped (subscriber hopelessly behind).  The
+                # once-sub is NOT consumed — a one-shot event must not
+                # vanish because a log flood filled the queue.
+                continue
+            if once:
+                delivered.append(wid)
+        if delivered:
+            with self.lock:
+                entries = self.remote_subs.get((channel, key))
+                if entries:
+                    for wid in delivered:
+                        entries.pop(wid, None)
+                    if not entries:
+                        self.remote_subs.pop((channel, key), None)
 
     def _pub_sender_loop(self) -> None:
         while not getattr(self, "_shutdown", False):
@@ -2122,12 +2135,18 @@ class Runtime:
             self._reply_raw(wid, msg)
 
     def _reply_raw(self, wid: str, msg: tuple) -> None:
+        # Resolve the conn UNDER the lock, send OUTSIDE it: a subscriber
+        # that stops draining must only stall the sender thread, never
+        # the control plane (TypedConn.send serializes per-conn writers).
         with self.lock:
             h = self.workers.get(wid)
             if h is not None:
-                self._send(h, msg)
-                return
-            conn = self.drivers.get(wid)
+                if h.conn is None:
+                    h.pending_sends.append(msg)
+                    return
+                conn = h.conn
+            else:
+                conn = self.drivers.get(wid)
         if conn is not None:
             try:
                 conn.send(msg)

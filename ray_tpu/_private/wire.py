@@ -157,15 +157,22 @@ def decode(buf) -> Any:
 
 class TypedConn:
     """Connection wrapper applying the framing to send/recv while keeping
-    the raw-byte surface for transfer bodies."""
+    the raw-byte surface for transfer bodies.  send() is atomic per conn:
+    Connection.send_bytes is NOT safe under concurrent writers (header and
+    body interleave), and several head threads (reply path, pub sender)
+    legitimately share one driver/worker conn."""
 
-    __slots__ = ("_c",)
+    __slots__ = ("_c", "_send_lock")
 
     def __init__(self, conn):
         self._c = conn
+        import threading
+
+        self._send_lock = threading.Lock()
 
     def send(self, obj: Any) -> None:
-        self._c.send_bytes(encode(obj))
+        with self._send_lock:
+            self._c.send_bytes(encode(obj))
 
     def recv(self) -> Any:
         return decode(self._c.recv_bytes())

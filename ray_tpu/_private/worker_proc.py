@@ -23,6 +23,7 @@ import queue
 import sys
 import threading
 import traceback
+from collections import OrderedDict
 from typing import Any, Dict, Optional
 
 from ray_tpu._private import serialization as ser
@@ -84,6 +85,13 @@ class WorkerRuntime:
         # Cross-process pubsub subscriptions: (channel, key) -> [cb].
         self._subs: Dict[tuple, list] = {}
         self._subs_lock = threading.Lock()
+        # Objects THIS process has seen materialized (resolved a value /
+        # pulled a copy): a dep in this set is provably produced, so a
+        # lease-dispatched task carrying it can be pushed — the executor
+        # stages the bytes via the transfer plane without any deadlock
+        # risk (the producer is done; nothing is starved).  Bounded LRU.
+        self._known_ready: "OrderedDict[str, bool]" = OrderedDict()
+        self._known_ready_lock = threading.Lock()
         self.async_loop = None
         self._async_loop_lock = threading.Lock()
 
@@ -246,6 +254,7 @@ class WorkerRuntime:
                 with self._backlog_lock:
                     self._oneway_backlog[:0] = backlog
                 return False
+            self._backlog_dropped = 0  # fresh overflow warning per burst
         err = ConnectionError("head connection was reset (head restart)")
         for req_id in list(self._pending):
             q = self._pending.pop(req_id, None)
@@ -295,7 +304,27 @@ class WorkerRuntime:
         for oid in contained:
             self.direct.mark_escaped(oid)
 
+    def mark_known_ready(self, oid: str) -> None:
+        with self._known_ready_lock:
+            self._known_ready[oid] = True
+            self._known_ready.move_to_end(oid)
+            while len(self._known_ready) > 8192:
+                self._known_ready.popitem(last=False)
+
+    def known_materialized(self, oid: str) -> bool:
+        """This process has direct evidence the object was produced (seen
+        its value, or it sits in this node's store)."""
+        with self._known_ready_lock:
+            if oid in self._known_ready:
+                return True
+        return self.shm.contains(oid)
+
     def get_value(self, object_id: str, timeout: Optional[float] = None) -> Any:
+        value = self._get_value(object_id, timeout)
+        self.mark_known_ready(object_id)  # reached only on success
+        return value
+
+    def _get_value(self, object_id: str, timeout: Optional[float] = None) -> Any:
         # Fastest path: a result of one of OUR direct calls, cached locally.
         if self.direct is not None:
             if self.direct.ready_local(object_id) is not None:

@@ -257,3 +257,30 @@ def test_leased_tasks_visible_in_task_table(ray_start_regular):
             break
         time.sleep(0.2)
     assert len(done) >= 3
+
+
+def test_nonlocal_dep_chain_stays_on_lease_path(ray_start_regular):
+    """A dep the caller has SEEN (arg-resolved / gotten) but does not hold
+    in its node store no longer forces the head path: the task rides a
+    lease and the executor stages the dep via the owner (VERDICT r4
+    item 3 — daemon-local dep staging; ray: dependency_manager.h:51)."""
+    seed = ray_tpu.put(7)  # small: inline at the head, in no node store
+
+    @ray_tpu.remote
+    def bump(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def driver_task(ref, n):
+        # `ref` was materialized during arg resolution (known_materialized)
+        v = ref
+        for _ in range(n):
+            r = bump.remote(v)      # dep seen by this process -> lease path
+            v = ray_tpu.get(r)
+        return v
+
+    before = _counts().get("submit", 0)
+    assert ray_tpu.get(driver_task.remote(seed, 8), timeout=120) == 7 + 8
+    assert _counts().get("submit", 0) == before, (
+        "seen-but-nonlocal deps must not push the chain onto the head path"
+    )
