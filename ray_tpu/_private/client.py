@@ -74,6 +74,15 @@ class CoreClient:
 
         if spec.parent_task_id is None:
             spec.parent_task_id = current_task_id()
+        from ray_tpu.util import tracing
+
+        if tracing.is_enabled() and spec.trace_ctx is None:
+            # The submit span's context rides the spec, so the executor's
+            # run span parents to it across the process boundary.
+            with tracing.span(
+                f"submit::{spec.name}", attrs={"task_id": spec.task_id}
+            ) as ctx:
+                spec.trace_ctx = dict(ctx)
 
     def submit(self, spec: TaskSpec) -> List[ObjectRef]:
         self._stamp_parent(spec)

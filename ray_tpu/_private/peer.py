@@ -95,12 +95,21 @@ class PeerServer:
         peer) raises AuthenticationError — which must not kill the accept
         loop (that would silently disable this worker's direct transport
         for the rest of its life)."""
-        from ray_tpu._private.wire import wrap
+        from ray_tpu._private.wire import ProtocolError, wrap
 
         try:
             return wrap(self.listener.accept())
         except (OSError, EOFError):
             raise
+        except ProtocolError as e:
+            # A version-skewed LEGITIMATE peer, not a stranger: silence
+            # here would turn the loud r4 versioning feature into a
+            # silent connect-retry loop on the direct path.
+            import sys
+
+            print(f"[ray_tpu] peer handshake rejected: {e}", file=sys.stderr,
+                  flush=True)
+            return None
         except Exception:
             return None  # bad handshake from a stranger: keep serving
 

@@ -435,6 +435,9 @@ class Runtime:
         # Drivers whose conn reset on a live head: death deferred briefly
         # so their reconnect can win the race (did -> deadline).
         self._driver_death_grace: Dict[str, float] = {}
+        # Trace-span sink (util/tracing.py; ray: spans land in the GCS task
+        # events the same batched way).
+        self.trace_spans: deque = deque(maxlen=10000)
         self.pubsub = Publisher()
         import queue as _queue
 
@@ -1992,6 +1995,11 @@ class Runtime:
                         else "tasks_failed"
                     ] += 1
                     self.task_events.append(e)
+        elif kind == "spans":
+            # Worker-side trace spans (util/tracing.py), batched off the
+            # latency path like task events.
+            with self.lock:
+                self.trace_spans.extend(msg[1])
         elif kind == "direct_lineage":
             # A lease-dispatched task produced shm results: remember its
             # spec so the head can re-execute the producer if the bytes are
@@ -2122,7 +2130,11 @@ class Runtime:
                 entries = self.remote_subs.get((channel, key))
                 if entries:
                     for wid in delivered:
-                        entries.pop(wid, None)
+                        # Consume ONLY a still-once entry: a re-subscribe
+                        # (or persistent upgrade) that landed during the
+                        # send window must survive this delivery.
+                        if entries.get(wid) is True:
+                            entries.pop(wid, None)
                     if not entries:
                         self.remote_subs.pop((channel, key), None)
 

@@ -37,6 +37,9 @@ class TaskSpec:
     # Tracing (ray: tracing_helper.py injects context into task specs;
     # ProfileEvent parentage): the submitting task, None for driver submits.
     parent_task_id: Optional[str] = None
+    # OTel-style trace context injected at submission when tracing is on
+    # (ray: _DictPropagator.inject_current_context, tracing_helper.py:160).
+    trace_ctx: Optional[Dict[str, str]] = None
     actor_method_names: Optional[List[str]] = None
     max_concurrency: int = 1
     # The ACTOR's method concurrency (creation tasks run ordered with

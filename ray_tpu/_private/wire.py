@@ -61,6 +61,7 @@ SCHEMAS: Dict[str, Tuple[int, Optional[int], tuple]] = {
     "seal_ow": (3, 3, (str, int)),
     "put_ow": (3, 3, (str,)),
     "task_events": (1, 1, (list,)),
+    "spans": (1, 1, (list,)),
     # cross-process pubsub (pubsub.py remote delivery)
     "subscribe": (2, 3, (str,)),
     "unsubscribe": (2, 2, (str,)),

@@ -149,11 +149,16 @@ class WorkerRuntime:
             raise value
         return value
 
-    def oneway(self, msg: tuple) -> None:
+    def oneway(self, msg: tuple, droppable: bool = False) -> None:
+        """droppable=True marks telemetry (spans, task events): dropped on
+        a dead conn instead of competing with seals/refops for the
+        bounded ownership backlog."""
         with self.conn_lock:
             try:
                 self.conn.send(msg)
             except OSError:
+                if droppable:
+                    return
                 # Head away (restart window): hold the message — seals,
                 # refops, and promotions carry ownership state the
                 # restarted head must still learn.  Appended INSIDE the
@@ -320,7 +325,17 @@ class WorkerRuntime:
         return self.shm.contains(oid)
 
     def get_value(self, object_id: str, timeout: Optional[float] = None) -> Any:
-        value = self._get_value(object_id, timeout)
+        from ray_tpu.exceptions import ObjectLostError
+
+        try:
+            value = self._get_value(object_id, timeout)
+        except ObjectLostError:
+            # Invalidate: a stale known-ready entry would keep steering
+            # lease-path submits at a dep whose bytes are gone (the
+            # deadlock guard must see the loss, not the old success).
+            with self._known_ready_lock:
+                self._known_ready.pop(object_id, None)
+            raise
         self.mark_known_ready(object_id)  # reached only on success
         return value
 
@@ -533,7 +548,23 @@ def _store_results(rt: WorkerRuntime, spec: TaskSpec, out) -> list:
 
 def _execute(rt: WorkerRuntime, spec: TaskSpec, blob: Optional[bytes]):
     """Run one task/actor-method/creation; returns ("done", ...) message."""
+    import contextlib
+
+    from ray_tpu.util import tracing
+
     _ctx_token = _current_task.set(spec.task_id)
+    stack = contextlib.ExitStack()
+    if getattr(spec, "trace_ctx", None) is not None and tracing.is_enabled():
+        # Adopt the submitter's context: this run span parents to its
+        # submit span, and anything WE submit parents to this run
+        # (ray: tracing_helper.py execute-side wrapper).
+        stack.enter_context(
+            tracing.span(
+                f"run::{spec.name}",
+                parent=spec.trace_ctx,
+                attrs={"task_id": spec.task_id, "worker_id": rt.worker_id},
+            )
+        )
     try:
         if spec.is_actor_creation:
             cls = rt.resolve_function(spec.fn_id, blob)
@@ -566,6 +597,7 @@ def _execute(rt: WorkerRuntime, spec: TaskSpec, blob: Optional[bytes]):
 
         return ("done", spec.task_id, [], cloudpickle.dumps(err))
     finally:
+        stack.close()  # end the run span (records it for the next flush)
         _current_task.reset(_ctx_token)
 
 
@@ -728,12 +760,17 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
     events_lock = threading.Lock()
 
     def flush_task_events() -> None:
+        from ray_tpu.util import tracing as _tracing
+
+        spans = _tracing.drain_spans()
+        if spans:
+            rt.oneway(("spans", spans), droppable=True)
         with events_lock:
             if not events_buf:
                 return
             batch = events_buf[:]
             events_buf.clear()
-        rt.oneway(("task_events", batch))
+        rt.oneway(("task_events", batch), droppable=True)
 
     def record_peer_task_event(spec, err_blob, t0: float, t1: float) -> None:
         with events_lock:

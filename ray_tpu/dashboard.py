@@ -48,6 +48,12 @@ def timeline() -> list:
                 },
             }
         )
+    # Trace spans (util/tracing.py, when enabled) ride the same timeline:
+    # submit/run spans interleave with task rows in the catapult view.
+    from ray_tpu.util.state import list_spans
+    from ray_tpu.util.tracing import spans_to_chrome_trace
+
+    out.extend(spans_to_chrome_trace(list_spans()))
     return out
 
 

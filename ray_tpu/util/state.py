@@ -44,6 +44,18 @@ def list_tasks(*, include_finished: bool = True, limit: int = 1000) -> List[Dict
     return out[:limit]
 
 
+def list_spans(limit: int = 1000) -> List[Dict[str, Any]]:
+    """Trace spans (util/tracing.py): worker spans arrive via the batched
+    flush; the driver/head process's own buffer is folded in here."""
+    from ray_tpu.util import tracing
+
+    rt = _rt()
+    local = tracing.drain_spans()
+    with rt.lock:
+        rt.trace_spans.extend(local)
+        return list(rt.trace_spans)[-limit:]
+
+
 def list_actors(limit: int = 1000) -> List[Dict[str, Any]]:
     rt = _rt()
     out = []

@@ -1,0 +1,145 @@
+"""Distributed tracing: OTel-compatible spans with context in task specs.
+
+ray: python/ray/util/tracing/tracing_helper.py — the reference wraps
+remote calls in OpenTelemetry spans and propagates the context INSIDE the
+task spec (`_DictPropagator.inject_current_context`, :160), so a task's
+execute span parents to its submitter's span across processes.  Same
+design here:
+
+  * opt-in (`RAY_TPU_TRACE=1` or `enable_tracing()`), zero overhead off;
+  * the ACTIVE trace context lives in a contextvar; submission injects it
+    into `spec.trace_ctx` as a W3C-traceparent-style dict, execution
+    adopts it, so nested submits chain naturally;
+  * spans always record to an in-process buffer that workers flush to the
+    head (state API / timeline); when the `opentelemetry` API package is
+    importable the same spans ALSO open real OTel spans — with no SDK
+    installed those are no-ops, with a user-configured SDK they export
+    wherever the user pointed it (the lazy-proxy pattern of the
+    reference's _OpenTelemetryProxy:33).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+_enabled = os.environ.get("RAY_TPU_TRACE", "") not in ("", "0")
+_current: "contextvars.ContextVar[Optional[Dict[str, str]]]" = contextvars.ContextVar(
+    "raytpu_trace_ctx", default=None
+)
+_buffer: List[Dict[str, Any]] = []
+_buffer_lock = threading.Lock()
+_MAX_BUFFER = 10000
+
+_otel_tracer = None
+_otel_checked = False
+
+
+def enable_tracing() -> None:
+    """Turn span recording on for this process (children inherit via the
+    RAY_TPU_TRACE env var when set instead)."""
+    global _enabled
+    _enabled = True
+
+
+def disable_tracing() -> None:
+    global _enabled
+    _enabled = False
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def _otel():
+    """Lazy OTel API tracer; None when the package is absent."""
+    global _otel_tracer, _otel_checked
+    if not _otel_checked:
+        _otel_checked = True
+        try:
+            from opentelemetry import trace as _t
+
+            _otel_tracer = _t.get_tracer("ray_tpu")
+        except Exception:
+            _otel_tracer = None
+    return _otel_tracer
+
+
+def _new_id(nbytes: int) -> str:
+    return os.urandom(nbytes).hex()
+
+
+@contextmanager
+def span(name: str, parent: Optional[Dict[str, str]] = None,
+         attrs: Optional[Dict[str, Any]] = None):
+    """Record one span.  `parent` (e.g. a spec's trace_ctx) wins over the
+    ambient context; the new span becomes ambient for the duration, so
+    anything submitted inside parents to it."""
+    if not _enabled:
+        yield None
+        return
+    up = parent if parent is not None else _current.get()
+    ctx = {
+        "trace_id": (up or {}).get("trace_id") or _new_id(16),
+        "span_id": _new_id(8),
+    }
+    rec = {
+        "name": name,
+        "trace_id": ctx["trace_id"],
+        "span_id": ctx["span_id"],
+        "parent_span_id": (up or {}).get("span_id"),
+        "start": time.time(),
+        "attrs": dict(attrs or {}),
+        "pid": os.getpid(),
+    }
+    token = _current.set(ctx)
+    otel = _otel()
+    om = otel.start_as_current_span(name) if otel is not None else None
+    if om is not None:
+        om.__enter__()
+    try:
+        yield ctx
+    finally:
+        if om is not None:
+            try:
+                om.__exit__(None, None, None)
+            except Exception:
+                pass
+        _current.reset(token)
+        rec["end"] = time.time()
+        with _buffer_lock:
+            _buffer.append(rec)
+            while len(_buffer) > _MAX_BUFFER:
+                _buffer.pop(0)
+
+
+def drain_spans() -> List[Dict[str, Any]]:
+    """Take the buffered spans (worker flush loops ship them to the head)."""
+    with _buffer_lock:
+        out, _buffer[:] = _buffer[:], []
+    return out
+
+
+def spans_to_chrome_trace(spans: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Chrome-trace 'X' events for `ray_tpu timeline`-style viewing."""
+    return [
+        {
+            "name": s["name"],
+            "ph": "X",
+            "ts": int(s["start"] * 1e6),
+            "dur": int(max(s.get("end", s["start"]) - s["start"], 0) * 1e6),
+            "pid": s.get("pid", 0),
+            "tid": 0,
+            "args": {
+                "trace_id": s["trace_id"],
+                "span_id": s["span_id"],
+                "parent_span_id": s.get("parent_span_id"),
+                **s.get("attrs", {}),
+            },
+        }
+        for s in spans
+    ]

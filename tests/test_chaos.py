@@ -1,0 +1,172 @@
+"""Chaos: random process kills under a mixed workload.
+
+ray: release/nightly_tests/setup_chaos.py + NodeKillerActor
+(python/ray/_private/test_utils.py:1347) — long-running workloads must
+survive worker/node churn with lineage on.  CI-scale here: a killer
+thread SIGKILLs random busy workers (and a whole daemon node) while
+task chains and a restartable actor keep making progress; every result
+must still be exactly right.
+"""
+
+import random
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def _rt():
+    from ray_tpu._private.runtime import get_runtime
+
+    return get_runtime()
+
+
+class _Killer:
+    """Kills a random busy worker every `interval` seconds (at most
+    `max_kills`), like the reference's NodeKillerActor but in-process."""
+
+    def __init__(self, interval: float = 0.8, max_kills: int = 6):
+        self.interval = interval
+        self.max_kills = max_kills
+        self.kills = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    def _loop(self):
+        rt = _rt()
+        rng = random.Random(0xC7A05)
+        while not self._stop.wait(self.interval):
+            if self.kills >= self.max_kills:
+                return
+            with rt.lock:
+                victims = [
+                    h for h in rt.workers.values()
+                    if h.state in ("busy", "actor") and h.proc is not None
+                ]
+            if not victims:
+                continue
+            h = rng.choice(victims)
+            try:
+                h.proc.kill()
+                self.kills += 1
+            except Exception:
+                pass
+
+
+def test_chaos_task_chains_survive_worker_kills(ray_start_regular):
+    """Task chains with retries + lineage keep producing correct results
+    while random busy workers are SIGKILLed."""
+
+    @ray_tpu.remote(max_retries=5)
+    def produce(i):
+        time.sleep(0.05)
+        return np.full((1 << 14,), i, dtype=np.int64)  # shm-sealed
+
+    @ray_tpu.remote(max_retries=5)
+    def fold(a, j):
+        time.sleep(0.05)
+        return int(a.sum()) + j
+
+    killer = _Killer(interval=0.6, max_kills=6).start()
+    try:
+        for round_no in range(3):
+            refs = [
+                fold.remote(produce.remote(i), round_no) for i in range(10)
+            ]
+            outs = ray_tpu.get(refs, timeout=240)
+            expect = [i * (1 << 14) + round_no for i in range(10)]
+            assert outs == expect, f"round {round_no}: wrong results"
+    finally:
+        killer.stop()
+    assert killer.kills > 0, "chaos never actually fired"
+
+
+def test_chaos_restartable_actor_survives_kills(ray_start_regular):
+    """A max_restarts actor keeps serving (with retry-budgeted calls)
+    while its worker is repeatedly killed."""
+
+    @ray_tpu.remote(max_restarts=10, max_task_retries=5)
+    class Greeter:
+        def hello(self, i):
+            return f"hi-{i}"
+
+    g = Greeter.remote()
+    assert ray_tpu.get(g.hello.remote(0), timeout=60) == "hi-0"
+    rt = _rt()
+
+    stop = threading.Event()
+    kills = {"n": 0}
+
+    def kill_actor_worker():
+        while not stop.wait(1.0):
+            if kills["n"] >= 3:
+                return
+            with rt.lock:
+                target = None
+                for h in rt.workers.values():
+                    if h.state == "actor" and h.proc is not None:
+                        target = h
+                        break
+            if target is not None:
+                try:
+                    target.proc.kill()
+                    kills["n"] += 1
+                except Exception:
+                    pass
+
+    t = threading.Thread(target=kill_actor_worker, daemon=True)
+    t.start()
+    try:
+        for i in range(1, 30):
+            assert ray_tpu.get(g.hello.remote(i), timeout=120) == f"hi-{i}"
+            time.sleep(0.05)
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    assert kills["n"] > 0, "chaos never actually fired"
+
+
+def test_chaos_daemon_node_kill_reconstructs_objects(ray_start_regular):
+    """SIGKILL a whole daemon node mid-workload: its sealed objects are
+    lost with its store, and consumers reconstruct them via lineage on
+    the surviving nodes (ray: node-failure object reconstruction)."""
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    rt = _rt()
+    nid = rt.add_daemon_node(num_cpus=2)
+
+    @ray_tpu.remote(max_retries=5)
+    def produce(i):
+        return np.full((1 << 14,), i, dtype=np.int64)
+
+    # Pin production to the doomed node so the only copies live there.
+    refs = [
+        produce.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(nid, soft=True)
+        ).remote(i)
+        for i in range(4)
+    ]
+    ray_tpu.wait(refs, num_returns=4, timeout=180)
+
+    proc = rt._daemon_procs.get(nid)
+    assert proc is not None
+    proc.kill()  # SIGKILL: workers die via pdeathsig, store dies with it
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and nid in rt.node_daemons:
+        time.sleep(0.2)
+
+    # Consumption reconstructs the producers on surviving capacity.
+    outs = ray_tpu.get([r for r in refs], timeout=240)
+    assert [int(a.sum()) for a in outs] == [i * (1 << 14) for i in range(4)]

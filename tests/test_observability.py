@@ -414,3 +414,51 @@ def test_structured_cluster_events():
             stop_dashboard()
     finally:
         ray_tpu.shutdown()
+
+
+def test_tracing_spans_chain_across_processes(monkeypatch):
+    """OTel-style spans with context in task specs (SURVEY §5.1; ray:
+    tracing_helper.py:160): a driver submit, its worker-side run, and a
+    NESTED submit/run all share one trace id with parent links."""
+    import time
+
+    monkeypatch.setenv("RAY_TPU_TRACE", "1")  # workers inherit
+    import ray_tpu
+    from ray_tpu.util import tracing
+
+    tracing.enable_tracing()
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    try:
+        @ray_tpu.remote
+        def inner(x):
+            return x + 1
+
+        @ray_tpu.remote
+        def outer():
+            return ray_tpu.get(inner.remote(1))
+
+        assert ray_tpu.get(outer.remote(), timeout=60) == 2
+        from ray_tpu.util.state import list_spans
+
+        deadline = time.time() + 15
+        spans = []
+        while time.time() < deadline:
+            spans = list_spans()
+            if sum(1 for s in spans if s["name"].startswith("run::")) >= 2:
+                break
+            time.sleep(0.3)
+        by_name = {}
+        for s in spans:
+            by_name.setdefault(s["name"], []).append(s)
+        assert "submit::outer" in by_name, sorted(by_name)
+        assert "run::outer" in by_name, sorted(by_name)
+        assert "run::inner" in by_name, sorted(by_name)
+        sub = by_name["submit::outer"][-1]
+        run = by_name["run::outer"][-1]
+        assert run["trace_id"] == sub["trace_id"], "one trace across processes"
+        assert run["parent_span_id"] == sub["span_id"], "run parents to submit"
+        # the nested chain stays in the same trace
+        assert by_name["run::inner"][-1]["trace_id"] == sub["trace_id"]
+    finally:
+        tracing.disable_tracing()  # module global: no leak into later tests
+        ray_tpu.shutdown()
