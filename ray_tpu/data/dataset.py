@@ -100,25 +100,18 @@ def _fused_map_block(block: Block, ops: List[tuple]) -> Block:
     return block
 
 
-@ray_tpu.remote
-def _partition_block(block: Block, ops: List[tuple], n: int, key_fn, seed) -> List[Block]:
-    """Map phase of all-to-all ops: apply the fused upstream chain, then
-    split the block into n shards — the pre-shuffle map pipeline never
-    materializes separately (ray: push_based_shuffle map stage).
+def _stable_hash(key) -> int:
+    """Process-independent key hash: builtin hash() of str/bytes is salted
+    per interpreter, which would scatter one key across partitions when
+    map tasks run in different worker processes."""
+    import pickle as _pickle
+    import zlib
 
-    key_fn=None randomly scatters rows — used ONLY by random_shuffle;
-    repartition/split use order-preserving contiguous ranges instead."""
-    for op in ops:
-        block = _apply_op(block, op)
-    shards: List[Block] = [[] for _ in range(n)]
-    if key_fn is None:
-        rng = random.Random(seed)
-        for r in block:
-            shards[rng.randrange(n)].append(r)
-    else:
-        for r in block:
-            shards[hash(key_fn(r)) % n].append(r)
-    return shards
+    try:
+        data = _pickle.dumps(key, protocol=4)
+    except Exception:
+        data = repr(key).encode()
+    return zlib.crc32(data)
 
 
 @ray_tpu.remote
@@ -163,12 +156,73 @@ def _merge_shards(*shards: Block) -> Block:
 
 
 @ray_tpu.remote
-def _merge_shuffle(seed, *shards: Block) -> Block:
-    out: List[Any] = []
-    for s in shards:
-        out.extend(block_rows(s))
-    random.Random(seed).shuffle(out)
+def _partition_block_grouped(
+    block: Block, ops: List[tuple], n: int, group_bounds: List[int], key_fn, seed
+):
+    """Map stage of the PUSH-BASED shuffle (ray:
+    _internal/push_based_shuffle.py): fused upstream chain, split into n
+    partitions, then PACK the partitions into merger groups — one output
+    object per MERGER instead of one per partition, so M maps produce
+    M x P intermediates (P = merge factor), not M x N."""
+    for op in ops:
+        block = _apply_op(block, op)
+    n_groups = len(group_bounds) - 1
+    shards: List[List[Any]] = [[] for _ in range(n)]
+    if key_fn is None:
+        rng = random.Random(seed)
+        for r in block_rows(block):
+            shards[rng.randrange(n)].append(r)
+    else:
+        for r in block_rows(block):
+            shards[_stable_hash(key_fn(r)) % n].append(r)
+    packs = [
+        shards[group_bounds[g] : group_bounds[g + 1]] for g in range(n_groups)
+    ]
+    return packs if n_groups > 1 else packs[0]
+
+
+@ray_tpu.remote
+def _merge_group_round(*packs):
+    """Merge stage of the push-based shuffle: combine ONE round's map
+    outputs for one merger group.  A round's merge depends only on that
+    round's maps, so it executes WHILE later rounds' maps run (the
+    pipelining that makes the shuffle push-based), each round's packed
+    intermediates free as soon as they merge, and — unlike an
+    accumulator chained across rounds — every row moves through the
+    store exactly twice (map -> merge -> finalize), not once per round
+    (ray: push_based_shuffle merge rounds)."""
+    out: List[List[Any]] = [[] for _ in range(len(packs[0]))]
+    for pack in packs:
+        for i, shard in enumerate(pack):
+            out[i].extend(block_rows(shard))
     return out
+
+
+def _concat_rounds(round_merges):
+    n = len(round_merges[0])
+    out: List[List[Any]] = [[] for _ in range(n)]
+    for rm in round_merges:
+        for i, rows in enumerate(rm):
+            out[i].extend(rows)
+    return out
+
+
+@ray_tpu.remote
+def _finalize_shuffle_group(seed, *round_merges):
+    """Reduce stage: concat each partition's rows across rounds, permute;
+    num_returns = partitions in the group."""
+    outs = _concat_rounds(round_merges)
+    for i, rows in enumerate(outs):
+        random.Random(seed + i).shuffle(rows)
+    return outs if len(outs) > 1 else outs[0]
+
+
+@ray_tpu.remote
+def _split_group(*round_merges):
+    """Reduce stage for keyed partitions (groupby): concat across rounds,
+    emit each partition as its own block; num_returns = group size."""
+    outs = _concat_rounds(round_merges)
+    return outs if len(outs) > 1 else outs[0]
 
 
 @ray_tpu.remote
@@ -323,21 +377,61 @@ class Dataset:
             return self._executed, []
         return self._base_refs, list(self._ops)
 
-    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
-        """ray: dataset.py:1008; two-phase push-based shuffle
-        (ray: _internal/push_based_shuffle.py).  The pending map chain
-        fuses into the partition phase: one task per input block total."""
+    # Push-based shuffle knobs (ray: push_based_shuffle.py computes a
+    # merge factor from cluster shape; fixed here — P mergers, and
+    # ROUND_SIZE map tasks fold into the accumulators per round so merge
+    # work pipelines with still-running maps).
+    _SHUFFLE_MERGERS = 8
+    _SHUFFLE_ROUND_SIZE = 8
+
+    def _push_partition(
+        self, n: int, key_fn, base_seed: Optional[int]
+    ) -> Tuple[List[Any], List[int]]:
+        """Shared push-based partition machinery (shuffle AND groupby):
+        round-chained map + merge over P merger groups.  Returns the P
+        accumulator refs and the group bounds."""
         refs, ops = self._fusable_inputs()
-        n = max(len(refs), 1)
+        P = min(self._SHUFFLE_MERGERS, n)
+        bounds = [p * n // P for p in range(P + 1)]
+        rounds: List[List[Any]] = [[] for _ in range(P)]  # per-group merges
+        for r0 in range(0, len(refs), self._SHUFFLE_ROUND_SIZE):
+            rrefs = refs[r0 : r0 + self._SHUFFLE_ROUND_SIZE]
+            packs = [
+                _partition_block_grouped.options(num_returns=P).remote(
+                    b, ops, n, bounds, key_fn,
+                    None if base_seed is None else base_seed + r0 + j,
+                )
+                for j, b in enumerate(rrefs)
+            ]
+            for p in range(P):
+                cols = [
+                    (packs[j][p] if P > 1 else packs[j])
+                    for j in range(len(packs))
+                ]
+                rounds[p].append(_merge_group_round.remote(*cols))
+        return rounds, bounds
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        """ray: dataset.py:1008; PUSH-BASED two-stage shuffle (ray:
+        _internal/push_based_shuffle.py).  The pending map chain fuses
+        into the partition stage; maps emit one packed object per merger
+        (M x P intermediates, not M x N); mergers fold map outputs in
+        ROUNDS chained through an accumulator, so merging overlaps the
+        next round's maps and each round's intermediates free as they
+        fold; a final per-group reduce permutes and emits the output
+        partitions."""
+        if not self._base_refs:
+            return Dataset([])
+        n = len(self._fusable_inputs()[0])  # outputs mirror input blocks
         base = seed if seed is not None else random.randrange(2**31)
-        parts = [
-            _partition_block.options(num_returns=n).remote(b, ops, n, None, base + i)
-            for i, b in enumerate(refs)
-        ]
-        new_refs = [
-            _merge_shuffle.remote(base + 7919 + i, *[parts[j][i] for j in range(len(parts))])
-            for i in range(n)
-        ]
+        rounds, bounds = self._push_partition(n, None, base)
+        new_refs: List[Any] = []
+        for p in range(len(rounds)):
+            g = bounds[p + 1] - bounds[p]  # >= 1: P <= n
+            out = _finalize_shuffle_group.options(num_returns=g).remote(
+                base + 7919 + p, *rounds[p]
+            )
+            new_refs.extend(out if g > 1 else [out])
         return Dataset(new_refs)
 
     def sort(self, key: Optional[Callable] = None, descending: bool = False) -> "Dataset":
@@ -349,17 +443,17 @@ class Dataset:
         self, key_fn: Callable, agg_fn: Callable[[Any, List[Any]], Any], num_partitions: int = 8
     ) -> "Dataset":
         """Hash-partition by key, then aggregate per partition (simplified
-        GroupedData — ray: python/ray/data/grouped_data.py)."""
+        GroupedData — ray: python/ray/data/grouped_data.py).  Rides the
+        same push-based round-merged partition machinery as shuffle."""
         n = num_partitions
-        refs, ops = self._fusable_inputs()
-        parts = [
-            _partition_block.options(num_returns=n).remote(b, ops, n, key_fn, None)
-            for b in refs
-        ]
-        merged = [
-            _merge_shards.remote(*[parts[j][i] for j in range(len(parts))])
-            for i in range(n)
-        ]
+        if not self._base_refs:
+            return Dataset([])
+        rounds, bounds = self._push_partition(n, key_fn, None)
+        merged: List[Any] = []
+        for p in range(len(rounds)):
+            g = bounds[p + 1] - bounds[p]  # >= 1: P <= n
+            out = _split_group.options(num_returns=g).remote(*rounds[p])
+            merged.extend(out if g > 1 else [out])
 
         def agg(block: Block) -> Block:
             groups: Dict[Any, List[Any]] = {}
