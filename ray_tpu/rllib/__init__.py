@@ -16,6 +16,7 @@ pattern).
 
 from ray_tpu.rllib.env import (
     CartPoleVectorEnv,
+    PendulumVectorEnv,
     VectorEnv,
     make_vector_env,
     register_env,
@@ -24,6 +25,9 @@ from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.policy import JaxPolicy, apply_policy, init_policy_params
 from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, LearnerGroup, vtrace
+from ray_tpu.rllib.appo import APPO, APPOConfig
+from ray_tpu.rllib.sac import SAC, SACConfig
+from ray_tpu.rllib.rl_module import ContinuousMLPModule, MLPModule, RLModule
 from ray_tpu.rllib.multi_agent import (
     MultiAgentCartPole,
     MultiAgentEnvRunner,
@@ -37,6 +41,14 @@ from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae
 
 __all__ = [
+    "PendulumVectorEnv",
+    "APPO",
+    "APPOConfig",
+    "SAC",
+    "SACConfig",
+    "RLModule",
+    "MLPModule",
+    "ContinuousMLPModule",
     "CartPoleVectorEnv",
     "DQN",
     "DQNConfig",

@@ -1,0 +1,58 @@
+"""APPO: asynchronous PPO (IMPALA architecture + clipped surrogate).
+
+ray: rllib/algorithms/appo/appo.py — the reference's APPO runs PPO's
+clipped-surrogate objective on IMPALA's asynchronous actor-learner
+machinery, with V-trace correcting the sampling lag.  Here that is
+literally the composition: APPO IS the IMPALA pipeline with the
+learner's policy loss swapped for the PPO clip applied to V-trace
+advantages (make_impala_learner's pg_loss_fn hook — one expression of
+difference, zero duplicated machinery).
+"""
+
+from __future__ import annotations
+
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, make_impala_learner
+
+
+class APPOConfig(IMPALAConfig):
+    """IMPALA's knobs + the PPO clip (ray: appo.py APPOConfig).  Note
+    vf_coeff keeps IMPALA's small default (0.01): advantages are
+    standardized while V-trace value targets are raw returns — a large
+    vf weight lets value gradients crush the shared torso (measured:
+    0.5 plateaus CartPole at ~65 reward; 0.01 clears 130)."""
+
+    def __init__(self):
+        super().__init__()
+        self.clip_param = 0.2
+
+    _TRAINING_KEYS = IMPALAConfig._TRAINING_KEYS | {"clip_param"}
+
+    def build(self) -> "APPO":
+        if self.env is None:
+            raise ValueError("call .environment(env) first")
+        return APPO(self)
+
+
+def make_appo_learner(config: APPOConfig, obs_size: int, num_actions: int):
+    """IMPALA's V-trace learner with the PPO clipped surrogate as the
+    policy objective (ray: appo_torch_policy's surrogate over vtrace;
+    the behavior policy's logp is the ratio denominator)."""
+    import jax.numpy as jnp
+
+    clip = config.clip_param
+
+    def clipped_surrogate(logp, behavior_logp, adv):
+        ratio = jnp.exp(logp - behavior_logp)
+        pg1 = ratio * adv
+        pg2 = jnp.clip(ratio, 1.0 - clip, 1.0 + clip) * adv
+        return -jnp.mean(jnp.minimum(pg1, pg2))
+
+    return make_impala_learner(
+        config, obs_size, num_actions, pg_loss_fn=clipped_surrogate
+    )
+
+
+class APPO(IMPALA):
+    """IMPALA's async pipeline, PPO's objective (ray: appo.py APPO)."""
+
+    _make_learner = staticmethod(make_appo_learner)

@@ -264,6 +264,11 @@ class DQN:
                 self._num_actions = self.offline.num_actions
         else:
             probe = make_vector_env(config.env, 1, seed=0)
+            if getattr(probe, "continuous", False):
+                raise ValueError(
+                    "DQN needs a discrete-action env; use SAC for "
+                    "continuous control"
+                )
             self._obs_size = probe.observation_size
             self._num_actions = probe.num_actions
         init_state, self._update, self._sync = _make_learner(

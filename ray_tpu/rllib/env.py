@@ -121,8 +121,95 @@ class CartPoleVectorEnv(VectorEnv):
         return out
 
 
+class PendulumVectorEnv(VectorEnv):
+    """N independent Pendulum-v1 instances (classic control swing-up,
+    public-domain physics), vectorized in numpy.  CONTINUOUS action
+    space: torque in [-max_torque, max_torque], action_size 1 — the
+    continuous-control counterpart CartPole can't provide (SAC's test
+    bed).  Episodes are pure time-limit truncations (no termination)."""
+
+    MAX_SPEED = 8.0
+    MAX_TORQUE = 2.0
+    DT = 0.05
+    G = 10.0
+    M = 1.0
+    L = 1.0
+    MAX_STEPS = 200
+
+    num_actions = 1  # action_size for continuous envs
+    action_size = 1
+    continuous = True
+    observation_size = 3  # (cos th, sin th, th_dot)
+
+    def __init__(self, num_envs: int = 8, seed: int = 0):
+        self.num_envs = num_envs
+        self._rng = np.random.default_rng(seed)
+        self._th = np.zeros(num_envs)
+        self._thdot = np.zeros(num_envs)
+        self._steps = np.zeros(num_envs, dtype=np.int64)
+        self._episode_return = np.zeros(num_envs)
+        self.completed_episode_returns: list = []
+
+    def _obs(self) -> np.ndarray:
+        return np.stack(
+            [np.cos(self._th), np.sin(self._th), self._thdot], axis=1
+        ).astype(np.float32)
+
+    def _reset_indices(self, idx: np.ndarray) -> None:
+        self._th[idx] = self._rng.uniform(-np.pi, np.pi, size=len(idx))
+        self._thdot[idx] = self._rng.uniform(-1.0, 1.0, size=len(idx))
+        self._steps[idx] = 0
+        self._episode_return[idx] = 0.0
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._reset_indices(np.arange(self.num_envs))
+        return self._obs()
+
+    def step(self, actions: np.ndarray):
+        u = np.clip(
+            np.asarray(actions, dtype=np.float64).reshape(self.num_envs),
+            -self.MAX_TORQUE,
+            self.MAX_TORQUE,
+        )
+        th, thdot = self._th, self._thdot
+        norm_th = ((th + np.pi) % (2 * np.pi)) - np.pi
+        costs = norm_th**2 + 0.1 * thdot**2 + 0.001 * u**2
+        thdot = thdot + (
+            3.0 * self.G / (2.0 * self.L) * np.sin(th)
+            + 3.0 / (self.M * self.L**2) * u
+        ) * self.DT
+        thdot = np.clip(thdot, -self.MAX_SPEED, self.MAX_SPEED)
+        th = th + thdot * self.DT
+        self._th, self._thdot = th, thdot
+        self._steps += 1
+        rewards = (-costs).astype(np.float32)
+        self._episode_return += rewards
+
+        final_obs = self._obs()
+        terminated = np.zeros(self.num_envs, dtype=bool)
+        truncated = self._steps >= self.MAX_STEPS
+        done_idx = np.nonzero(truncated)[0]
+        if len(done_idx):
+            self.completed_episode_returns.extend(
+                self._episode_return[done_idx].tolist()
+            )
+            self._reset_indices(done_idx)
+        return final_obs, rewards, terminated, truncated
+
+    def current_obs(self) -> np.ndarray:
+        return self._obs()
+
+    def drain_episode_returns(self) -> list:
+        out = self.completed_episode_returns
+        self.completed_episode_returns = []
+        return out
+
+
 _ENV_REGISTRY: Dict[str, Callable[..., VectorEnv]] = {
     "CartPole-v1": CartPoleVectorEnv,
+    "Pendulum-v1": PendulumVectorEnv,
 }
 
 

@@ -40,13 +40,15 @@ class EnvRunner:
         lam: float = 0.95,
         seed: int = 0,
         hidden=(64, 64),
+        module=None,
     ):
         self.env = make_vector_env(env, num_envs, seed=seed)
         self.rollout_length = rollout_length
         self.gamma = gamma
         self.lam = lam
         self.policy = JaxPolicy(
-            self.env.observation_size, self.env.num_actions, seed=seed, hidden=hidden
+            self.env.observation_size, self.env.num_actions, seed=seed,
+            hidden=hidden, module=module,
         )
         self._obs = self.env.reset(seed=seed)
 

@@ -155,6 +155,7 @@ class IMPALAConfig:
         self.updates_per_iteration = 8
         self.broadcast_interval = 1  # weight refresh every N updates
         self.hidden = (64, 64)
+        self.module = None  # RLModule override (ray: rl_module.py)
         self.seed = 0
 
     def environment(self, env: str | Callable) -> "IMPALAConfig":
@@ -188,6 +189,11 @@ class IMPALAConfig:
             setattr(self, k, v)
         return self
 
+    def rl_module(self, module) -> "IMPALAConfig":
+        """Plug a custom RLModule (ray: core/rl_module/rl_module.py)."""
+        self.module = module
+        return self
+
     def debugging(self, seed: int = 0) -> "IMPALAConfig":
         self.seed = seed
         return self
@@ -198,25 +204,34 @@ class IMPALAConfig:
         return IMPALA(self)
 
 
-def make_impala_learner(config: IMPALAConfig, obs_size: int, num_actions: int):
+def make_impala_learner(
+    config: IMPALAConfig, obs_size: int, num_actions: int, pg_loss_fn=None
+):
     """(init_state, update_fn): V-trace actor-critic update as one pure fn.
 
     ray: rllib/algorithms/impala/vtrace_torch_policy + learner.py:657 —
     here loss, V-trace scan, grads and the optimizer step all fuse into a
     single XLA program, shardable by LearnerGroup.
+
+    pg_loss_fn(logp, behavior_logp, adv) -> scalar overrides the policy
+    objective on the SAME V-trace machinery (APPO passes the PPO clipped
+    surrogate; None = the plain V-trace policy gradient).
     """
     import jax
     import jax.numpy as jnp
     import optax
 
-    from ray_tpu.rllib.policy import apply_policy, init_policy_params
+    from ray_tpu.rllib.rl_module import MLPModule
+
+    module = config.module or MLPModule(config.hidden)
+    apply_policy = module.forward
 
     opt = optax.adam(config.lr)
     ent_c, vf_c = config.entropy_coeff, config.vf_coeff
 
     def init_state(seed: int):
         key = jax.random.PRNGKey(seed)
-        params = init_policy_params(key, obs_size, num_actions, config.hidden)
+        params = module.init(key, obs_size, num_actions)
         return {"params": params, "opt_state": opt.init(params)}
 
     def loss_fn(params, batch):
@@ -247,7 +262,10 @@ def make_impala_learner(config: IMPALAConfig, obs_size: int, num_actions: int):
         # a small rollout swing over orders of magnitude, drowning the
         # entropy/value terms (same reasoning as PPO's normalization).
         adv = (adv - adv.mean()) / (adv.std() + 1e-8)
-        pg_loss = -jnp.mean(adv * logp)
+        if pg_loss_fn is not None:
+            pg_loss = pg_loss_fn(logp, batch[LOGPS].reshape(-1), adv)
+        else:
+            pg_loss = -jnp.mean(adv * logp)
         vf_loss = 0.5 * jnp.mean((values - vs.reshape(-1)) ** 2)
         entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=1))
         total = pg_loss + vf_c * vf_loss - ent_c * entropy
@@ -280,13 +298,20 @@ class IMPALA:
     `avg_weights_lag`) is what V-trace corrects.
     """
 
+    _make_learner = staticmethod(make_impala_learner)
+
     def __init__(self, config: IMPALAConfig):
         self.config = config
         ray_tpu.init(ignore_reinit_error=True)
         probe = make_vector_env(config.env, 1, seed=0)
+        if getattr(probe, "continuous", False):
+            raise ValueError(
+                f"{type(self).__name__} needs a discrete-action env; "
+                "use SAC for continuous control"
+            )
         self._obs_size = probe.observation_size
         self._num_actions = probe.num_actions
-        init_state, update_fn = make_impala_learner(
+        init_state, update_fn = self._make_learner(
             config, self._obs_size, self._num_actions
         )
         self._learners = LearnerGroup(update_fn, config.num_learners)
@@ -303,6 +328,7 @@ class IMPALA:
                 gamma=config.gamma,
                 seed=config.seed + 1000 * (i + 1),
                 hidden=config.hidden,
+                module=config.module,
             )
             for i in range(config.num_env_runners)
         ]

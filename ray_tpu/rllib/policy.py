@@ -63,14 +63,35 @@ def _sample_actions(params, obs, key):
 
 
 class JaxPolicy:
-    """Stateful convenience wrapper used by env runners: params + rng."""
+    """Stateful convenience wrapper used by env runners: params + rng.
 
-    def __init__(self, obs_size: int, num_actions: int, seed: int = 0, hidden=(64, 64)):
+    `module` plugs a custom RLModule architecture in (ray:
+    rl_module.py); None keeps the built-in MLP fast path (a module-level
+    jit shared across instances)."""
+
+    def __init__(self, obs_size: int, num_actions: int, seed: int = 0,
+                 hidden=(64, 64), module=None):
         self.obs_size = obs_size
         self.num_actions = num_actions
+        self.module = module
         key = jax.random.PRNGKey(seed)
         self._key, init_key = jax.random.split(key)
-        self.params = init_policy_params(init_key, obs_size, num_actions, hidden)
+        if module is None:
+            self.params = init_policy_params(init_key, obs_size, num_actions, hidden)
+            self._sample = _sample_actions
+        else:
+            self.params = module.init(init_key, obs_size, num_actions)
+            fwd = module.forward
+
+            @jax.jit
+            def _sample(params, obs, key):
+                logits, value = fwd(params, obs)
+                action = jax.random.categorical(key, logits)
+                logp = jax.nn.log_softmax(logits)
+                logp_a = jnp.take_along_axis(logp, action[:, None], axis=1)[:, 0]
+                return action, logp_a, value
+
+            self._sample = _sample
 
     def set_weights(self, params) -> None:
         self.params = jax.tree_util.tree_map(jnp.asarray, params)
@@ -81,5 +102,5 @@ class JaxPolicy:
     def compute_actions(self, obs: np.ndarray):
         """Batch inference: [N, obs] → (actions [N], logp [N], values [N])."""
         self._key, sub = jax.random.split(self._key)
-        a, lp, v = _sample_actions(self.params, jnp.asarray(obs), sub)
+        a, lp, v = self._sample(self.params, jnp.asarray(obs), sub)
         return np.asarray(a), np.asarray(lp), np.asarray(v)

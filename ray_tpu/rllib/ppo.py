@@ -45,6 +45,7 @@ class PPOConfig:
         self.num_epochs = 4
         self.minibatch_size = 256
         self.hidden = (64, 64)
+        self.module = None  # RLModule override (ray: rl_module.py)
         self.seed = 0
 
     # -- builder sections (mirror the reference's fluent API) -------------
@@ -78,6 +79,11 @@ class PPOConfig:
             setattr(self, k, v)
         return self
 
+    def rl_module(self, module) -> "PPOConfig":
+        """Plug a custom RLModule (ray: core/rl_module/rl_module.py)."""
+        self.module = module
+        return self
+
     def debugging(self, seed: int = 0) -> "PPOConfig":
         self.seed = seed
         return self
@@ -95,14 +101,17 @@ def _make_learner(config: PPOConfig, obs_size: int, num_actions: int):
     import jax.numpy as jnp
     import optax
 
-    from ray_tpu.rllib.policy import apply_policy, init_policy_params
+    from ray_tpu.rllib.rl_module import MLPModule
+
+    module = config.module or MLPModule(config.hidden)
+    apply_policy = module.forward
 
     opt = optax.adam(config.lr)
     clip, ent_c, vf_c = config.clip_param, config.entropy_coeff, config.vf_coeff
 
     def init_state(seed: int):
         key = jax.random.PRNGKey(seed)
-        params = init_policy_params(key, obs_size, num_actions, config.hidden)
+        params = module.init(key, obs_size, num_actions)
         return {"params": params, "opt_state": opt.init(params), "key": key}
 
     def loss_fn(params, mb):
@@ -172,6 +181,11 @@ class PPO:
         self.config = config
         ray_tpu.init(ignore_reinit_error=True)
         probe = make_vector_env(config.env, 1, seed=0)
+        if getattr(probe, "continuous", False):
+            raise ValueError(
+                f"{type(self).__name__} needs a discrete-action env; "
+                "use SAC for continuous control"
+            )
         self._obs_size = probe.observation_size
         self._num_actions = probe.num_actions
         init_state, self._update = _make_learner(
@@ -188,6 +202,7 @@ class PPO:
                 lam=config.lam,
                 seed=config.seed + 1000 * (i + 1),
                 hidden=config.hidden,
+                module=config.module,
             )
             for i in range(config.num_env_runners)
         ]

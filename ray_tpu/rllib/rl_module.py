@@ -1,0 +1,119 @@
+"""RLModule: the pluggable model abstraction.
+
+ray: rllib/core/rl_module/rl_module.py — the reference's new-stack module
+API lets users swap network architectures into any algorithm.  JAX-first
+redesign: a module is a pair of PURE functions — `init(key, obs_size,
+num_actions) -> params` and `forward(params, obs) -> (logits, value)` —
+so algorithms jit/grad/shard straight through it; no framework wrapper
+object holds state.  Modules must be cloudpickle-able (they ride task
+specs to env-runner actors).
+
+Built-ins:
+  * MLPModule        — tanh MLP torso + categorical policy / value heads
+                       (the default every algorithm uses);
+  * ContinuousMLPModule — squashed-Gaussian policy + twin Q heads (SAC).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+
+class RLModule:
+    """Interface (ray: rl_module.py RLModule): subclass and override
+    init/forward to plug a custom architecture into PPO/IMPALA/APPO
+    via config.rl_module(module=...)."""
+
+    def init(self, key, obs_size: int, num_actions: int) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def forward(self, params: Dict[str, Any], obs):
+        """obs [B, obs_size] -> (logits [B, A], value [B])."""
+        raise NotImplementedError
+
+
+class MLPModule(RLModule):
+    """Default actor-critic MLP (orthogonal init, tanh activations)."""
+
+    def __init__(self, hidden: Tuple[int, ...] = (64, 64)):
+        self.hidden = tuple(hidden)
+
+    def init(self, key, obs_size: int, num_actions: int) -> Dict[str, Any]:
+        from ray_tpu.rllib.policy import init_policy_params
+
+        return init_policy_params(key, obs_size, num_actions, self.hidden)
+
+    def forward(self, params: Dict[str, Any], obs):
+        from ray_tpu.rllib.policy import apply_policy
+
+        return apply_policy(params, obs)
+
+
+class ContinuousMLPModule(RLModule):
+    """Squashed-Gaussian actor + twin Q critics for continuous control
+    (SAC — ray: rllib/algorithms/sac's policy/Q model pair)."""
+
+    def __init__(self, hidden: Tuple[int, ...] = (128, 128)):
+        self.hidden = tuple(hidden)
+
+    @staticmethod
+    def _mlp_init(key, sizes, out, out_scale=1.0):
+        import jax
+        import jax.numpy as jnp
+
+        keys = jax.random.split(key, len(sizes))
+        layers = []
+        dims = sizes + (out,)
+        for i in range(len(dims) - 1):
+            scale = jnp.sqrt(2.0) if i < len(dims) - 2 else out_scale
+            layers.append(
+                {
+                    "w": jax.nn.initializers.orthogonal(scale)(
+                        keys[i], (dims[i], dims[i + 1])
+                    ),
+                    "b": jnp.zeros(dims[i + 1]),
+                }
+            )
+        return layers
+
+    @staticmethod
+    def _mlp_apply(layers, x):
+        import jax.numpy as jnp
+
+        for i, l in enumerate(layers):
+            x = x @ l["w"] + l["b"]
+            if i < len(layers) - 1:
+                x = jnp.tanh(x)
+        return x
+
+    def init(self, key, obs_size: int, act_size: int) -> Dict[str, Any]:
+        import jax
+
+        k_pi, k_q1, k_q2 = jax.random.split(key, 3)
+        sizes = (obs_size,) + self.hidden
+        q_sizes = (obs_size + act_size,) + self.hidden
+        return {
+            "pi": self._mlp_init(k_pi, sizes, 2 * act_size, 0.01),
+            "q1": self._mlp_init(k_q1, q_sizes, 1),
+            "q2": self._mlp_init(k_q2, q_sizes, 1),
+        }
+
+    def pi(self, params, obs):
+        """-> (mean [B, A], log_std [B, A])."""
+        import jax.numpy as jnp
+
+        out = self._mlp_apply(params["pi"], obs)
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        return mean, jnp.clip(log_std, -20.0, 2.0)
+
+    def q(self, params, obs, act):
+        import jax.numpy as jnp
+
+        x = jnp.concatenate([obs, act], axis=-1)
+        q1 = self._mlp_apply(params["q1"], x)[..., 0]
+        q2 = self._mlp_apply(params["q2"], x)[..., 0]
+        return q1, q2
+
+    def forward(self, params, obs):  # actor-critic surface (unused by SAC)
+        mean, log_std = self.pi(params, obs)
+        return mean, log_std

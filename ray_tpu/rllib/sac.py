@@ -1,0 +1,405 @@
+"""SAC: soft actor-critic for continuous control.
+
+ray: rllib/algorithms/sac/sac.py — off-policy maximum-entropy RL with a
+squashed-Gaussian actor, twin Q critics, polyak-averaged targets, and
+automatic entropy-temperature tuning.  TPU-first: the whole update
+(actor + both critics + alpha + target polyak) is ONE jitted program;
+replay sampling stays host-side numpy; env runners are actors collecting
+with the freshest actor params (same runner pattern as DQN).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import make_vector_env
+from ray_tpu.rllib.rl_module import ContinuousMLPModule
+
+
+class ContinuousReplayBuffer:
+    """Numpy ring buffer with float action vectors (the DQN buffer stores
+    int action ids; ray: replay_buffers/replay_buffer.py)."""
+
+    def __init__(self, capacity: int, obs_size: int, act_size: int):
+        self.capacity = capacity
+        self.obs = np.zeros((capacity, obs_size), dtype=np.float32)
+        self.actions = np.zeros((capacity, act_size), dtype=np.float32)
+        self.rewards = np.zeros(capacity, dtype=np.float32)
+        self.next_obs = np.zeros((capacity, obs_size), dtype=np.float32)
+        self.terminateds = np.zeros(capacity, dtype=np.float32)
+        self.size = 0
+        self._idx = 0
+
+    def add_batch(self, obs, actions, rewards, next_obs, terminateds) -> None:
+        n = len(obs)
+        idx = (self._idx + np.arange(n)) % self.capacity
+        self.obs[idx] = obs
+        self.actions[idx] = actions
+        self.rewards[idx] = rewards
+        self.next_obs[idx] = next_obs
+        self.terminateds[idx] = terminateds
+        self._idx = (self._idx + n) % self.capacity
+        self.size = min(self.size + n, self.capacity)
+
+    def sample(self, batch_size: int, rng: np.random.Generator) -> Dict[str, np.ndarray]:
+        idx = rng.integers(0, self.size, size=batch_size)
+        return {
+            "obs": self.obs[idx],
+            "actions": self.actions[idx],
+            "rewards": self.rewards[idx],
+            "next_obs": self.next_obs[idx],
+            "terminateds": self.terminateds[idx],
+        }
+
+
+class _SACRunner:
+    """Actor payload: steps a continuous VectorEnv with the squashed-
+    Gaussian actor (jitted batch inference)."""
+
+    def __init__(self, env, num_envs: int, seed: int, hidden, act_limit: float):
+        import jax
+
+        self.env = make_vector_env(env, num_envs, seed=seed)
+        self.module = ContinuousMLPModule(hidden)
+        self.act_limit = act_limit
+        self._key = jax.random.PRNGKey(seed)
+        self._params = None
+        mod, limit = self.module, act_limit
+
+        @jax.jit
+        def _act(params, obs, key):
+            import jax.numpy as jnp
+
+            mean, log_std = mod.pi(params, obs)
+            eps = jax.random.normal(key, mean.shape)
+            return jnp.tanh(mean + jnp.exp(log_std) * eps) * limit
+
+        self._act = _act
+        self._obs = self.env.reset(seed=seed)
+
+    def collect(self, params, n_steps: int, random_actions: bool = False) -> Dict[str, Any]:
+        import jax
+
+        if params is not None:
+            self._params = params
+        N = self.env.num_envs
+        cols = {k: [] for k in ("obs", "actions", "rewards", "next_obs", "terminateds")}
+        obs = self._obs
+        self._key, sub = jax.random.split(self._key)  # fresh per collect:
+        # an unsplit key would replay the SAME warmup action sequence
+        # every call, filling the buffer with correlated exploration
+        rng = np.random.default_rng(int(jax.random.randint(sub, (), 0, 2**31 - 1)))
+        for _ in range(n_steps):
+            if random_actions or self._params is None:
+                acts = rng.uniform(
+                    -self.act_limit, self.act_limit,
+                    size=(N, self.env.action_size),
+                ).astype(np.float32)
+            else:
+                self._key, sub = jax.random.split(self._key)
+                acts = np.asarray(self._act(self._params, obs, sub))
+            final_obs, rewards, terminated, _trunc = self.env.step(acts)
+            cols["obs"].append(obs)
+            cols["actions"].append(acts)
+            cols["rewards"].append(rewards)
+            cols["next_obs"].append(final_obs)
+            cols["terminateds"].append(terminated.astype(np.float32))
+            obs = self.env.current_obs()
+        self._obs = obs
+        return {
+            "batch": {k: np.concatenate(v, axis=0) for k, v in cols.items()},
+            "episode_returns": self.env.drain_episode_returns(),
+            "steps": n_steps * N,
+        }
+
+    def ping(self):
+        return "pong"
+
+
+class SACConfig:
+    """Builder-style config (ray: sac.py SACConfig)."""
+
+    def __init__(self):
+        self.env: Optional[str | Callable] = None
+        self.num_env_runners = 1
+        self.num_envs_per_runner = 8
+        self.rollout_length = 32
+        self.gamma = 0.99
+        self.lr = 3e-4
+        self.tau = 0.005  # polyak
+        self.batch_size = 256
+        self.buffer_capacity = 100_000
+        self.learning_starts = 1_000
+        self.updates_per_iteration = 64
+        self.act_limit = 2.0
+        self.target_entropy: Optional[float] = None  # default: -act_size
+        self.hidden = (128, 128)
+        self.seed = 0
+
+    def environment(self, env) -> "SACConfig":
+        self.env = env
+        return self
+
+    def env_runners(self, num_env_runners=1, num_envs_per_runner=8,
+                    rollout_length=32) -> "SACConfig":
+        self.num_env_runners = num_env_runners
+        self.num_envs_per_runner = num_envs_per_runner
+        self.rollout_length = rollout_length
+        return self
+
+    _TRAINING_KEYS = frozenset(
+        {
+            "gamma", "lr", "tau", "batch_size", "buffer_capacity",
+            "learning_starts", "updates_per_iteration", "act_limit",
+            "target_entropy", "hidden",
+        }
+    )
+
+    def training(self, **kw) -> "SACConfig":
+        for k, v in kw.items():
+            if k not in self._TRAINING_KEYS:
+                raise TypeError(
+                    f"unknown SAC training option {k!r}; valid: "
+                    f"{sorted(self._TRAINING_KEYS)}"
+                )
+            setattr(self, k, v)
+        return self
+
+    def debugging(self, seed: int = 0) -> "SACConfig":
+        self.seed = seed
+        return self
+
+    def build(self) -> "SAC":
+        if self.env is None:
+            raise ValueError("call .environment(env) first")
+        return SAC(self)
+
+
+def make_sac_learner(config: SACConfig, obs_size: int, act_size: int):
+    """(init_state, update): actor + twin critics + alpha + polyak, fused
+    into one XLA program (ray: sac_torch_policy's three optimizers)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    module = ContinuousMLPModule(config.hidden)
+    limit = config.act_limit
+    target_ent = (
+        config.target_entropy if config.target_entropy is not None else -float(act_size)
+    )
+    pi_opt = optax.adam(config.lr)
+    q_opt = optax.adam(config.lr)
+    a_opt = optax.adam(config.lr)
+    gamma, tau = config.gamma, config.tau
+
+    def sample_action(params, obs, key):
+        """Reparameterized squashed-Gaussian sample + log-prob with the
+        tanh change-of-variables correction."""
+        mean, log_std = module.pi(params, obs)
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(key, mean.shape)
+        pre = mean + std * eps
+        act = jnp.tanh(pre)
+        logp = (
+            -0.5 * (((pre - mean) / std) ** 2 + 2 * log_std + jnp.log(2 * jnp.pi))
+        ).sum(-1)
+        logp = logp - jnp.sum(jnp.log(1 - act**2 + 1e-6), axis=-1)
+        return act * limit, logp
+
+    def init_state(seed: int):
+        key = jax.random.PRNGKey(seed)
+        k_init, key = jax.random.split(key)
+        params = module.init(k_init, obs_size, act_size)
+        return {
+            "params": params,
+            "target": jax.tree_util.tree_map(jnp.array, params),
+            "pi_opt": pi_opt.init(params["pi"]),
+            "q_opt": q_opt.init({"q1": params["q1"], "q2": params["q2"]}),
+            "log_alpha": jnp.zeros(()),
+            "a_opt": a_opt.init(jnp.zeros(())),
+            "key": key,
+        }
+
+    def update(state, batch):
+        key, k_next, k_pi = jax.random.split(state["key"], 3)
+        params, target = state["params"], state["target"]
+        alpha = jnp.exp(state["log_alpha"])
+
+        # -- critic ----------------------------------------------------
+        next_act, next_logp = sample_action(params, batch["next_obs"], k_next)
+        tq1, tq2 = module.q(target, batch["next_obs"], next_act)
+        y = batch["rewards"] + gamma * (1.0 - batch["terminateds"]) * (
+            jnp.minimum(tq1, tq2) - alpha * next_logp
+        )
+        y = jax.lax.stop_gradient(y)
+
+        def q_loss_fn(q_params):
+            p = {**params, **q_params}
+            q1, q2 = module.q(p, batch["obs"], batch["actions"])
+            return jnp.mean((q1 - y) ** 2) + jnp.mean((q2 - y) ** 2)
+
+        q_params = {"q1": params["q1"], "q2": params["q2"]}
+        q_loss, q_grads = jax.value_and_grad(q_loss_fn)(q_params)
+        q_updates, q_opt_state = q_opt.update(q_grads, state["q_opt"], q_params)
+        q_params = optax.apply_updates(q_params, q_updates)
+        params = {**params, **q_params}
+
+        # -- actor -----------------------------------------------------
+        def pi_loss_fn(pi_params):
+            p = {**params, "pi": pi_params}
+            act, logp = sample_action(p, batch["obs"], k_pi)
+            q1, q2 = module.q(params, batch["obs"], act)
+            return jnp.mean(alpha * logp - jnp.minimum(q1, q2)), logp
+
+        (pi_loss, logp), pi_grads = jax.value_and_grad(
+            pi_loss_fn, has_aux=True
+        )(params["pi"])
+        pi_updates, pi_opt_state = pi_opt.update(
+            pi_grads, state["pi_opt"], params["pi"]
+        )
+        params = {**params, "pi": optax.apply_updates(params["pi"], pi_updates)}
+
+        # -- temperature ----------------------------------------------
+        def a_loss_fn(log_alpha):
+            return -jnp.mean(
+                jnp.exp(log_alpha) * jax.lax.stop_gradient(logp + target_ent)
+            )
+
+        a_loss, a_grad = jax.value_and_grad(a_loss_fn)(state["log_alpha"])
+        a_updates, a_opt_state = a_opt.update(a_grad, state["a_opt"])
+        log_alpha = optax.apply_updates(state["log_alpha"], a_updates)
+
+        # -- polyak target --------------------------------------------
+        target = jax.tree_util.tree_map(
+            lambda t, p: (1.0 - tau) * t + tau * p, target, params
+        )
+        metrics = {
+            "q_loss": q_loss,
+            "pi_loss": pi_loss,
+            "alpha": jnp.exp(log_alpha),
+            "entropy": -jnp.mean(logp),
+        }
+        return {
+            "params": params,
+            "target": target,
+            "pi_opt": pi_opt_state,
+            "q_opt": q_opt_state,
+            "log_alpha": log_alpha,
+            "a_opt": a_opt_state,
+            "key": key,
+        }, metrics
+
+    return init_state, jax.jit(update, donate_argnums=(0,))
+
+
+class SAC:
+    """ray: Algorithm surface (train/save/restore/get_weights) over the
+    SAC learner + replay + runner actors."""
+
+    def __init__(self, config: SACConfig):
+        self.config = config
+        ray_tpu.init(ignore_reinit_error=True)
+        probe = make_vector_env(config.env, 1, seed=0)
+        if not getattr(probe, "continuous", False):
+            raise ValueError("SAC needs a continuous-action env (e.g. Pendulum-v1)")
+        self._obs_size = probe.observation_size
+        self._act_size = probe.action_size
+        init_state, self._update = make_sac_learner(
+            config, self._obs_size, self._act_size
+        )
+        self._state = init_state(config.seed)
+        self.buffer = ContinuousReplayBuffer(
+            config.buffer_capacity, self._obs_size, self._act_size
+        )
+        self._rng = np.random.default_rng(config.seed)
+        Runner = ray_tpu.remote(_SACRunner)
+        self.runners = [
+            Runner.remote(
+                config.env, config.num_envs_per_runner,
+                config.seed + 1000 * (i + 1), config.hidden, config.act_limit,
+            )
+            for i in range(config.num_env_runners)
+        ]
+        ray_tpu.get([r.ping.remote() for r in self.runners], timeout=120)
+        self.iteration = 0
+        self._total_steps = 0
+        self._episode_returns: List[float] = []
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree_util.tree_map(np.asarray, self._state["params"])
+
+    def train(self) -> Dict[str, Any]:
+        import jax
+
+        t0 = time.time()
+        weights = self.get_weights()
+        warmup = self._total_steps < self.config.learning_starts
+        results = ray_tpu.get(
+            [
+                r.collect.remote(weights, self.config.rollout_length, warmup)
+                for r in self.runners
+            ],
+            timeout=300,
+        )
+        steps = 0
+        for res in results:
+            b = res["batch"]
+            self.buffer.add_batch(
+                b["obs"], b["actions"], b["rewards"], b["next_obs"],
+                b["terminateds"],
+            )
+            self._episode_returns.extend(res["episode_returns"])
+            steps += res["steps"]
+        self._total_steps += steps
+
+        metrics: Dict[str, Any] = {}
+        if self._total_steps >= self.config.learning_starts:
+            for _ in range(self.config.updates_per_iteration):
+                batch = self.buffer.sample(self.config.batch_size, self._rng)
+                self._state, metrics = self._update(self._state, batch)
+        self._episode_returns = self._episode_returns[-100:]
+        self.iteration += 1
+        mean_ret = (
+            float(np.mean(self._episode_returns)) if self._episode_returns else 0.0
+        )
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": mean_ret,
+            "num_env_steps_sampled": self._total_steps,
+            "env_steps_per_sec": steps / max(time.time() - t0, 1e-9),
+            **{k: float(v) for k, v in metrics.items()},
+        }
+
+    def save(self, path: Optional[str] = None) -> str:
+        import jax
+
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        host = jax.tree_util.tree_map(np.asarray, self._state)
+        return Checkpoint.from_dict(
+            {"learner_state": host, "iteration": self.iteration}
+        ).to_directory(path)
+
+    def restore(self, path: str) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        d = Checkpoint.from_directory(path).to_dict()
+        self._state = jax.tree_util.tree_map(jnp.asarray, d["learner_state"])
+        self.iteration = d["iteration"]
+
+    def stop(self) -> None:
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        self.runners = []

@@ -607,3 +607,100 @@ def test_policy_client_server_roundtrip(rt):
     assert algo.buffer.size == len(batch["actions"])
     server.close()
     algo.stop()
+
+
+def test_appo_cartpole_learns(rt):
+    """APPO (async PPO: IMPALA pipeline + clipped surrogate on V-trace
+    advantages; ray: rllib/algorithms/appo) must clearly learn."""
+    from ray_tpu.rllib import APPOConfig
+
+    algo = (
+        APPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=2, num_envs_per_runner=8, rollout_length=64)
+        .training(lr=7e-4, updates_per_iteration=12, clip_param=0.3,
+                  entropy_coeff=3e-3)
+        .debugging(seed=5)
+        .build()
+    )
+    try:
+        best = 0.0
+        for _ in range(60):
+            result = algo.train()
+            best = max(best, result["episode_reward_mean"])
+            if best >= 100.0:
+                break
+        assert best >= 100.0, f"APPO failed to learn: best={best:.1f}"
+        assert result["avg_weights_lag"] >= 0  # the async pipeline ran
+    finally:
+        algo.stop()
+
+
+def test_sac_pendulum_learns(rt):
+    """SAC (squashed-Gaussian actor, twin Q, alpha auto-tune; ray:
+    rllib/algorithms/sac) improves Pendulum swing-up well past random."""
+    from ray_tpu.rllib import SACConfig
+
+    algo = (
+        SACConfig()
+        .environment("Pendulum-v1")
+        .env_runners(num_env_runners=1, num_envs_per_runner=16, rollout_length=25)
+        .training(learning_starts=800, updates_per_iteration=200,
+                  batch_size=128, lr=1e-3)
+        .debugging(seed=0)
+        .build()
+    )
+    try:
+        best = -1e9
+        for _ in range(40):
+            result = algo.train()
+            if result["episode_reward_mean"]:
+                best = max(best, result["episode_reward_mean"])
+            if best > -1000.0:
+                break
+        # random policy sits near -1200..-1500; learning clears -1000
+        assert best > -1000.0, f"SAC failed to improve: best={best:.1f}"
+        assert 0.0 < result["alpha"] < 2.0  # temperature auto-tuned
+    finally:
+        algo.stop()
+
+
+def test_custom_rl_module_plugs_into_ppo(rt):
+    """A user RLModule (ray: core/rl_module/rl_module.py) drops into PPO
+    via config.rl_module() and is used by BOTH learner and env runners."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib import PPOConfig
+    from ray_tpu.rllib.rl_module import RLModule
+
+    class TinyModule(RLModule):
+        def init(self, key, obs_size, num_actions):
+            k1, k2, k3 = jax.random.split(key, 3)
+            return {
+                "w1": jax.random.normal(k1, (obs_size, 32)) * 0.1,
+                "pi": jax.random.normal(k2, (32, num_actions)) * 0.01,
+                "vf": jax.random.normal(k3, (32, 1)) * 0.1,
+            }
+
+        def forward(self, params, obs):
+            h = jnp.tanh(obs @ params["w1"])
+            return h @ params["pi"], (h @ params["vf"])[..., 0]
+
+    algo = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=1, num_envs_per_runner=4, rollout_length=32)
+        .rl_module(TinyModule())
+        .debugging(seed=1)
+        .build()
+    )
+    try:
+        result = algo.train()
+        assert result["num_env_steps_sampled"] > 0
+        assert "w1" in algo.get_weights()  # the CUSTOM params are training
+        import numpy as np
+
+        assert np.isfinite(result["total_loss"])
+    finally:
+        algo.stop()
