@@ -59,6 +59,12 @@ _DEFS: Dict[str, tuple] = {
         "a spawned worker that hasn't connected within this window dies "
         "via its own watchdog",
     ),
+    "spill_storage_uri": (
+        "", str,
+        "external spill target URI (file:// native; s3://gs:// via fsspec "
+        "when installed); empty = session-local spill directory "
+        "(ray: external_storage.py:185)",
+    ),
     "native_store": (
         1, int,
         "1 = use the C++ shm arena when it builds; 0 = file-per-object",

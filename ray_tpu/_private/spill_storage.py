@@ -1,0 +1,141 @@
+"""Pluggable spill targets for the object store.
+
+ray: python/ray/_private/external_storage.py:185 — the reference spills
+plasma objects to local disk OR external storage (S3/URI) behind one
+interface.  Same shape here: the OwnerStore's reclaim path talks to a
+SpillStorage; the default is a local directory, and any fsspec-style URI
+(s3://, gs://, file://) selects the external backend via the
+RAY_TPU_SPILL_STORAGE_URI knob.  file:// works with zero dependencies;
+other schemes use `fsspec` when importable and fail with guidance when
+not (this image ships no cloud SDKs).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Optional
+
+
+class SpillStorage:
+    """put/get/delete of packed object images by locator string."""
+
+    def put(self, object_id: str, data) -> str:  # data: bytes-like
+        """Persist `data`; returns the locator later passed to get/delete."""
+        raise NotImplementedError
+
+    def get(self, locator: str) -> bytes:
+        raise NotImplementedError
+
+    def delete(self, locator: str) -> None:
+        raise NotImplementedError
+
+    def destroy(self) -> None:
+        """Session teardown: drop everything this session spilled."""
+
+
+class LocalSpillStorage(SpillStorage):
+    """File-per-object under a session-scoped directory (the default)."""
+
+    def __init__(self, directory: str):
+        self.dir = directory
+
+    def _path(self, object_id: str) -> str:
+        return os.path.join(self.dir, object_id.replace(":", "_"))
+
+    def put(self, object_id: str, data) -> str:  # data: bytes-like
+        os.makedirs(self.dir, exist_ok=True)
+        path = self._path(object_id)
+        with open(path, "wb") as f:
+            f.write(data)
+        return path
+
+    def get(self, locator: str) -> bytes:
+        with open(locator, "rb") as f:
+            return f.read()
+
+    def delete(self, locator: str) -> None:
+        try:
+            os.unlink(locator)
+        except OSError:
+            pass
+
+    def destroy(self) -> None:
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+
+class URISpillStorage(SpillStorage):
+    """External storage by URI prefix (ray: external_storage.py's
+    ExternalStorageSmartOpenImpl intent).  file:// is handled natively;
+    other schemes ride fsspec when importable."""
+
+    def __init__(self, base_uri: str, session: str):
+        self.base = base_uri.rstrip("/") + f"/raytpu-spill-{session}"
+        self.scheme = base_uri.split("://", 1)[0] if "://" in base_uri else "file"
+        self._fs = None
+        if self.scheme != "file":
+            try:
+                import fsspec
+
+                self._fs = fsspec.filesystem(self.scheme)
+            except Exception as e:  # noqa: BLE001 — actionable guidance
+                raise ValueError(
+                    f"spill URI scheme {self.scheme!r} needs the fsspec "
+                    f"package (and its {self.scheme} backend) installed; "
+                    "this environment has neither — use a file:// URI or "
+                    "the default local spill directory"
+                ) from e
+
+    def _local_path(self, uri: str) -> str:
+        return uri[len("file://"):] if uri.startswith("file://") else uri
+
+    def put(self, object_id: str, data) -> str:  # data: bytes-like
+        locator = f"{self.base}/{object_id.replace(':', '_')}"
+        if self._fs is None:
+            path = self._local_path(locator)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "wb") as f:
+                f.write(data)
+        else:
+            with self._fs.open(locator, "wb") as f:
+                f.write(data)
+        return locator
+
+    def get(self, locator: str) -> bytes:
+        if self._fs is None:
+            with open(self._local_path(locator), "rb") as f:
+                return f.read()
+        with self._fs.open(locator, "rb") as f:
+            return f.read()
+
+    def delete(self, locator: str) -> None:
+        try:
+            if self._fs is None:
+                os.unlink(self._local_path(locator))
+            else:
+                self._fs.rm(locator)
+        except Exception:
+            pass
+
+    def destroy(self) -> None:
+        try:
+            if self._fs is None:
+                shutil.rmtree(self._local_path(self.base), ignore_errors=True)
+            else:
+                self._fs.rm(self.base, recursive=True)
+        except Exception:
+            pass
+
+
+def make_spill_storage(
+    spill_dir: Optional[str], session: str
+) -> Optional[SpillStorage]:
+    """Backend per the spill_storage_uri knob; None disables spilling."""
+    from ray_tpu._private import config as _config
+
+    uri = _config.get("spill_storage_uri")
+    if uri:
+        return URISpillStorage(uri, session)
+    if spill_dir is None:
+        return None
+    return LocalSpillStorage(spill_dir)

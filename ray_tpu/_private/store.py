@@ -372,7 +372,16 @@ class OwnerStore:
         # rescanning its whole oid list (wakeup-storm O(n^2) otherwise).
         self._oid_waiters: Dict[str, List["_WaitToken"]] = {}
         self._errors: Dict[str, Any] = {}  # id -> exception to raise on get
-        self._spill_dir = spill_dir
+        # Pluggable spill backend (ray: external_storage.py:185): local
+        # directory by default, URI-selected external storage via the
+        # spill_storage_uri knob; locators are stored in _spilled.
+        from ray_tpu._private.spill_storage import make_spill_storage
+
+        self._spill_storage = make_spill_storage(spill_dir, session_name)
+        # Locators to delete OFF the lock (an external backend's rm may be
+        # a network call; running it under self._lock would stall every
+        # store operation) — drained by the reclaim thread.
+        self._spill_deletes: List[str] = []
         self._lock = threading.RLock()
         # Capacity + LRU clock (ray: plasma_allocator.h:44 footprint cap,
         # eviction_policy.h:105 LRUCache).  Overridable via env for tests/ops.
@@ -455,11 +464,9 @@ class OwnerStore:
             self._shm_bytes -= size
             self.shm.delete(object_id)
         p = self._spilled.pop(object_id, None)
-        if p:
-            try:
-                os.unlink(p)
-            except OSError:
-                pass
+        if p and self._spill_storage is not None:
+            self._spill_deletes.append(p)  # deleted off-lock by the reclaimer
+            self._reclaim_event.set()
         self._ready.pop(object_id, None)
         self._errors.pop(object_id, None)
         self._last_access.pop(object_id, None)
@@ -533,6 +540,13 @@ class OwnerStore:
             if not self._reclaim_event.is_set():
                 continue
             self._reclaim_event.clear()
+            with self._lock:
+                doomed, self._spill_deletes = self._spill_deletes, []
+            for loc in doomed:  # off-lock: external rm may be a network call
+                try:
+                    self._spill_storage.delete(loc)
+                except Exception:
+                    pass
             try:
                 self._make_room(0, strict=False)
             except Exception:
@@ -720,34 +734,33 @@ class OwnerStore:
     # -- spilling (ray: local_object_manager.h:110 SpillObjects) -------------
 
     def spill(self, object_id: str) -> Optional[str]:
-        if self._spill_dir is None:
+        if self._spill_storage is None:
             return None
         obj = self.shm.get(object_id)
         if obj is None:
             return None
-        os.makedirs(self._spill_dir, exist_ok=True)
-        path = os.path.join(self._spill_dir, object_id.replace(":", "_"))
-        with open(path, "wb") as f:
-            f.write(ser.pack(bytes(obj.payload), [pickle.PickleBuffer(b) for b in obj.buffers]))
+        locator = self._spill_storage.put(
+            object_id,
+            ser.pack(  # bytearray written as-is: no extra copy under pressure
+                bytes(obj.payload),
+                [pickle.PickleBuffer(b) for b in obj.buffers],
+            ),
+        )
         with self._lock:
             size = self._in_shm.pop(object_id, None)
             if size is None:
                 # Freed (remove_ref -> _free) between the unlocked read above
                 # and here: recording _spilled would resurrect a dead object
-                # and leak the file.
-                try:
-                    os.unlink(path)
-                except OSError:
-                    pass
+                # and leak the stored image.
+                self._spill_storage.delete(locator)
                 return None
-            self._spilled[object_id] = path
+            self._spilled[object_id] = locator
             self._shm_bytes -= size
             self.shm.delete(object_id)
-        return path
+        return locator
 
     def _restore(self, object_id: str, path: str) -> None:
-        with open(path, "rb") as f:
-            data = f.read()
+        data = self._spill_storage.get(path)
         # Non-strict: the object exists and must come back even when it is
         # individually larger than capacity (it got in via a worker-sealed
         # overage) — raising here would make it permanently unreadable.
@@ -758,10 +771,7 @@ class OwnerStore:
             self._account_shm(object_id, len(data))
             self._spilled.pop(object_id, None)
             self._touch(object_id)
-        try:
-            os.unlink(path)
-        except OSError:
-            pass
+        self._spill_storage.delete(path)
 
     def shm_usage(self) -> int:
         with self._lock:
@@ -771,5 +781,5 @@ class OwnerStore:
         self._destroyed = True
         self._reclaim_event.set()
         self.shm.destroy()
-        if self._spill_dir:
-            shutil.rmtree(self._spill_dir, ignore_errors=True)
+        if self._spill_storage is not None:
+            self._spill_storage.destroy()

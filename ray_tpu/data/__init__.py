@@ -8,6 +8,17 @@ ops (repartition/shuffle/sort/groupby) are two-phase task graphs.
 
 from ray_tpu.data.block import ArrowBlock, Block, BlockAccessor, NumpyBlock
 from ray_tpu.data.dataset import Dataset, DatasetPipeline
+from ray_tpu.data.datasource import (
+    CSVDatasource,
+    Datasource,
+    FileBasedDatasource,
+    JSONDatasource,
+    ParquetDatasource,
+    ReadTask,
+    TextDatasource,
+    read_datasource,
+    write_datasource,
+)
 from ray_tpu.data.read_api import (
     from_arrow,
     from_items,
@@ -22,6 +33,15 @@ from ray_tpu.data.read_api import (
 
 __all__ = [
     "ArrowBlock",
+    "CSVDatasource",
+    "Datasource",
+    "FileBasedDatasource",
+    "JSONDatasource",
+    "ParquetDatasource",
+    "ReadTask",
+    "TextDatasource",
+    "read_datasource",
+    "write_datasource",
     "Block",
     "BlockAccessor",
     "Dataset",

@@ -601,6 +601,13 @@ class Dataset:
                     pass
         return written
 
+    def write_datasource(self, datasource) -> List[Any]:
+        """Parallel per-block writes through a pluggable Datasource
+        (ray: Dataset.write_datasource)."""
+        from ray_tpu.data.datasource import write_datasource
+
+        return write_datasource(self, datasource)
+
     def write_parquet(self, path: str) -> List[str]:
         return self._write(path, "parquet", "parquet")
 

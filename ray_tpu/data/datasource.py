@@ -1,0 +1,140 @@
+"""Datasource: the pluggable read/write surface.
+
+ray: python/ray/data/datasource/datasource.py — a Datasource yields
+ReadTasks (serializable zero-arg callables, one per block/partition) that
+execute as distributed tasks; custom sources (databases, object stores,
+proprietary formats) plug into `read_datasource()` without touching the
+engine.  Writes mirror it: `Dataset.write_datasource()` runs
+`datasource.write_block(block, index)` once per block, in parallel.
+
+The built-in file readers (read_parquet/csv/json/text) are expressed as
+FileBasedDatasource subclasses, so they exercise the same plugin path a
+user source does.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+
+
+class ReadTask:
+    """One unit of read parallelism: a serializable callable returning a
+    Block (ray: datasource.py ReadTask).  `metadata` is free-form (row
+    counts, input files) surfaced for debugging."""
+
+    def __init__(self, read_fn: Callable[[], Any], metadata: Optional[dict] = None):
+        self._fn = read_fn
+        self.metadata = metadata or {}
+
+    def __call__(self):
+        return self._fn()
+
+
+class Datasource:
+    """Interface: override get_read_tasks (and optionally write_block)."""
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        raise NotImplementedError
+
+    def write_block(self, block, index: int) -> Any:
+        """One block -> one output partition (return value surfaced to the
+        caller, e.g. a path).  Optional: read-only sources skip it."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement writes"
+        )
+
+
+class FileBasedDatasource(Datasource):
+    """One ReadTask per file; subclasses implement _read_file(path).
+    ray: datasource/file_based_datasource.py."""
+
+    def __init__(self, paths):
+        from ray_tpu.data.read_api import _expand
+
+        self.paths = _expand(paths)
+
+    def _read_file(self, path: str):
+        raise NotImplementedError
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        read = self._read_file
+        return [
+            ReadTask(
+                (lambda p=p: read(p)),
+                metadata={"input_files": [p]},
+            )
+            for p in self.paths
+        ]
+
+
+class ParquetDatasource(FileBasedDatasource):
+    def __init__(self, paths, columns: Optional[List[str]] = None):
+        super().__init__(paths)
+        self.columns = columns
+
+    def _read_file(self, path: str):
+        import pyarrow.parquet as pq
+
+        from ray_tpu.data.block import ArrowBlock
+
+        return ArrowBlock(pq.read_table(path, columns=self.columns))
+
+
+class CSVDatasource(FileBasedDatasource):
+    def _read_file(self, path: str):
+        import pyarrow.csv as pacsv
+
+        return pacsv.read_csv(path).to_pylist()
+
+
+class JSONDatasource(FileBasedDatasource):
+    def _read_file(self, path: str):
+        import json
+
+        rows = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    rows.append(json.loads(line))
+        return rows
+
+
+class TextDatasource(FileBasedDatasource):
+    def _read_file(self, path: str):
+        with open(path) as f:
+            return [ln.rstrip("\n") for ln in f]
+
+
+@ray_tpu.remote
+def _run_read_task(task: ReadTask):
+    return task()
+
+
+@ray_tpu.remote
+def _run_write_block(datasource: Datasource, block, index: int):
+    return datasource.write_block(block, index)
+
+
+def read_datasource(datasource: Datasource, *, parallelism: int = 8):
+    """Execute a datasource's read plan as distributed tasks
+    (ray: read_api.py read_datasource)."""
+    from ray_tpu.data.dataset import Dataset
+
+    tasks = datasource.get_read_tasks(parallelism)
+    if not tasks:
+        return Dataset([ray_tpu.put([])])
+    return Dataset([_run_read_task.remote(t) for t in tasks])
+
+
+def write_datasource(dataset, datasource: Datasource) -> List[Any]:
+    """One write_block task per block, in parallel; returns the per-block
+    results (ray: Dataset.write_datasource)."""
+    return ray_tpu.get(
+        [
+            _run_write_block.remote(datasource, b, i)
+            for i, b in enumerate(dataset._block_refs)
+        ]
+    )

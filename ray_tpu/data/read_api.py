@@ -74,39 +74,6 @@ def from_arrow(table, *, parallelism: int = 8) -> Dataset:
     )
 
 
-@ray_tpu.remote
-def _read_parquet_file(path: str, columns):
-    """Parquet → ArrowBlock: the table stays Arrow end-to-end (slice /
-    map_batches(batch_format="pyarrow") / write_parquet without a row or
-    numpy detour; ray: datasource/parquet_datasource.py reads Arrow
-    blocks and block.py treats pyarrow.Table as the native block)."""
-    import pyarrow.parquet as pq
-
-    from ray_tpu.data.block import ArrowBlock
-
-    return ArrowBlock(pq.read_table(path, columns=columns))
-
-
-@ray_tpu.remote
-def _read_csv_file(path: str) -> List[Dict]:
-    import pyarrow.csv as pacsv
-
-    return pacsv.read_csv(path).to_pylist()
-
-
-@ray_tpu.remote
-def _read_json_file(path: str) -> List[Dict]:
-    import json
-
-    rows = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if line:
-                rows.append(json.loads(line))
-    return rows
-
-
 def _expand(paths) -> List[str]:
     if isinstance(paths, str):
         paths = [paths]
@@ -121,22 +88,29 @@ def _expand(paths) -> List[str]:
     return out
 
 
+# Built-in file readers ride the SAME pluggable path a user datasource
+# does (ray: read_parquet -> ParquetDatasource -> read_datasource).
+
+
 def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
-    return Dataset([_read_parquet_file.remote(p, columns) for p in _expand(paths)])
+    from ray_tpu.data.datasource import ParquetDatasource, read_datasource
+
+    return read_datasource(ParquetDatasource(paths, columns))
 
 
 def read_csv(paths) -> Dataset:
-    return Dataset([_read_csv_file.remote(p) for p in _expand(paths)])
+    from ray_tpu.data.datasource import CSVDatasource, read_datasource
+
+    return read_datasource(CSVDatasource(paths))
 
 
 def read_json(paths) -> Dataset:
-    return Dataset([_read_json_file.remote(p) for p in _expand(paths)])
+    from ray_tpu.data.datasource import JSONDatasource, read_datasource
+
+    return read_datasource(JSONDatasource(paths))
 
 
 def read_text(paths) -> Dataset:
-    @ray_tpu.remote
-    def _read(path: str) -> List[str]:
-        with open(path) as f:
-            return [ln.rstrip("\n") for ln in f]
+    from ray_tpu.data.datasource import TextDatasource, read_datasource
 
-    return Dataset([_read.remote(p) for p in _expand(paths)])
+    return read_datasource(TextDatasource(paths))

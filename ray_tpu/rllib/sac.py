@@ -338,8 +338,12 @@ class SAC:
         import jax
 
         t0 = time.time()
-        weights = self.get_weights()
         warmup = self._total_steps < self.config.learning_starts
+        # Runners only run the actor: ship pi params alone (the twin Q
+        # trees are the bulk of the bytes), and nothing during warmup.
+        weights = None if warmup else {
+            "pi": jax.tree_util.tree_map(np.asarray, self._state["params"]["pi"])
+        }
         results = ray_tpu.get(
             [
                 r.collect.remote(weights, self.config.rollout_length, warmup)
