@@ -1276,7 +1276,7 @@ class Runtime:
             # the io-loop tick tops the pool back up to this target while
             # the driver waits on results — converting barrier idle time
             # into worker boots).
-            self._prestart_target = min(self._prestart_target + 1, 256)
+            self._prestart_target = min(self._prestart_target + 1, 64)
             self._prestart_miss_t = time.monotonic()
         return self._spawn_worker(node_id, env_key, renv)
 
