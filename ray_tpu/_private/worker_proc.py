@@ -658,6 +658,11 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
         def _tr(label):
             pass
     _tr("start")
+    if os.environ.get("RAY_TPU_FAULTHANDLER"):
+        import faulthandler
+        import signal as _sig
+
+        faulthandler.register(_sig.SIGUSR1, all_threads=True)
     if os.environ.get("RAY_TPU_PDEATHSIG"):
         # Daemon-owned worker: die when the node daemon dies, even on
         # SIGKILL of the daemon (node-failure semantics — a raylet's

@@ -53,21 +53,63 @@ class _ReplicaSet:
                 rid: refs for rid, refs in self.inflight.items() if rid in live
             }
 
+    _STALE_ENTRY_S = 120.0
+
+    @staticmethod
+    def _ref_state(ref):
+        """Local-only readiness: True = definitively pending, False =
+        definitively done, None = unknowable here.  The caller's direct
+        transport knows the state of its own calls without ANY head
+        traffic; a head wait here (the old implementation) put a hidden
+        owner round trip on every assignment AND stalled the whole data
+        plane for the reconnect window during a head outage — the proxy
+        must keep serving while the head is down."""
+        from ray_tpu._private.worker_proc import get_worker_runtime
+
+        wr = get_worker_runtime()
+        if wr is not None:
+            if wr.direct is not None:
+                r = wr.direct.ready_local(ref.id)
+                if r is not None:
+                    return not r  # owned: definitive either way
+            # Relayed ref (e.g. the first calls before the direct route
+            # resolved): the process still KNOWS completion once anything
+            # here resolved the value (get_value marks known_materialized).
+            if wr.known_materialized(ref.id):
+                return False
+            return None
+        # Driver-side handle: the in-process runtime's store answers
+        # readiness as a local dict check.
+        from ray_tpu._private import runtime as rt_mod
+
+        if rt_mod.is_initialized():
+            return not rt_mod.get_runtime().store.is_ready(ref.id)
+        return None
+
     def _purge_locked(self, rid: str):
         entries = self.inflight.get(rid)
         if not entries:
             return
-        refs = [e for e in entries if not isinstance(e, _StreamToken)]
-        tokens = [e for e in entries if isinstance(e, _StreamToken) and not e.done]
-        if refs:
-            done, pending = ray_tpu.wait(refs, num_returns=len(refs), timeout=0)
-        else:
-            pending = []
-        self.inflight[rid] = pending + tokens
+        now = time.monotonic()
+        keep = []
+        for e, ts in entries:
+            if isinstance(e, _StreamToken):
+                if not e.done:
+                    keep.append((e, ts))
+                continue
+            state = self._ref_state(e)
+            if state is True:
+                keep.append((e, ts))  # definitively pending: NEVER aged —
+                # a 5-minute inference must keep counting against capacity
+            elif state is None and now - ts < self._STALE_ENTRY_S:
+                # Unknowable here (relayed, never resolved locally): age
+                # out so it can't count against capacity forever.
+                keep.append((e, ts))
+        self.inflight[rid] = keep
 
     def record(self, rid: str, entry: Any) -> None:
         with self._lock:
-            self.inflight.setdefault(rid, []).append(entry)
+            self.inflight.setdefault(rid, []).append((entry, time.monotonic()))
 
     def has_replicas(self) -> bool:
         with self._lock:

@@ -417,3 +417,80 @@ def test_attached_driver_streams_worker_logs(head, capsys):
             break
         time.sleep(0.2)
     assert "hello-from-remote-worker" in out
+
+
+def test_serve_deployment_survives_head_kill9(tmp_path):
+    """A serve deployment keeps answering HTTP requests THROUGH a kill -9
+    of the head (proxy->replica calls ride the direct peer transport,
+    which never touches the head), and the restarted head adopts the
+    controller/replicas so the deployment stays managed (VERDICT r4
+    item 4 'done' criterion)."""
+    import json as _json
+    import urllib.request
+
+    proc, head_json = launch_head_subprocess(
+        str(tmp_path), num_cpus=4, session="hserve"
+    )
+    try:
+        ray_tpu.init(address=head_json)
+        from ray_tpu import serve
+
+        serve.start(http_options={"host": "127.0.0.1", "port": 0})
+
+        @serve.deployment(name="durable", num_replicas=2,
+                          ray_actor_options={"max_restarts": 3})
+        def durable(body=None):
+            return {"ok": True}
+
+        serve.run(durable.bind())
+        addr = serve.get_http_address()
+
+        def hit(timeout=30):
+            req = urllib.request.Request(
+                addr + "/durable", data=b"{}", method="POST"
+            )
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return _json.loads(resp.read())
+
+        # Warm until BOTH replicas' direct routes are resolved: only
+        # resolved routes can serve through an outage (an unresolved
+        # actor needs the control plane, here as in the reference).
+        for _ in range(8):
+            assert hit()["result"] == {"ok": True}
+
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10)
+        proc2 = None
+        try:
+            # DURING the outage: the data plane stays up — zero failures.
+            for _ in range(5):
+                assert hit()["result"] == {"ok": True}
+
+            proc2, _ = launch_head_subprocess(
+                str(tmp_path), num_cpus=4, session="hserve"
+            )
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                try:
+                    assert hit(timeout=10)["result"] == {"ok": True}
+                    break
+                except Exception:
+                    time.sleep(0.5)
+            # steady state after adoption
+            for _ in range(5):
+                assert hit()["result"] == {"ok": True}
+        finally:
+            try:
+                serve.shutdown()
+            except Exception:
+                pass
+            ray_tpu.shutdown()
+            if proc2 is not None:
+                proc2.terminate()
+                try:
+                    proc2.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc2.kill()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
