@@ -494,3 +494,55 @@ def test_serve_deployment_survives_head_kill9(tmp_path):
     finally:
         if proc.poll() is None:
             proc.kill()
+
+
+def test_reconnect_recover_restores_unsent_backlog_tail():
+    """WorkerRuntime.reconnect_recover: a second bounce mid-flush must
+    put the UNSENT backlog tail back (ownership state survives repeated
+    bounces) and report failure so the caller retries."""
+    from ray_tpu._private.worker_proc import WorkerRuntime
+
+    class FakeConn:
+        def __init__(self, fail_after=None):
+            self.sent = []
+            self.fail_after = fail_after
+
+        def send(self, msg):
+            if self.fail_after is not None and len(self.sent) >= self.fail_after:
+                raise OSError("bounced again")
+            self.sent.append(msg)
+
+        def close(self):
+            pass
+
+    import threading
+
+    rt = WorkerRuntime.__new__(WorkerRuntime)  # skip store setup
+    rt.conn = FakeConn()
+    rt.conn_lock = threading.Lock()
+    rt._backlog_lock = threading.Lock()
+    rt._oneway_backlog = [("refop", "add", "o1"), ("seal_ow", "o2", 1, []),
+                          ("refop", "del", "o3")]
+    rt._backlog_dropped = 5
+    rt._pending = {}
+    rt.direct = None
+    rt._subs = {}
+    rt._subs_lock = threading.Lock()
+
+    # Second bounce after the hello + first backlog entry:
+    flaky = FakeConn(fail_after=2)  # hello + 1 backlog msg succeed
+    ok = rt.reconnect_recover(flaky, lambda c: c.send(("ready",)))
+    assert not ok
+    # hello + first backlog entry went out; the unsent TAIL was restored.
+    assert flaky.sent[0] == ("ready",)
+    assert rt._oneway_backlog == [("seal_ow", "o2", 1, []),
+                                  ("refop", "del", "o3")]
+
+    # A clean retry drains everything and resets the overflow warning.
+    good = FakeConn()
+    ok = rt.reconnect_recover(good, lambda c: c.send(("ready",)))
+    assert ok
+    assert good.sent == [("ready",), ("seal_ow", "o2", 1, []),
+                         ("refop", "del", "o3")]
+    assert rt._oneway_backlog == []
+    assert rt._backlog_dropped == 0

@@ -209,3 +209,39 @@ def test_broadcast_staggers_pulls_across_sources(ray_start_regular):
     assert len(locs) == 8, locs
     for nid in nids:
         rt.remove_node(nid)
+
+
+def test_admit_pull_caps_grants_and_rotates(ray_start_regular):
+    """_admit_pull: grants are capped at the source count; replies rotate
+    the endpoint list; object_copied frees a grant (unit-level checks of
+    the staggered-broadcast admission)."""
+    from ray_tpu._private.runtime import _PARKED, get_runtime
+
+    rt = get_runtime()
+    eps = [("h1", 1), ("h2", 2)]
+    oid = "o:unit-admit:0"
+    r1 = rt._admit_pull("w1", 1, oid, list(eps))
+    r2 = rt._admit_pull("w2", 2, oid, list(eps))
+    assert r1[0] == "pull" and r2[0] == "pull"
+    assert r1[1] != r2[1], "endpoint rotation must spread pullers"
+    # Third puller vs two sources: parked.
+    r3 = rt._admit_pull("w3", 3, oid, list(eps))
+    assert r3 is _PARKED
+    assert rt.metrics["pull_parks"] >= 1
+    # A copy lands: one grant freed -> next admission succeeds.
+    with rt.lock:
+        grants = rt._pull_grants.get(oid)
+        assert grants and len(grants) == 2
+        grants.pop()
+    r4 = rt._admit_pull("w4", 4, oid, list(eps))
+    assert r4[0] == "pull"
+    # Consume w3's park deterministically (its 5s fallback timer must not
+    # fire into a torn-down runtime after the fixture exits): make the
+    # object resolvable, then publish the wake-up the park waits on.
+    rt.store.put_error(oid, RuntimeError("unit-test cleanup"))
+    deferred = rt.pubsub.publish("object_copied", oid, oid)
+    for cb in deferred:
+        cb(oid)
+    time.sleep(0.2)  # the deferred serve replies (to a nonexistent wid)
+    with rt.lock:
+        rt._pull_grants.pop(oid, None)
