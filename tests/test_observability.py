@@ -462,3 +462,36 @@ def test_tracing_spans_chain_across_processes(monkeypatch):
     finally:
         tracing.disable_tracing()  # module global: no leak into later tests
         ray_tpu.shutdown()
+
+
+def test_spans_appear_in_chrome_timeline(monkeypatch):
+    """Enabled tracing feeds the chrome-trace timeline export alongside
+    task rows (the `ray_tpu timeline` surface)."""
+    import time
+
+    monkeypatch.setenv("RAY_TPU_TRACE", "1")
+    import ray_tpu
+    from ray_tpu.util import tracing
+
+    tracing.enable_tracing()
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    try:
+        @ray_tpu.remote
+        def traced():
+            return 1
+
+        assert ray_tpu.get(traced.remote(), timeout=60) == 1
+        from ray_tpu.dashboard import timeline
+
+        deadline = time.time() + 15
+        names = []
+        while time.time() < deadline:
+            names = [e["name"] for e in timeline()]
+            if any(n.startswith("run::traced") for n in names):
+                break
+            time.sleep(0.3)
+        assert any(n.startswith("submit::traced") for n in names), names[:20]
+        assert any(n.startswith("run::traced") for n in names), names[:20]
+    finally:
+        tracing.disable_tracing()
+        ray_tpu.shutdown()
