@@ -184,6 +184,25 @@ _DEFS: Dict[str, tuple] = {
         "actual hog — prestarted idle workers are never bigger) or "
         "'newest' spawned (ray: worker_killing_policy.h)",
     ),
+    "fault_spec": (
+        "", str,
+        "deterministic fault-injection plan (faults.py grammar: "
+        "'<point>:<action>[@sel,...];...'); empty = injection disabled "
+        "(zero-overhead fast path; ray: RayConfig testing knobs like "
+        "testing_asio_delay_us)",
+    ),
+    "fault_seed": (
+        0, int,
+        "seed for the fault plan's prob= selectors — the same spec+seed "
+        "replays the same injection schedule (print it on failure, rerun "
+        "to reproduce)",
+    ),
+    "zygote_fork_grace_s": (
+        20.0, float,
+        "how long a zygote-forked worker handle with no pid attribution "
+        "yet reads alive before the reaper declares the fork lost and "
+        "reschedules its lease",
+    ),
     "actor_adopt_grace_s": (
         5.0, float,
         "after a head restart, how long restored detached/named actors "

@@ -23,6 +23,8 @@ import pickle
 import sys
 from typing import Any, Dict, Optional
 
+from ray_tpu._private import faults
+
 # Version of the snapshot DOCUMENT (not the wire protocol): bumped when
 # the snapshot's shape changes incompatibly.  Restore-time mismatch is
 # LOUD — a silent clean boot on a version bump would quietly drop
@@ -96,6 +98,11 @@ class FileSnapshotStorage(SnapshotStorage):
         self.path = path
 
     def save(self, session: str, snap: Dict[str, Any]) -> None:
+        if faults.ENABLED:
+            # error -> this tick is skipped (the snapshot loop is
+            # best-effort); crash -> head death mid-persist, which the
+            # atomic tmp+rename below must survive.
+            faults.point("gcs.save", key=session)
         tmp = self.path + ".tmp"
         with open(tmp, "wb") as f:
             pickle.dump(_stamp(snap), f)
@@ -147,6 +154,8 @@ class SqliteSnapshotStorage(SnapshotStorage):
     def save(self, session: str, snap: Dict[str, Any]) -> None:
         import time
 
+        if faults.ENABLED:
+            faults.point("gcs.save", key=session)
         blob = pickle.dumps(_stamp(snap))
         with self._lock:
             self._conn.execute(

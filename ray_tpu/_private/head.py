@@ -92,8 +92,10 @@ def main() -> None:
         except (OSError, ValueError, KeyError):
             pass
 
+    from ray_tpu._private import faults
     from ray_tpu._private.runtime import Runtime
 
+    faults.set_process_tag("head")
     rt = Runtime(
         num_cpus=cfg.get("num_cpus"),
         resources=cfg.get("resources"),

@@ -96,6 +96,9 @@ def main() -> None:
     cfg = json.loads(os.environ["RAY_TPU_NODE_CONFIG"])
     node_id = cfg["node_id"]
     session = cfg["session"]
+    from ray_tpu._private import faults
+
+    faults.set_process_tag(f"daemon:{node_id}")
 
     # The node object store: an isolated per-node directory (distinct even
     # when several daemons share one machine in tests — no cross-node path

@@ -31,6 +31,7 @@ import threading
 from typing import Callable, Iterable, List, Optional, Tuple
 
 from ray_tpu._private import config as _config
+from ray_tpu._private import faults
 
 
 def _chunk_size() -> int:
@@ -50,6 +51,10 @@ def stream_object(conn, read_raw: Callable[[str], Optional[tuple]], oid: str) ->
     tmpfs pages — the fallback IS the fast path.)
     """
     try:
+        # error -> the except below: the peer sees EOF mid-transfer and
+        # retries another endpoint; crash kills the serving daemon here.
+        if faults.ENABLED:
+            faults.point("object.serve", key=oid)
         raw = read_raw(oid)
         if raw is None:
             conn.send(("missing",))
@@ -198,6 +203,8 @@ def _raw_chunks(conn, total: int, deadline: float):
         mv = memoryview(buf)
         got = 0
         while got < total:
+            if faults.ENABLED:
+                faults.point("object.chunk")  # error -> pull fails mid-body
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 raise OSError("object transfer timed out")
@@ -227,6 +234,8 @@ def _recv_body_into(conn, total: int, deadline: float, view) -> None:
     try:
         got = 0
         while got < total:
+            if faults.ENABLED:
+                faults.point("object.chunk")  # error -> pull fails mid-body
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 raise OSError("object transfer timed out")
@@ -268,6 +277,10 @@ def fetch_object(
     if timeout is None:
         timeout = _config.get("object_transfer_timeout_s")
     deadline = time.monotonic() + timeout
+    if faults.ENABLED:
+        # error -> OSError out of the fetch: pull_from_any tries the next
+        # copy, or the consumer falls to lineage reconstruction.
+        faults.point("object.fetch", key=oid)
     conn = _connect_with_deadline(endpoint, authkey, timeout)
     try:
         conn.send(("object_fetch", oid))

@@ -47,6 +47,8 @@ import queue
 import threading
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from ray_tpu._private import faults
+
 
 class PeerServer:
     """In-worker listener accepting direct task pushes from peer workers.
@@ -170,6 +172,10 @@ class PeerConn:
         from ray_tpu._private import config as _config
 
         self.endpoint = tuple(endpoint)
+        if faults.ENABLED:
+            # error -> OSError out of the constructor: the route falls back
+            # exactly as for a real connect failure (relay / retry).
+            faults.point("peer.connect", key=f"{endpoint[0]}:{endpoint[1]}")
         self.conn = _connect_with_deadline(
             self.endpoint, authkey, _config.get("object_transfer_timeout_s")
         )
@@ -186,6 +192,10 @@ class PeerConn:
         if self.dead:
             return False
         try:
+            if faults.ENABLED and faults.point(
+                "peer.send", key=msg[0] if msg else None
+            ) == "drop":
+                return True  # lost on the wire: the caller believes it sent
             with self.send_lock:
                 self.conn.send(msg)
             return True
@@ -871,11 +881,30 @@ class DirectTransport:
                     r.buffered = []
                     for spec in to_send:
                         self.inflight[spec.task_id] = (aid, spec, conn, None)
+                    sent = 0
                     for spec in to_send:
-                        if not conn.send(("pcall", spec)):
+                        try:
+                            if faults.ENABLED:
+                                faults.point("peer.redrive", key=spec.task_id)
+                            ok = conn.send(("pcall", spec))
+                        except faults.InjectedFault:
+                            ok = False
+                        if not ok:
                             send_failed = True
                             break
+                        sent += 1
                         self.calls_sent += 1
+                    if send_failed:
+                        # The flush broke before to_send[sent:] hit the
+                        # socket: those calls provably never ran.  Un-bind
+                        # them from the conn and re-buffer IN ORDER,
+                        # uncharged — the death path below charges
+                        # spec.attempt only for the sent prefix it
+                        # re-drives (same never-ran un-charge
+                        # _resend_actor applies).
+                        for spec in to_send[sent:]:
+                            self.inflight[spec.task_id] = (aid, spec, None, None)
+                        r.buffered[:0] = to_send[sent:]
                     r.conn = conn
                     r.state = "direct"
                     r.recover_started = False

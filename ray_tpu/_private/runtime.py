@@ -31,6 +31,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 import cloudpickle
 
+from ray_tpu._private import faults
 from ray_tpu._private import ids, serialization as ser
 from ray_tpu._private.gcs import (
     ALIVE,
@@ -187,14 +188,20 @@ class _ZygoteProcHandle:
 
     def is_alive(self):
         if self._pid is None:
-            # Fork request in flight: while the zygote itself lives the
-            # fork will land (pid attribution may lag under load — a
-            # fixed grace here once mis-declared slow-boot storms dead,
-            # cascading into retry storms); a dead zygote means the
-            # request is lost after a short grace.
-            if self._zygote is not None and self._zygote.poll() is None:
-                return True
-            return time.monotonic() - self._created < 20.0
+            # Fork request in flight: the grace applies even while the
+            # zygote itself lives — a lost ("forked", ...) reply (zygote
+            # conn broke so _zygote_loop exited, or the frame was dropped)
+            # leaves no worker process behind this handle, and an
+            # unconditional True would wedge its lease as "starting"
+            # forever.  The window is generous vs the ~2ms fork + serial
+            # attribution so slow-boot storms are not mis-declared dead
+            # (the old cascade this guard once caused).
+            from ray_tpu._private import config as _config
+
+            return (
+                time.monotonic() - self._created
+                < _config.get("zygote_fork_grace_s")
+            )
         try:
             os.kill(self._pid, 0)
             return True
@@ -375,7 +382,13 @@ class ActorRuntime:
         self.info = info
         self.worker_id: Optional[str] = None
         self.queued: deque = deque()  # TaskSpecs waiting for ALIVE
-        self.in_flight: Set[str] = set()  # task_ids sent to the worker
+        # task_ids sent to the worker, as an insertion-ordered dict-set:
+        # requeue-on-death iterates this to rebuild per-caller call order
+        # across a restart, so push order must be recoverable (a plain set
+        # iterates in hash order — the direct path's ActorRoute buffer
+        # keeps order, and this relayed twin must match; ray:
+        # sequential_actor_submit_queue.h orders by sequence number).
+        self.in_flight: Dict[str, None] = {}
         self.expected_death = False
         self.no_restart = False
         self.placement = None
@@ -395,6 +408,9 @@ class Runtime:
         listen_port: int = 0,
         authkey: Optional[bytes] = None,
     ):
+        # _system_config overrides exported their env form by now: pick up
+        # a fault plan configured via ray_tpu.init(_system_config=...).
+        faults.refresh_from_env()
         self.session_name = session_name or f"{os.getpid()}-{os.urandom(3).hex()}"
         self.namespace = namespace
         self.state = GlobalState()
@@ -1293,6 +1309,12 @@ class Runtime:
             h.pending_sends.append(msg)
         else:
             try:
+                # error -> the existing OSError path (delivery lost, like a
+                # conn that broke mid-send); drop -> same, minus the raise.
+                if faults.ENABLED and faults.point(
+                    "head.send", key=msg[0] if msg else None
+                ) == "drop":
+                    return
                 h.conn.send(msg)
             except OSError:
                 pass
@@ -2108,14 +2130,29 @@ class Runtime:
         deferred=True)."""
         if not self.remote_subs:
             return
+        if faults.ENABLED:
+            try:
+                if faults.point("pubsub.publish", key=str(channel)) == "drop":
+                    return  # publish lost before fan-out
+            except faults.InjectedFault:
+                return  # same observable outcome as drop for a publish
         with self.lock:
             entries = self.remote_subs.get((channel, key))
             wildcard = self.remote_subs.get((channel, "*"))
             targets = dict(wildcard or ())
             if entries:
                 targets.update(entries)
+            # once-flagged in EITHER registration (the merge above lets an
+            # exact persistent sub shadow a wildcard once flag).
+            once_wids = {
+                wid
+                for d in (entries, wildcard)
+                if d
+                for wid, once in d.items()
+                if once
+            }
         delivered = []
-        for wid, once in targets.items():
+        for wid, _once in targets.items():
             try:
                 self._pub_queue.put_nowait((wid, ("pub", channel, key, args)))
             except Exception:
@@ -2123,20 +2160,24 @@ class Runtime:
                 # once-sub is NOT consumed — a one-shot event must not
                 # vanish because a log flood filled the queue.
                 continue
-            if once:
-                delivered.append(wid)
-        if delivered:
+            delivered.append(wid)
+        if once_wids.intersection(delivered):
             with self.lock:
-                entries = self.remote_subs.get((channel, key))
-                if entries:
+                # Consume delivered once-entries from BOTH the exact-key
+                # and the wildcard registration (a once+wildcard sub must
+                # not fire on every later publish forever), and ONLY
+                # still-once entries: a re-subscribe (or persistent
+                # upgrade) that landed during the send window must
+                # survive this delivery.
+                for ck in ((channel, key), (channel, "*")):
+                    entries = self.remote_subs.get(ck)
+                    if not entries:
+                        continue
                     for wid in delivered:
-                        # Consume ONLY a still-once entry: a re-subscribe
-                        # (or persistent upgrade) that landed during the
-                        # send window must survive this delivery.
                         if entries.get(wid) is True:
                             entries.pop(wid, None)
                     if not entries:
-                        self.remote_subs.pop((channel, key), None)
+                        self.remote_subs.pop(ck, None)
 
     def _pub_sender_loop(self) -> None:
         while not getattr(self, "_shutdown", False):
@@ -2882,7 +2923,7 @@ class Runtime:
         rec.start_time = time.time()
         rec.worker_id = h.worker_id
         rec.node_id = h.node_id
-        ar.in_flight.add(rec.spec.task_id)
+        ar.in_flight[rec.spec.task_id] = None
         blob = None
         if rec.spec.fn_id not in h.known_fns:
             blob = self.state.get_function(rec.spec.fn_id)
@@ -3078,7 +3119,7 @@ class Runtime:
         if spec.actor_id is not None and not spec.is_actor_creation:
             ar = self.actors.get(spec.actor_id)
             if ar:
-                ar.in_flight.discard(task_id)
+                ar.in_flight.pop(task_id, None)
         elif not spec.is_actor_creation:
             self._release_for(rec)
             if h is not None and h.state == "busy":
@@ -3410,6 +3451,9 @@ class Runtime:
         # In-flight relayed calls: retry-budgeted ones re-queue onto the
         # restarted instance (same semantics as the direct path's recovery
         # re-drive; ray: max_task_retries); the rest fail ActorDiedError.
+        # in_flight is insertion-ordered (push order == per-caller submit
+        # order), so `requeue` comes out in submission order and the
+        # extendleft below really does prepend "in order".
         requeue: List[str] = []
         for tid in list(ar.in_flight):
             rec = self.tasks.get(tid)

@@ -751,8 +751,13 @@ class OwnerStore:
             if size is None:
                 # Freed (remove_ref -> _free) between the unlocked read above
                 # and here: recording _spilled would resurrect a dead object
-                # and leak the stored image.
-                self._spill_storage.delete(locator)
+                # and leak the stored image.  Queue the delete for the
+                # reclaim thread — on a URI/fsspec backend it is a blocking
+                # network call, and running it here would stall every store
+                # operation behind this lock (the hazard _free's own
+                # _spill_deletes queue exists to avoid).
+                self._spill_deletes.append(locator)
+                self._reclaim_event.set()
                 return None
             self._spilled[object_id] = locator
             self._shm_bytes -= size

@@ -32,6 +32,16 @@ import pickle
 import struct
 from typing import Any, Dict, Optional, Tuple
 
+from ray_tpu._private import faults
+
+
+def _kind(obj: Any) -> Optional[str]:
+    """Control-message kind for fault `match=` scoping (None for payload
+    frames) — only computed when injection is enabled."""
+    if isinstance(obj, tuple) and obj and isinstance(obj[0], str):
+        return obj[0]
+    return None
+
 MAGIC = b"RT"
 PROTOCOL_VERSION = 1
 _HEADER = struct.pack("<2sH", MAGIC, PROTOCOL_VERSION)
@@ -172,11 +182,17 @@ class TypedConn:
         self._send_lock = threading.Lock()
 
     def send(self, obj: Any) -> None:
+        if faults.ENABLED and faults.point("wire.send", key=_kind(obj)) == "drop":
+            return  # frame lost on the wire; the sender believes it went out
         with self._send_lock:
             self._c.send_bytes(encode(obj))
 
     def recv(self) -> Any:
-        return decode(self._c.recv_bytes())
+        while True:
+            obj = decode(self._c.recv_bytes())
+            if faults.ENABLED and faults.point("wire.recv", key=_kind(obj)) == "drop":
+                continue  # frame lost before delivery; wait for the next
+            return obj
 
     # raw passthrough (object-transfer body, recv_into via fileno)
     def send_bytes(self, b) -> None:

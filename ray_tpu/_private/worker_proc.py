@@ -79,6 +79,10 @@ class WorkerRuntime:
         self._oneway_backlog: list = []
         self._backlog_lock = threading.Lock()
         self._backlog_dropped = 0
+        # Bumped by every SUCCESSFUL reconnect_recover: request() retries
+        # use it to tell a healed-then-rebroken conn (fresh incident,
+        # fresh window) from one continuous outage (budget runs out).
+        self._conn_generation = 0
         # Attached drivers adopt the head's window (their own env may not
         # carry the knob); None = read the local config.
         self.reconnect_window_override: Optional[float] = None
@@ -114,22 +118,38 @@ class WorkerRuntime:
 
         deadline = None
         last_err = None
+        gen_at_err = None
         while True:
             try:
                 return self._request_once(op, payload, timeout)
-            except ConnectionError:
+            except ConnectionError as e:
                 window = self._reconnect_window()
                 if window <= 0:
-                    raise
+                    raise  # classic mode: conn loss is final
                 now = _time.monotonic()
-                # A fresh INCIDENT (no failure within the last window)
-                # gets a fresh budget: a request that rode out one bounce
-                # hours ago must not be left with zero window at the next.
-                if last_err is None or now - last_err > window + 10.0:
+                # A fresh INCIDENT gets a fresh budget.  Two signals mark
+                # one: a successful reconnect happened since the last
+                # failure (the conn GENERATION moved — each head bounce
+                # that heals must not eat into the next bounce's window;
+                # a long-lived parked get that rides bounce after bounce
+                # spaced under the window would otherwise accumulate into
+                # a spurious give-up), or the last failure is simply old.
+                gen = getattr(self, "_conn_generation", 0)
+                if (
+                    last_err is None
+                    or gen != gen_at_err
+                    or now - last_err > window + 10.0
+                ):
                     deadline = now + window + 10.0
+                gen_at_err = gen
                 last_err = now
                 if now > deadline:
-                    raise
+                    # Say WHICH budget lapsed — "connection reset" alone
+                    # reads like a missing retry, not an exhausted one.
+                    raise ConnectionError(
+                        f"request {op!r} still failing after riding the "
+                        f"{window:.0f}s reconnect window: {e}"
+                    ) from e
                 _time.sleep(0.2)  # recv thread is swapping the conn
 
     def _request_once(self, op: str, payload: Any, timeout: Optional[float]) -> Any:
@@ -217,10 +237,19 @@ class WorkerRuntime:
     def _on_pub(self, channel: str, key, args: tuple) -> None:
         with self._subs_lock:
             exact = self._subs.get((channel, key), [])
-            fired = list(exact) + list(self._subs.get((channel, "*"), ()))
-            exact[:] = [e for e in exact if not e[1]]  # consume once-subs
+            # key == "*" would alias `wild` to `exact` (double-fire +
+            # double-consume); pub frames carry concrete keys, but guard.
+            wild = self._subs.get((channel, "*"), []) if key != "*" else []
+            fired = list(exact) + list(wild)
+            # Consume once-subs from BOTH registries: a once+wildcard sub
+            # fired here and must not fire on every later key forever.
+            exact[:] = [e for e in exact if not e[1]]
             if not exact:
                 self._subs.pop((channel, key), None)
+            if key != "*":
+                wild[:] = [e for e in wild if not e[1]]
+                if not wild:
+                    self._subs.pop((channel, "*"), None)
         for cb, _once in fired:
             try:
                 cb(key, *args)
@@ -260,6 +289,8 @@ class WorkerRuntime:
                     self._oneway_backlog[:0] = backlog
                 return False
             self._backlog_dropped = 0  # fresh overflow warning per burst
+            # The swap succeeded: failures after this are a NEW incident.
+            self._conn_generation = getattr(self, "_conn_generation", 0) + 1
         err = ConnectionError("head connection was reset (head restart)")
         for req_id in list(self._pending):
             q = self._pending.pop(req_id, None)
@@ -568,6 +599,15 @@ def _execute(rt: WorkerRuntime, spec: TaskSpec, blob: Optional[bytes]):
     try:
         if spec.is_actor_creation:
             cls = rt.resolve_function(spec.fn_id, blob)
+            from ray_tpu._private import faults
+
+            if faults.ENABLED:
+                # Scope chaos clauses by the hosted actor class
+                # (proc=actor:<Class>) — set BEFORE __init__ so creation
+                # is inside the scope too.
+                faults.set_process_tag(
+                    f"worker:{rt.worker_id}:actor:{cls.__name__}"
+                )
             args, kwargs = _resolve_args(rt, spec.args_blob)
             rt.current_actor = cls(*args, **kwargs)
             rt.current_actor_id = spec.actor_id

@@ -50,6 +50,16 @@ def _child_entry(overrides: dict, out_path: str, err_path: str) -> None:
     if os.environ.get("RAY_TPU_PDEATHSIG") or overrides.get("RAY_TPU_PDEATHSIG"):
         _arm_pdeathsig()
     os.environ.update({k: str(v) for k, v in overrides.items()})
+    # The fork inherited the ZYGOTE's fault-plane state (its process tag
+    # and visit counters): re-derive the worker identity and restart the
+    # clause counters so proc=worker clauses scope correctly and each
+    # worker's injection schedule starts from zero.
+    from ray_tpu._private import faults
+
+    faults.set_process_tag(
+        "worker:" + os.environ.get("RAY_TPU_WORKER_ID", "?")
+    )
+    faults.refresh_from_env()
     try:
         out_fd = os.open(out_path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
         err_fd = os.open(err_path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
@@ -100,7 +110,10 @@ def main() -> None:
     import ray_tpu._private.store  # noqa: F401
     import ray_tpu._private.worker_proc  # noqa: F401
     import ray_tpu.exceptions  # noqa: F401
+    from ray_tpu._private import faults
     from ray_tpu._private import wire
+
+    faults.set_process_tag("zygote")
 
     if inherited_fd is not None:
         from multiprocessing.connection import Connection
@@ -161,7 +174,15 @@ def main() -> None:
             os._exit(0)  # unreachable; _child_entry never returns
         children[pid] = wid
         try:
-            conn.send(("forked", wid, pid))
+            # drop -> the ("forked", ...) reply is lost while both zygote
+            # and child live: the owner's pid-less handle must reap via
+            # its grace window.  error (an OSError) -> zygote exit, the
+            # conn-break twin of the same scenario.
+            if not (
+                faults.ENABLED
+                and faults.point("zygote.forked", key=wid) == "drop"
+            ):
+                conn.send(("forked", wid, pid))
         except OSError:
             os._exit(0)
 

@@ -1,0 +1,585 @@
+"""Chaos soak: a schedule-driven, minutes-scale fault sweep with a seed.
+
+ray: release/nightly_tests/setup_chaos.py runs Ray's long-running chaos
+suites with a NodeKillerActor; the CI-scale tests/test_chaos.py here kills
+at wall-clock random and cannot replay a failure.  This harness drives the
+deterministic fault plane (ray_tpu/_private/faults.py) instead: every kill
+and every delay comes from a named, seeded RAY_TPU_FAULT_SPEC clause, so a
+failing run prints its seed and the exact spec to rerun.
+
+The soak boots a SPLIT cluster (standalone head subprocess + one external
+node daemon) and keeps three workloads running while the spec fires:
+
+  * task chains (produce -> fold, lineage + retries) — every round's
+    results must be exactly right;
+  * a restartable actor under max_task_retries — every reply must match;
+  * serve HTTP traffic against a 2-replica deployment — every logical
+    request must eventually succeed.
+
+The default schedule (seeded, per-process deterministic):
+  * workers crash at their result-send hazard (wire.send of done/pdone
+    frames, every N-th matching frame) — the juiciest window: did the
+    result land before the death?;
+  * the node daemon crashes at its t=18s (store loss -> lineage
+    reconstruction) and is relaunched as a fresh node;
+  * the head SIGKILLs itself mid-snapshot at its t=30s and is relaunched
+    into the same session (restore + live-worker adoption);
+  * a small probabilistic delay on every control frame keeps ordering
+    races warm.
+
+Afterwards the harness drains to a quiescent state (fault spec stripped
+from relaunches), runs a clean verification round, and checks the ledger:
+no lost results, no reply mismatches, per-task execution counts within
+retry budgets, zero lost serve requests.  The report lands in
+CHAOS_r01.json (or --out).
+
+Usage:
+    python scripts/chaos_soak.py --duration 75 --seed 7 \
+        [--spec '<fault spec>'] [--out CHAOS_r01.json] [--no-serve]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+import numpy as np  # noqa: E402
+
+import ray_tpu  # noqa: E402
+
+# Per-process deterministic kill schedule + latency noise:
+#   * match=^done (anchored) kills RELAYED executors — chain task workers
+#     and the soak actor's worker — at their result-send hazard, but not
+#     direct-path repliers (pdone does not match), so the serve data
+#     plane (replicas) and control actors (proxy/controller) ride through
+#     the head bounces on their open peer conns.  (Killing replicas near
+#     a head bounce is expressible — wire.send:crash@proc=actor:Replica,
+#     match=pdone — but exposes a known gap: anonymous actor records die
+#     with the head, so a replica that dies before re-registration cannot
+#     be re-resolved.  See ROADMAP.)
+#   * only the FIRST daemon (soak-d1) dies — its store loss must heal via
+#     lineage before the head kill lands at t=30;
+#   * each head incarnation SIGKILLs itself mid-snapshot at its t=30.
+DEFAULT_SPEC = (
+    "wire.send:crash@proc=worker,match=^done,after=40,every=53,times=2;"
+    "wire.send:delay=0.002@prob=0.02;"
+    "wire.send:crash@proc=daemon:soak-d1,at=18,times=1;"
+    "gcs.save:crash@proc=head,at=30,times=1"
+)
+
+TASK_RETRIES = 25
+ACTOR_RETRIES = 25
+CHAIN_WIDTH = 8
+# Driver-level re-drives per logical operation.  A head kill erases the
+# control-plane record of COMPLETED-but-unfetched results that lived only
+# in the head process; the supported recovery envelope is snapshot
+# re-drive (in-flight tasks) + surviving node copies + actor adoption.  A
+# logical op that still cannot produce its (correct) answer after this
+# many fresh submissions counts as LOST and fails the soak — and every
+# re-drive is counted in the report, so the at-most-once windows are
+# measured, not papered over.
+REDRIVES = 3
+# shm-sized payloads (>= max_direct_call_object_size): sealed segments
+# live on tmpfs node stores and survive head bounces; inline results die
+# with the head process.
+ARR = 1 << 14
+
+
+def _append(path: str, line: str) -> None:
+    # O_APPEND single-line writes are atomic across the node's processes.
+    with open(path, "a") as f:
+        f.write(line + "\n")
+
+
+@ray_tpu.remote(max_retries=TASK_RETRIES)
+def produce(i, r, log_path):
+    _append(log_path, f"produce:{r}:{i}")
+    return np.full((ARR,), i, dtype=np.int64)
+
+
+@ray_tpu.remote(max_retries=TASK_RETRIES)
+def fold(a, j, r, log_path):
+    _append(log_path, f"fold:{r}:{j}")
+    return np.full((ARR,), int(a.sum()) + j, dtype=np.int64)
+
+
+@ray_tpu.remote(max_restarts=100, max_task_retries=ACTOR_RETRIES)
+class SoakActor:
+    def __init__(self, log_path):
+        self.log_path = log_path
+
+    def echo(self, i):
+        _append(self.log_path, f"actor:{i}")
+        return i
+
+
+def _launch_daemon(head_json: str, node_id: str, num_cpus: int):
+    with open(head_json) as f:
+        info = json.load(f)
+    env = os.environ.copy()
+    env.update(
+        {
+            "RAY_TPU_DRIVER_HOST": info["host"],
+            "RAY_TPU_DRIVER_PORT": str(info["port"]),
+            "RAY_TPU_AUTHKEY": info["authkey"],
+            "RAY_TPU_NODE_CONFIG": json.dumps(
+                {
+                    "node_id": node_id,
+                    "session": info["session"],
+                    "num_cpus": num_cpus,
+                    "resources": {},
+                    "labels": {},
+                }
+            ),
+            "PYTHONPATH": os.pathsep.join(dict.fromkeys([REPO_ROOT] + sys.path)),
+        }
+    )
+    return subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.node_daemon"],
+        env=env,
+        close_fds=True,
+    )
+
+
+class _Workload(threading.Thread):
+    """Base: loops `step` until stop; remembers the first hard failure."""
+
+    t0 = 0.0  # stamped by run_soak before start()
+
+    def __init__(self, name, stop):
+        super().__init__(daemon=True, name=name)
+        self.stop_evt = stop
+        self.failure: Optional[str] = None
+        self.iterations = 0
+        self.redrives = 0
+
+    def note(self, msg):
+        print(
+            f"[soak t={time.monotonic() - self.t0:6.1f}s] [{self.name}] {msg}",
+            flush=True,
+        )
+
+    def run(self):
+        while not self.stop_evt.is_set():
+            try:
+                self.step()
+                self.iterations += 1
+            except Exception as e:  # noqa: BLE001 — a soak failure is data
+                import traceback
+
+                self.failure = (
+                    f"(iteration {self.iterations}, "
+                    f"t={time.monotonic() - self.t0:.1f}s) "
+                    f"{type(e).__name__}: {e}"
+                )
+                self.note(self.failure + "\n" + traceback.format_exc())
+                return
+
+    def eventually(self, make_refs, check, timeout=60.0):
+        """Submit-fresh-and-get with a bounded, COUNTED re-drive on the
+        two outcomes a head kill can legitimately inflict on this client
+        (a parked get that will never resolve, a loudly-lost object).
+        Wrong VALUES never retry — they fail the soak immediately."""
+        from ray_tpu.exceptions import GetTimeoutError, ObjectLostError
+
+        last = None
+        for attempt in range(1 + REDRIVES):
+            if attempt:
+                self.redrives += 1
+                self.note(
+                    f"re-drive {attempt}/{REDRIVES} of iteration "
+                    f"{self.iterations} after {last!r}"
+                )
+            try:
+                outs = ray_tpu.get(make_refs(), timeout=timeout)
+            except (GetTimeoutError, ObjectLostError) as e:
+                last = e
+                continue
+            check(outs)
+            return
+        raise AssertionError(
+            f"logical op LOST after {REDRIVES} re-drives: {last!r}"
+        )
+
+
+class _ChainLoad(_Workload):
+    def __init__(self, stop, log_path):
+        super().__init__("soak-chains", stop)
+        self.log_path = log_path
+
+    def step(self):
+        r = self.iterations
+
+        def make_refs():
+            return [
+                fold.remote(
+                    produce.remote(i, r, self.log_path), i, r, self.log_path
+                )
+                for i in range(CHAIN_WIDTH)
+            ]
+
+        def check(outs):
+            for i, a in enumerate(outs):
+                expect = i * ARR + i
+                if a.shape != (ARR,) or int(a[0]) != expect or int(a.sum()) != expect * ARR:
+                    raise AssertionError(
+                        f"chain round {r} lane {i}: wrong result (CORRUPT)"
+                    )
+
+        self.eventually(make_refs, check)
+
+
+class _ActorLoad(_Workload):
+    def __init__(self, stop, log_path):
+        super().__init__("soak-actor", stop)
+        self.actor = SoakActor.options(name="soak_actor").remote(log_path)
+
+    def step(self):
+        i = self.iterations
+
+        def check(outs):
+            if outs != [i]:
+                raise AssertionError(
+                    f"actor echo({i}) returned {outs[0]} (CORRUPT reply)"
+                )
+
+        self.eventually(lambda: [self.actor.echo.remote(i)], check)
+        # Shared-box pacing.  This also sets the actor-worker churn rate:
+        # the kill clause fires on done-frame COUNTS, so an unpaced echo
+        # hammer would recycle the actor's worker every ~1s and the
+        # one-box cluster would spend itself respawning processes.
+        time.sleep(0.1)
+
+
+class _ServeLoad(_Workload):
+    """One logical request per step; each retries (with address
+    re-discovery — a restarted proxy binds a fresh port) until it succeeds
+    or the per-request budget lapses (then it is LOST — the soak fails)."""
+
+    def __init__(self, stop, addr, addr_fn):
+        super().__init__("soak-serve", stop)
+        self.addr = addr
+        self.addr_fn = addr_fn
+        self.ok = 0
+        self.retried = 0
+        self.lost = 0
+
+    def step(self):
+        import urllib.request
+
+        deadline = time.monotonic() + 60
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                req = urllib.request.Request(
+                    self.addr + "/soak", data=b"{}", method="POST"
+                )
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    body = json.loads(resp.read())
+                assert body["result"] == {"ok": True}
+                self.ok += 1
+                if attempt > 1:
+                    self.retried += 1
+                # Light pacing: the soak shares one box with the whole
+                # cluster; an unpaced HTTP hammer starves the processes
+                # it is testing.
+                time.sleep(0.05)
+                return
+            except Exception:
+                if time.monotonic() > deadline:
+                    self.lost += 1
+                    raise AssertionError(
+                        f"serve request lost after {attempt} attempts"
+                    )
+                time.sleep(1.0)
+                try:
+                    self.addr = self.addr_fn() or self.addr
+                except Exception:
+                    pass  # control plane mid-bounce: retry the old address
+
+
+def _count_log(path: str) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    try:
+        with open(path) as f:
+            for ln in f:
+                ln = ln.strip()
+                if ln:
+                    counts[ln] = counts.get(ln, 0) + 1
+    except FileNotFoundError:
+        pass
+    return counts
+
+
+def run_soak(
+    duration: float = 75.0,
+    seed: int = 7,
+    spec: str = DEFAULT_SPEC,
+    out: Optional[str] = None,
+    use_serve: bool = True,
+    num_cpus: int = 4,
+) -> Dict:
+    from ray_tpu._private import faults
+    from ray_tpu._private.head import launch_head_subprocess
+
+    faults.configure(spec, seed)  # fail LOUDLY on a typo'd plan, up front
+    faults.disable()  # the driver itself stays clean; children get the env
+
+    workdir = tempfile.mkdtemp(prefix=f"chaos-soak-{seed}-")
+    log_path = os.path.join(workdir, "executions.log")
+    # Unique per run: session names key the shared /tmp log + store dirs,
+    # and a reused name would interleave a previous soak's state.
+    session = f"chaos{seed}x{os.getpid():x}"
+    saved_env = {
+        k: os.environ.get(k)
+        for k in (
+            "RAY_TPU_FAULT_SPEC",
+            "RAY_TPU_FAULT_SEED",
+            "RAY_TPU_RECONNECT_WINDOW_S",
+        )
+    }
+    os.environ["RAY_TPU_FAULT_SPEC"] = spec
+    os.environ["RAY_TPU_FAULT_SEED"] = str(seed)
+    os.environ["RAY_TPU_RECONNECT_WINDOW_S"] = "45"
+
+    report: Dict = {
+        "seed": seed,
+        "spec": spec,
+        "duration_s": duration,
+        "kills": {"head": 0, "daemon": 0},
+        "result": "FAIL",
+    }
+    head = daemon = None
+    serve_mod = None
+    stop = threading.Event()
+    loads = []
+    try:
+        head, head_json = launch_head_subprocess(
+            workdir, num_cpus=num_cpus, session=session
+        )
+        daemon = _launch_daemon(head_json, "soak-d1", num_cpus)
+        ray_tpu.init(address=head_json)
+
+        if use_serve:
+            from ray_tpu import serve as serve_mod
+
+            serve_mod.start(http_options={"host": "127.0.0.1", "port": 0})
+
+            @serve_mod.deployment(
+                name="soak",
+                num_replicas=2,
+                ray_actor_options={"max_restarts": 100},
+            )
+            def soak_dep(body=None):
+                return {"ok": True}
+
+            serve_mod.run(soak_dep.bind())
+            addr = serve_mod.get_http_address()
+
+        loads = [
+            _ChainLoad(stop, log_path),
+            _ActorLoad(stop, log_path),
+        ]
+        if use_serve:
+            loads.append(_ServeLoad(stop, addr, serve_mod.get_http_address))
+
+        # ---- supervise the schedule window: the SPEC does the killing;
+        # the harness only resurrects control-plane processes.
+        t0 = time.monotonic()
+        _Workload.t0 = t0
+        for w in loads:
+            w.start()
+
+        def note(msg):
+            print(f"[soak t={time.monotonic() - t0:6.1f}s] {msg}", flush=True)
+
+        daemon_n = 1
+        while time.monotonic() - t0 < duration:
+            time.sleep(0.5)
+            draining = time.monotonic() - t0 > duration - 10
+            if head.poll() is not None:
+                report["kills"]["head"] += 1
+                if draining:
+                    # Quiescence: relaunches near/after the end come up
+                    # with the fault plan stripped.
+                    os.environ.pop("RAY_TPU_FAULT_SPEC", None)
+                note(f"head died (kill #{report['kills']['head']}); relaunching")
+                head, _ = launch_head_subprocess(
+                    workdir, num_cpus=num_cpus, session=session
+                )
+                note("head relaunched")
+            if daemon.poll() is not None:
+                report["kills"]["daemon"] += 1
+                daemon_n += 1
+                if draining:
+                    os.environ.pop("RAY_TPU_FAULT_SPEC", None)
+                note(f"daemon died (kill #{report['kills']['daemon']}); "
+                     f"relaunching as soak-d{daemon_n}")
+                daemon = _launch_daemon(head_json, f"soak-d{daemon_n}", num_cpus)
+            dead = [w for w in loads if w.failure]
+            if dead:
+                note(f"workload failure: {[(w.name, w.failure) for w in dead]}")
+                break
+
+        # ---- drain: stop the storm but KEEP SUPERVISING — surviving
+        # processes still carry live clauses (each head incarnation crashes
+        # at its own t=30), and a death with nobody resurrecting it would
+        # strand the workloads' final operations.  Relaunches from here on
+        # come up with the fault plan stripped.
+        os.environ.pop("RAY_TPU_FAULT_SPEC", None)
+        stop.set()
+        drain_deadline = time.monotonic() + 300
+        while (
+            any(w.is_alive() for w in loads)
+            and time.monotonic() < drain_deadline
+        ):
+            time.sleep(0.5)
+            if head.poll() is not None:
+                report["kills"]["head"] += 1
+                note("head died during drain; relaunching clean")
+                head, _ = launch_head_subprocess(
+                    workdir, num_cpus=num_cpus, session=session
+                )
+            if daemon.poll() is not None:
+                report["kills"]["daemon"] += 1
+                daemon_n += 1
+                note(f"daemon died during drain; relaunching as soak-d{daemon_n}")
+                daemon = _launch_daemon(head_json, f"soak-d{daemon_n}", num_cpus)
+        for w in loads:
+            w.join(timeout=10)
+            if w.is_alive():
+                raise AssertionError(f"[{w.name}] never drained (wedged op)")
+        for w in loads:
+            if w.failure:
+                raise AssertionError(f"[{w.name}] {w.failure}")
+        if head.poll() is not None:
+            head, _ = launch_head_subprocess(
+                workdir, num_cpus=num_cpus, session=session
+            )
+        # A clean round on the post-storm cluster: convergence, not luck.
+        final = ray_tpu.get(
+            [
+                fold.remote(produce.remote(i, "final", log_path), i, "final",
+                            log_path)
+                for i in range(CHAIN_WIDTH)
+            ],
+            timeout=240,
+        )
+        for i, a in enumerate(final):
+            assert int(a[0]) == i * ARR + i, (
+                "post-storm cluster did not converge to correct results"
+            )
+
+        # ---- the ledger: executions within retry budgets, kills fired.
+        counts = _count_log(log_path)
+        head_kills = report["kills"]["head"]
+        # At-least-once bound: system retries per submission, times the
+        # driver's counted re-drives, plus the snapshot re-drive a head
+        # restart performs.
+        budget = (TASK_RETRIES + 1) * (1 + REDRIVES) + head_kills
+        over = {k: c for k, c in counts.items() if c > budget}
+        assert not over, f"execution counts beyond retry budgets: {over}"
+        dup_execs = sum(c - 1 for c in counts.values() if c > 1)
+        chains = next(w for w in loads if w.name == "soak-chains")
+        actor = next(w for w in loads if w.name == "soak-actor")
+        report.update(
+            {
+                "chain_rounds": chains.iterations,
+                "chain_results_checked": chains.iterations * CHAIN_WIDTH,
+                "chain_redrives": chains.redrives,
+                "actor_calls": actor.iterations,
+                "actor_redrives": actor.redrives,
+                "distinct_executions": len(counts),
+                "duplicate_executions": dup_execs,
+                "execution_budget": budget,
+            }
+        )
+        if use_serve:
+            sv = next(w for w in loads if w.name == "soak-serve")
+            report["serve"] = {
+                "ok": sv.ok, "retried": sv.retried, "lost": sv.lost,
+            }
+            assert sv.lost == 0, f"{sv.lost} serve requests lost"
+        assert chains.iterations >= 3, "soak too short: <3 chain rounds ran"
+        assert actor.iterations >= 10, "soak too short: <10 actor calls ran"
+        assert head_kills >= 1, "schedule never killed the head"
+        assert report["kills"]["daemon"] >= 1, "schedule never killed a daemon"
+        assert dup_execs >= 1, (
+            "no task was ever re-executed: worker kill clauses never fired"
+        )
+        report["result"] = "PASS"
+        return report
+    except BaseException:
+        print(
+            "\n=== CHAOS SOAK FAILED — replay with:\n"
+            f"    python scripts/chaos_soak.py --seed {seed} "
+            f"--duration {duration} --spec '{spec}'\n"
+            f"    (session dir kept at {workdir})",
+            file=sys.stderr,
+            flush=True,
+        )
+        raise
+    finally:
+        stop.set()
+        if serve_mod is not None:
+            try:
+                serve_mod.shutdown()
+            except Exception:
+                pass
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        for proc in (daemon, head):
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        if out and report.get("result"):
+            with open(out, "w") as f:
+                json.dump(report, f, indent=1, sort_keys=True)
+                f.write("\n")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--duration", type=float, default=75.0)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--spec", default=DEFAULT_SPEC)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--no-serve", action="store_true")
+    ap.add_argument("--num-cpus", type=int, default=4)
+    args = ap.parse_args(argv)
+    report = run_soak(
+        duration=args.duration,
+        seed=args.seed,
+        spec=args.spec,
+        out=args.out,
+        use_serve=not args.no_serve,
+        num_cpus=args.num_cpus,
+    )
+    print(json.dumps(report, indent=1, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

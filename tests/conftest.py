@@ -33,6 +33,14 @@ jax.config.update("jax_platforms", "cpu")
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: minutes-scale soak/e2e tests excluded from tier-1 "
+        "(-m 'not slow'); run explicitly via -m slow",
+    )
+
+
 @pytest.fixture
 def ray_start_regular():
     import ray_tpu

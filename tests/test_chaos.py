@@ -6,10 +6,17 @@ survive worker/node churn with lineage on.  CI-scale here: a killer
 thread SIGKILLs random busy workers (and a whole daemon node) while
 task chains and a restartable actor keep making progress; every result
 must still be exactly right.
+
+The minutes-scale, REPLAYABLE version of this file is the chaos soak
+(scripts/chaos_soak.py + the `slow`-marked tests below): kills come from
+the deterministic fault plane (faults.py) instead of a wall-clock
+thread, so any failure reruns from its printed seed.
 """
 
+import os
 import random
 import signal
+import sys
 import threading
 import time
 
@@ -170,3 +177,66 @@ def test_chaos_daemon_node_kill_reconstructs_objects(ray_start_regular):
     # Consumption reconstructs the producers on surviving capacity.
     outs = ray_tpu.get([r for r in refs], timeout=240)
     assert [int(a.sum()) for a in outs] == [i * (1 << 14) for i in range(4)]
+
+
+# ---------------------------------------------------------------------------
+# schedule-driven soak (slow tier: minutes-scale, deterministic fault plane)
+
+
+def _soak():
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(__file__), "..", "scripts")
+    )
+    from chaos_soak import run_soak
+
+    return run_soak
+
+
+@pytest.mark.slow
+def test_chaos_soak_schedule_driven(tmp_path):
+    """Acceptance soak: a >=60s schedule-driven run whose spec kills
+    workers (at their result-send hazard), a node daemon, and the head —
+    with zero lost or duplicated results beyond retry budgets, and
+    convergence to a quiescent, correct cluster afterwards.  On failure
+    the harness prints the seed + spec to replay."""
+    run_soak = _soak()
+    report = run_soak(
+        duration=65.0, seed=7, out=str(tmp_path / "CHAOS_soak.json")
+    )
+    assert report["result"] == "PASS"
+    assert report["kills"]["head"] >= 1
+    assert report["kills"]["daemon"] >= 1
+    assert report["duplicate_executions"] >= 1  # worker kills fired + healed
+
+
+@pytest.mark.slow
+def test_chaos_soak_seed_replay_schedule_identical():
+    """The same spec + seed produces an identical injection schedule
+    across two fresh configurations (the replayability contract the soak
+    leans on when it prints a failing seed)."""
+    from ray_tpu._private import faults
+
+    spec = (
+        "wire.send:drop@prob=0.2;peer.send:delay=0.001@prob=0.5;"
+        "gcs.save:error@every=3"
+    )
+
+    def schedule():
+        faults.configure(spec, 1234)
+        out = []
+        for i in range(300):
+            try:
+                out.append(faults.point("wire.send", key="done"))
+            except faults.InjectedFault:
+                out.append("error")
+            try:
+                out.append(faults.point("gcs.save"))
+            except faults.InjectedFault:
+                out.append("error")
+        fired = faults.log()
+        faults.disable()
+        return out, [(n, a, v) for _t, n, a, v in fired]
+
+    s1 = schedule()
+    s2 = schedule()
+    assert s1 == s2

@@ -1,0 +1,193 @@
+"""Fault-injection plane (faults.py): spec grammar, seeded determinism,
+disabled fast path, and live wiring at the hazard sites.
+
+ray: the reference's RayConfig testing knobs (testing_asio_delay_us etc.)
+give CI deterministic failure injection; these tests pin the same
+properties here — a chaos scenario is nameable, replayable from its seed,
+and free when unset.
+"""
+
+import os
+import time
+
+import pytest
+
+from ray_tpu._private import faults
+
+import ray_tpu
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.disable()
+    yield
+    faults.disable()
+
+
+# ---------------------------------------------------------------- grammar
+
+
+def test_spec_parse_errors_are_loud():
+    for bad in [
+        "nonsense",              # no point:action shape
+        "a.b:boom",              # unknown action
+        "a.b:delay",             # delay without seconds
+        "a.b:delay=xyz",         # non-numeric delay
+        "a.b:drop@every=x",      # non-integer selector
+        "a.b:drop@every=0",      # every must be positive
+        "a.b:drop@prob=1.5",     # prob out of range
+        "a.b:drop@who=1",        # unknown selector
+        "a.b:drop@nth",          # selector without value
+        ":drop",                 # empty point name
+    ]:
+        with pytest.raises(faults.FaultSpecError):
+            faults.configure(bad)
+        # A bad plan must not half-install.
+        assert not faults.ENABLED
+
+
+def test_disabled_is_noop():
+    assert not faults.ENABLED
+    assert faults.point("peer.send", key="pcall") is None
+    assert faults.log() == []
+
+
+def test_selector_semantics():
+    faults.configure("p.x:drop@every=3,after=1,times=2", 0)
+    fired = [v for v in range(1, 13) if faults.point("p.x") == "drop"]
+    # eligible visits are >1; every 3rd eligible visit fires; 2 at most
+    assert fired == [4, 7]
+
+    faults.configure("p.y:drop@nth=2", 0)
+    assert [faults.point("p.y") for _ in range(4)] == [None, "drop", None, None]
+
+    faults.configure("p.z:drop@match=abc", 0)
+    assert faults.point("p.z", key="zzz") is None
+    assert faults.point("p.z", key="xxabcxx") == "drop"
+
+    # proc= scopes to the process tag
+    faults.configure("p.w:drop@proc=worker", 0)
+    assert faults.point("p.w") is None  # this process is tagged "main"
+    faults.set_process_tag("worker:w-123")
+    try:
+        assert faults.point("p.w") == "drop"
+    finally:
+        faults.set_process_tag("main")
+
+
+def test_wildcard_point_pattern():
+    faults.configure("peer.*:drop@every=1", 0)
+    assert faults.point("peer.send") == "drop"
+    assert faults.point("peer.connect") == "drop"
+    assert faults.point("wire.send") is None
+
+
+def test_seed_determinism_identical_schedule():
+    """Acceptance: a fixed seed produces an identical injection schedule
+    across two runs; a different seed produces a different one."""
+    spec = "p.a:drop@prob=0.3;p.b:drop@prob=0.7,times=20"
+
+    def schedule(seed):
+        faults.configure(spec, seed)
+        out = []
+        for i in range(200):
+            out.append((faults.point("p.a"), faults.point("p.b")))
+        return out
+
+    s1 = schedule(7)
+    s2 = schedule(7)
+    assert s1 == s2
+    assert any(a == "drop" for a, _b in s1)
+    assert any(b == "drop" for _a, b in s1)
+    s3 = schedule(8)
+    assert s1 != s3
+
+
+def test_error_action_is_oserror():
+    faults.configure("p.e:error@nth=1", 5)
+    with pytest.raises(faults.InjectedFault) as ei:
+        faults.point("p.e")
+    assert isinstance(ei.value, ConnectionError)  # hence OSError
+    assert "seed 5" in str(ei.value)  # the replay handle is in the message
+    # subsequent visits pass
+    assert faults.point("p.e") is None
+
+
+def test_delay_action_sleeps():
+    faults.configure("p.d:delay=0.05@nth=1", 0)
+    t0 = time.monotonic()
+    faults.point("p.d")
+    assert time.monotonic() - t0 >= 0.045
+
+
+def test_fired_log_records_injections():
+    faults.configure("p.l:drop@every=2", 0)
+    for _ in range(6):
+        faults.point("p.l")
+    entries = faults.log()
+    assert [v for _t, _n, _a, v in entries] == [2, 4, 6]
+    assert faults.stats() == {"p.l": 3}
+
+
+# ---------------------------------------------------------------- wiring
+
+
+def test_wire_send_drop_loses_frame():
+    """TypedConn.send with a drop clause: the frame never reaches the
+    peer, the sender sees success (a lost message, not a failed send)."""
+    from multiprocessing import Pipe
+
+    from ray_tpu._private import wire
+
+    a, b = Pipe()
+    ca, cb = wire.wrap(a), wire.wrap(b)
+    faults.configure("wire.send:drop@match=spans", 0)
+    ca.send(("spans", []))          # dropped
+    ca.send(("heartbeat",))         # delivered
+    assert cb.recv() == ("heartbeat",)
+    ca.close()
+    cb.close()
+
+
+def test_wire_recv_drop_skips_frame():
+    from multiprocessing import Pipe
+
+    from ray_tpu._private import wire
+
+    a, b = Pipe()
+    ca, cb = wire.wrap(a), wire.wrap(b)
+    faults.configure("wire.recv:drop@nth=1", 0)
+    ca.send(("heartbeat",))
+    ca.send(("sync",))
+    assert cb.recv() == ("sync",)   # first frame consumed by the fault
+    ca.close()
+    cb.close()
+
+
+def test_gcs_save_error_skips_tick(tmp_path):
+    from ray_tpu._private.gcs_storage import FileSnapshotStorage
+
+    st = FileSnapshotStorage(str(tmp_path / "snap.pkl"))
+    faults.configure("gcs.save:error@nth=1", 0)
+    with pytest.raises(faults.InjectedFault):
+        st.save("s", {"session": "s", "kv": {}})
+    # the fault consumed its one shot; the next tick persists
+    st.save("s", {"session": "s", "kv": {}})
+    assert st.load("s") is not None
+
+
+def test_end_to_end_delay_injection_under_real_runtime(ray_start_regular):
+    """Wiring is live on a real cluster: a benign delay clause on the
+    head's control delivery fires, results stay correct."""
+    faults.configure("head.send:delay=0.001@every=5", 0)
+    try:
+
+        @ray_tpu.remote
+        def add(a, b):
+            return a + b
+
+        outs = ray_tpu.get([add.remote(i, i) for i in range(20)], timeout=120)
+        assert outs == [2 * i for i in range(20)]
+        assert faults.stats().get("head.send", 0) > 0
+    finally:
+        faults.disable()

@@ -1,0 +1,382 @@
+"""Regression tests for the round-5 ADVICE findings (recovery-path
+correctness), one per fix:
+
+  * relayed-actor restart re-drives in-flight calls in submission order;
+  * a mid-flush re-drive failure charges retry budget only for calls that
+    actually hit the socket;
+  * a pid-less zygote handle reads dead after the fork grace even while
+    the zygote lives (lost ("forked", ...) reply);
+  * once + wildcard pubsub subscriptions are consumed on both the head
+    and the worker side;
+  * the spill freed-race delete is queued to the reclaim thread, never
+    run under the store lock.
+"""
+
+import os
+import queue
+import threading
+import time
+import types
+
+import pytest
+
+import ray_tpu
+
+
+def _rt():
+    from ray_tpu._private.runtime import get_runtime
+
+    return get_runtime()
+
+
+# ------------------------------------------------- ordered relayed re-drive
+
+
+def test_relayed_actor_requeue_preserves_submission_order(
+    ray_start_regular, tmp_path
+):
+    """Kill an actor worker with many relayed calls in flight: the
+    retry-budgeted requeue must replay them in per-caller submission
+    order on the restarted instance (previously a Set[str] iterated in
+    hash order)."""
+    path = str(tmp_path / "order.log")
+
+    @ray_tpu.remote(max_restarts=2, max_task_retries=5)
+    class Recorder:
+        def record(self, i, path, sleep=0.0):
+            with open(path, "a") as f:
+                f.write(f"{i}\n")
+            if sleep:
+                time.sleep(sleep)
+            return i
+
+    a = Recorder.remote()
+    # First call blocks the single-threaded executor; the rest pile up
+    # in flight behind it (pushed, unacked).
+    refs = [a.record.remote(0, path, sleep=2.0)]
+    refs += [a.record.remote(i, path) for i in range(1, 8)]
+
+    # Wait for the first call to be mid-execution, then SIGKILL the
+    # actor's worker while all 8 calls are in flight.
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and not os.path.exists(path):
+        time.sleep(0.02)
+    assert os.path.exists(path), "actor never started executing"
+    rt = _rt()
+    with rt.lock:
+        target = None
+        for h in rt.workers.values():
+            if h.state == "actor" and h.proc is not None:
+                target = h
+                break
+    assert target is not None
+    target.proc.kill()
+
+    assert ray_tpu.get(refs, timeout=180) == list(range(8))
+    with open(path) as f:
+        lines = [ln.strip() for ln in f if ln.strip()]
+    # The re-driven replay (last 8 entries) runs in submission order.
+    assert lines[-8:] == [str(i) for i in range(8)], lines
+
+
+# ------------------------------------------- uncharged unsent re-drive tail
+
+
+class _FlakyConn:
+    """Peer conn whose send fails after `ok_sends` successes."""
+
+    def __init__(self, ok_sends):
+        self.ok_sends = ok_sends
+        self.sent = []
+        self.dead = False
+
+    def send(self, msg):
+        if len(self.sent) >= self.ok_sends:
+            return False
+        self.sent.append(msg)
+        return True
+
+
+class _FakeSpec:
+    def __init__(self, task_id):
+        self.task_id = task_id
+        self.attempt = 0
+        self.max_retries = 5
+        self.retry_exceptions = False
+        self.contained_refs = []
+
+    def return_ids(self):
+        return []
+
+
+def test_recover_actor_flush_charges_only_sent_prefix():
+    """_recover_actor's re-drive flush dies mid-send: only the specs that
+    hit the socket are charged an attempt; the unsent tail re-buffers
+    uncharged, behind the re-driven prefix, in order."""
+    from ray_tpu._private.peer import ActorRoute, DirectTransport
+
+    resolved = threading.Event()
+    release_second = threading.Event()
+    calls = {"n": 0}
+
+    class FakeWR:
+        authkey = b"k"
+        task_event_sink = None
+
+        def request(self, op, payload, timeout=None):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                return ("direct", None, ("127.0.0.1", 9), True)
+            # The post-failure background recovery: park until the test
+            # has asserted the buffer, then declare the actor dead.
+            resolved.set()
+            release_second.wait(timeout=30)
+            return ("dead", None, None, False)
+
+        def oneway(self, msg, droppable=False):
+            pass
+
+        def borrow_ref(self, c):
+            pass
+
+        def unborrow_ref(self, c):
+            pass
+
+    t = DirectTransport(FakeWR())
+    conn = _FlakyConn(ok_sends=2)
+    t._conn_to = lambda ep: conn
+
+    specs = [_FakeSpec(f"t{i}") for i in range(4)]
+    r = ActorRoute(conn, restartable=True)
+    r.state = "recovering"
+    r.conn = None
+    r.recover_started = True
+    r.buffered = list(specs)
+    t.routes["a1"] = r
+    for s in specs:
+        t.inflight[s.task_id] = ("a1", s, None, None)
+
+    t._recover_actor("a1")  # flush: t0, t1 sent; t2 fails mid-send
+
+    assert resolved.wait(timeout=30), "death path never re-entered recovery"
+    # Sent prefix charged exactly once; never-sent tail uncharged.
+    assert [s.attempt for s in specs] == [1, 1, 0, 0]
+    # Buffer rebuilt in submission order: re-driven prefix first.
+    with t.lock:
+        assert [s.task_id for s in r.buffered] == ["t0", "t1", "t2", "t3"]
+    release_second.set()
+
+
+# ------------------------------------------------- pid-less zygote handles
+
+
+def test_pidless_zygote_handle_dies_after_grace_with_live_zygote():
+    """A handle whose ("forked", ...) reply was lost reads dead after the
+    grace window EVEN while the zygote process is alive, so the reaper
+    reschedules its lease (previously: alive forever)."""
+    from ray_tpu._private import config as _config
+    from ray_tpu._private.runtime import _ZygoteProcHandle
+
+    class LiveZygote:
+        def poll(self):
+            return None  # still running
+
+    h = _ZygoteProcHandle(LiveZygote())
+    assert h.is_alive()  # fresh request: within grace
+    h._created -= _config.get("zygote_fork_grace_s") + 1.0
+    assert not h.is_alive()  # grace lapsed: fork reply is lost
+    # A (late) pid attribution flips liveness back to the real process.
+    h.set_pid(os.getpid())
+    assert h.is_alive()
+
+
+# ------------------------------------------- once+wildcard pubsub consumption
+
+
+def test_head_once_wildcard_subscription_consumed():
+    """Head side: a once=True wildcard subscription fires exactly once
+    (previously the consume pass only popped exact-key entries)."""
+    from ray_tpu._private.runtime import Runtime
+
+    fake = types.SimpleNamespace(
+        lock=threading.RLock(),
+        remote_subs={("ch", "*"): {"w_once": True, "w_persist": False}},
+        _pub_queue=queue.Queue(),
+    )
+    publish = Runtime._remote_publish.__get__(fake)
+
+    publish("ch", "k1", ("a",))
+    publish("ch", "k2", ("b",))
+
+    per_wid = {}
+    while not fake._pub_queue.empty():
+        wid, _msg = fake._pub_queue.get_nowait()
+        per_wid[wid] = per_wid.get(wid, 0) + 1
+    assert per_wid == {"w_once": 1, "w_persist": 2}
+    assert fake.remote_subs == {("ch", "*"): {"w_persist": False}}
+
+
+def test_head_exact_once_still_consumed_and_resub_survives():
+    """The pre-existing exact-key semantics hold: once consumed, a
+    persistent re-subscription that landed before the consume pass is
+    kept."""
+    from ray_tpu._private.runtime import Runtime
+
+    fake = types.SimpleNamespace(
+        lock=threading.RLock(),
+        remote_subs={("ch", "k"): {"w1": True}},
+        _pub_queue=queue.Queue(),
+    )
+    publish = Runtime._remote_publish.__get__(fake)
+    publish("ch", "k", ())
+    assert ("ch", "k") not in fake.remote_subs
+    # once entry upgraded to persistent mid-send must survive: simulate by
+    # re-registering between publishes.
+    fake.remote_subs[("ch", "k")] = {"w1": False}
+    publish("ch", "k", ())
+    assert fake.remote_subs == {("ch", "k"): {"w1": False}}
+
+
+def test_worker_once_wildcard_subscription_consumed():
+    """Worker side: _on_pub prunes a once=True wildcard sub after its
+    first delivery (previously it fired on every later key forever)."""
+    from ray_tpu._private.worker_proc import WorkerRuntime
+
+    wr = WorkerRuntime.__new__(WorkerRuntime)  # skip store setup
+    wr._subs_lock = threading.Lock()
+    fired = []
+    wr._subs = {
+        ("ch", "*"): [
+            (lambda key, *a: fired.append(("once", key)), True),
+            (lambda key, *a: fired.append(("persist", key)), False),
+        ]
+    }
+    wr._on_pub("ch", "k1", ())
+    wr._on_pub("ch", "k2", ())
+    assert fired == [("once", "k1"), ("persist", "k1"), ("persist", "k2")]
+    remaining = wr._subs[("ch", "*")]
+    assert len(remaining) == 1 and remaining[0][1] is False
+
+
+# ------------------------------------------- reconnect budget per incident
+
+
+def test_request_budget_refreshes_after_each_healed_reconnect(monkeypatch):
+    """Soak-found (chaos_soak seed 7): a long-lived request that rides
+    SEVERAL head bounces — each healed by a successful reconnect — must
+    get a fresh give-up budget per incident.  The old time-gap heuristic
+    treated bounces spaced under window+10s as one continuous outage and
+    gave up mid-heal."""
+    import time as _t
+
+    from ray_tpu._private.worker_proc import WorkerRuntime
+
+    clock = {"t": 0.0}
+    monkeypatch.setattr(_t, "monotonic", lambda: clock["t"])
+    monkeypatch.setattr(
+        _t, "sleep", lambda s: clock.__setitem__("t", clock["t"] + s)
+    )
+
+    wr = WorkerRuntime.__new__(WorkerRuntime)
+    wr.reconnect_window_override = 45.0
+    wr._conn_generation = 0
+
+    # Script: the request's conn dies at t=0, 30, 61 (bounces spaced well
+    # under window+10=55s apart); each bounce heals (generation bumps)
+    # before the next; the reply finally lands on the 4th try.
+    script = iter([(0.0, 0), (30.0, 1), (61.0, 2)])
+
+    def once(op, payload, timeout):
+        for t, gen in script:
+            clock["t"] = t
+            wr._conn_generation = gen
+            raise ConnectionError("head connection was reset (head restart)")
+        return "ok"
+
+    wr._request_once = once
+    # Old logic: gives up at the THIRD bounce (61 > 0+55).  New logic:
+    # every healed reconnect refreshes the budget, so the request rides
+    # all three bounces and resolves.
+    assert wr.request("get_object", "oid") == "ok"
+
+
+def test_request_gives_up_when_outage_never_heals(monkeypatch):
+    """The give-up still fires for one CONTINUOUS outage: no successful
+    reconnect (generation frozen), failures past window+10s."""
+    import time as _t
+
+    import pytest as _pytest
+
+    from ray_tpu._private.worker_proc import WorkerRuntime
+
+    clock = {"t": 0.0}
+    monkeypatch.setattr(_t, "monotonic", lambda: clock["t"])
+    monkeypatch.setattr(
+        _t, "sleep", lambda s: clock.__setitem__("t", clock["t"] + s)
+    )
+
+    wr = WorkerRuntime.__new__(WorkerRuntime)
+    wr.reconnect_window_override = 45.0
+    wr._conn_generation = 0
+
+    def once(op, payload, timeout):
+        clock["t"] += 30.0  # failures at 30, 60, 90... same generation
+        raise ConnectionError("head connection lost mid-send")
+
+    wr._request_once = once
+    with _pytest.raises(ConnectionError, match="reconnect window"):
+        wr.request("get_object", "oid")
+
+
+# ------------------------------------------------- spill freed-race delete
+
+
+def test_spill_freed_race_delete_queued_not_synchronous(tmp_path):
+    """OwnerStore.spill()'s freed-race path must queue the stored-image
+    delete for the reclaim thread instead of running it (a potentially
+    blocking network call on URI backends) under the store lock."""
+    import numpy as np
+
+    from ray_tpu._private.store import OwnerStore
+
+    store = OwnerStore(
+        f"frtest-{os.getpid()}", spill_dir=str(tmp_path / "spill")
+    )
+    try:
+        oid = "obj-freed-race"
+        store.put(oid, np.zeros(300_000, dtype=np.uint8))  # shm-sealed
+        assert oid in store._in_shm
+
+        deletes = []
+        real = store._spill_storage
+
+        class Recording:
+            def put(self, o, data):
+                return real.put(o, data)
+
+            def get(self, p):
+                return real.get(p)
+
+            def delete(self, p):
+                deletes.append(threading.current_thread().name)
+                real.delete(p)
+
+            def destroy(self):
+                real.destroy()
+
+        store._spill_storage = Recording()
+        # Simulate the race: the object is freed after spill() read the
+        # segment but before it re-took the lock.
+        with store._lock:
+            store._in_shm.pop(oid)
+        assert store.spill(oid) is None
+        # The delete must not have run on this (caller) thread...
+        me = threading.current_thread().name
+        assert all(t != me for t in deletes)
+        # ...but the reclaim thread performs it promptly.
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not deletes:
+            time.sleep(0.02)
+        assert deletes and all(t != me for t in deletes)
+    finally:
+        store.destroy()
