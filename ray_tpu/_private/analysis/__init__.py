@@ -1,0 +1,93 @@
+"""Project-specific concurrency static analysis (SURVEY §5.2).
+
+The reference hardens its C++ concurrency with clang thread-safety
+annotations (GUARDED_BY) + TSAN in CI; this Python runtime gets the
+equivalent as an AST lint over the package, run by tier-1 tests and
+`scripts/ray_tpu_lint.py`.  Three passes:
+
+  * blocking-under-lock (blocking.py) — calls from a catalog of blocking
+    operations (time.sleep, conn.recv/sock.recv, .result(), wire
+    send/recv, subprocess, faults.point delay-capable sites) made
+    lexically inside a `with <lock>` body or between explicit
+    acquire()/release();
+  * lock-order (lock_order.py) — the per-module lock-acquisition graph
+    from nested `with` statements plus same-module call edges; cycles are
+    potential ABBA deadlock inversions;
+  * fault-registry (fault_registry.py) — every faults.point("name") call
+    site collected into a generated catalog
+    (ray_tpu/_private/analysis/fault_points.txt), and every literal
+    RAY_TPU_FAULT_SPEC / faults.configure() spec in tests+scripts
+    validated against it (a typo'd spec silently injects nothing — false
+    robustness).
+
+Existing, reviewed sites live in allowlist.txt with one-line
+justifications; the lint fails only on NEW violations.  The runtime twin
+of the static side is the opt-in lock watchdog
+(ray_tpu/_private/lock_watchdog.py, RAY_TPU_LOCK_WATCHDOG=1).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+from ray_tpu._private.analysis.common import Violation, iter_py_files
+from ray_tpu._private.analysis import blocking, fault_registry, lock_order
+from ray_tpu._private.analysis import allowlist as allowlist_mod
+
+PASSES = ("blocking-under-lock", "lock-order", "fault-registry")
+
+
+class AnalysisResult:
+    """All findings plus the allowlist split applied to them."""
+
+    def __init__(self, violations: List[Violation], allowed: Dict[str, str]):
+        self.violations = violations
+        self.allowlist = allowed
+        keys = {v.key for v in violations}
+        self.new = [v for v in violations if v.key not in allowed]
+        self.allowlisted = [v for v in violations if v.key in allowed]
+        self.stale_allowlist = sorted(k for k in allowed if k not in keys)
+
+    @property
+    def ok(self) -> bool:
+        return not self.new
+
+
+def run_analysis(
+    roots: Sequence[str],
+    spec_roots: Optional[Sequence[str]] = None,
+    allowlist_path: Optional[str] = None,
+    catalog_path: Optional[str] = None,
+) -> AnalysisResult:
+    """Run all three passes over `roots` (package dirs or files).
+
+    spec_roots: where fault-spec literals are validated (tests/scripts);
+    catalog_path: committed fault-point catalog to check for staleness
+    (None = skip the staleness check, e.g. on fixture trees)."""
+    files = []
+    for root in roots:
+        files.extend(iter_py_files(root))
+    violations: List[Violation] = []
+    for path, rel in files:
+        violations.extend(blocking.scan_file(path, rel))
+        violations.extend(lock_order.scan_file(path, rel))
+    points = fault_registry.collect_points(files)
+    if catalog_path is not None:
+        violations.extend(fault_registry.check_catalog(points, catalog_path))
+    spec_files = []
+    for root in spec_roots or ():
+        spec_files.extend(iter_py_files(root))
+    # Specs validate against package points PLUS points the spec tree
+    # itself visits (tests exercise the fault plane with synthetic
+    # faults.point("p.x") calls; those are real points for their specs).
+    known = dict(points)
+    for name, locs in fault_registry.collect_points(spec_files).items():
+        known.setdefault(name, []).extend(locs)
+    violations.extend(fault_registry.validate_spec_files(spec_files, known))
+    allowed = (
+        allowlist_mod.load(allowlist_path) if allowlist_path and os.path.exists(allowlist_path)
+        else {}
+    )
+    violations.sort(key=lambda v: (v.rel, v.line, v.key))
+    return AnalysisResult(violations, allowed)

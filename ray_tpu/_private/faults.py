@@ -69,6 +69,7 @@ __all__ = [
     "InjectedFault",
     "configure",
     "disable",
+    "parse_spec",
     "point",
     "log",
     "stats",
@@ -267,6 +268,19 @@ def _parse_clause(text: str, index: int, seed_val: int) -> _Clause:
                    after, times, prob, at_s, match, proc)
 
 
+def parse_spec(spec: str, seed_val: int = 0) -> List[_Clause]:
+    """Parse a spec WITHOUT installing it.  The registry export: the
+    concurrency lint (analysis/fault_registry.py) validates every literal
+    spec in tests/scripts against the generated fault-point catalog with
+    this — the real parser, so the lint can never accept a spec the
+    runtime would reject.  Raises FaultSpecError on any typo."""
+    return [
+        _parse_clause(part.strip(), i, seed_val)
+        for i, part in enumerate(spec.split(";"))
+        if part.strip()
+    ]
+
+
 def configure(spec: str, seed_val: Optional[int] = None) -> None:
     """Parse + install a fault plan.  Raises FaultSpecError on any typo —
     never silently installs a partial plan."""
@@ -274,11 +288,7 @@ def configure(spec: str, seed_val: Optional[int] = None) -> None:
     if seed_val is None:
         seed_val = _parse_int("RAY_TPU_FAULT_SEED",
                               os.environ.get("RAY_TPU_FAULT_SEED", "0") or "0")
-    clauses = [
-        _parse_clause(part.strip(), i, seed_val)
-        for i, part in enumerate(spec.split(";"))
-        if part.strip()
-    ]
+    clauses = parse_spec(spec, seed_val)
     with _lock:
         _clauses = clauses
         _seed = seed_val

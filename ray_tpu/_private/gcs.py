@@ -16,6 +16,8 @@ round promotes this object behind a gRPC service without changing callers.
 from __future__ import annotations
 
 import threading
+
+from ray_tpu._private import lock_watchdog
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
@@ -73,7 +75,7 @@ class PlacementGroupInfo:
 
 class GlobalState:
     def __init__(self):
-        self.lock = threading.RLock()
+        self.lock = lock_watchdog.make_lock("GlobalState.lock", rlock=True)
         self.nodes: Dict[str, NodeInfo] = {}
         self.actors: Dict[str, ActorInfo] = {}
         self.named_actors: Dict[tuple, str] = {}  # (namespace, name) -> actor_id

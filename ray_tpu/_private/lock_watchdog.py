@@ -1,0 +1,235 @@
+"""Opt-in runtime lock watchdog — the dynamic twin of the static
+concurrency lint (ray_tpu/_private/analysis/).
+
+RAY_TPU_LOCK_WATCHDOG=1 makes the hot runtime locks (store, peer
+transport, runtime, worker_proc, gcs — every make_lock() hook point)
+instrumented wrappers that record, per thread, the acquisition order and
+hold times the static passes can only approximate, and report:
+
+  * ORDER INVERSIONS — lock B acquired while holding A after A was ever
+    acquired while holding B (the observed-order analogue of the
+    lock-order pass; TSAN's lock-order-inversion check works the same
+    way: it flags the inverted ORDER even when the interleaving didn't
+    deadlock this run);
+  * LONG HOLDS — any lock held longer than RAY_TPU_LOCK_HOLD_S seconds
+    (default 1.0; blocking I/O under a lock shows up here even when the
+    blocking call is hidden behind a call chain the lexical lint can't
+    see).
+
+Reports are collected in-process (reports()) and, when
+RAY_TPU_LOCK_WATCHDOG_DIR is set, appended to <dir>/<pid>.watchdog so a
+multi-process harness (the chaos soak) can assert ZERO reports across
+every process of the cluster.  The watchdog never raises and never
+blocks: detection must not perturb the schedule it observes.
+
+Disabled (the default), make_lock returns plain threading primitives —
+zero wrappers, zero overhead.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+ENABLED: bool = os.environ.get("RAY_TPU_LOCK_WATCHDOG") == "1"
+
+
+def _hold_threshold_s() -> float:
+    try:
+        return float(os.environ.get("RAY_TPU_LOCK_HOLD_S", "1.0"))
+    except ValueError:
+        return 1.0
+
+
+_registry_lock = threading.Lock()  # guards the structures below only
+_edges: Dict[Tuple[str, str], str] = {}  # (held, acquired) -> where first seen
+_reported_pairs: Set[frozenset] = set()
+_reported_holds: Set[Tuple[str, str]] = set()  # (lock, thread-name)
+_reports: List[str] = []
+_tls = threading.local()
+
+
+def _held_stack() -> List[str]:
+    try:
+        return _tls.held
+    except AttributeError:
+        _tls.held = []
+        return _tls.held
+
+
+def _emit(report: str) -> None:
+    with _registry_lock:
+        _reports.append(report)
+    out_dir = os.environ.get("RAY_TPU_LOCK_WATCHDOG_DIR")
+    if out_dir:
+        try:
+            with open(
+                os.path.join(out_dir, f"{os.getpid()}.watchdog"), "a"
+            ) as f:
+                f.write(report + "\n")
+        except OSError:
+            pass
+    import sys
+
+    print(f"[ray_tpu] LOCK WATCHDOG: {report}", file=sys.stderr, flush=True)
+
+
+def _record_acquire(name: str) -> None:
+    """Called with the lock JUST acquired.  Records order edges against
+    every lock this thread already holds and reports inversions."""
+    held = _held_stack()
+    for prior in held:
+        if prior == name:
+            continue
+        pair = (prior, name)
+        if pair not in _edges:  # racy pre-check; settled under the lock
+            with _registry_lock:
+                _edges.setdefault(
+                    pair, threading.current_thread().name
+                )
+        inverse = (name, prior)
+        if inverse in _edges:
+            key = frozenset(pair)
+            with _registry_lock:
+                if key in _reported_pairs:
+                    continue
+                _reported_pairs.add(key)
+                where = _edges[inverse]
+            _emit(
+                f"order inversion: acquired {name!r} while holding "
+                f"{prior!r} (thread {threading.current_thread().name}), "
+                f"but {prior!r} was previously acquired while holding "
+                f"{name!r} (thread {where}) — potential ABBA deadlock"
+            )
+    held.append(name)
+
+
+def _record_release(name: str, held_since: float) -> None:
+    held = _held_stack()
+    # Remove the innermost occurrence (non-LIFO release is legal).
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] == name:
+            del held[i]
+            break
+    dt = time.monotonic() - held_since
+    thr = _hold_threshold_s()
+    if dt > thr:
+        tname = threading.current_thread().name
+        with _registry_lock:
+            if (name, tname) in _reported_holds:
+                return
+            _reported_holds.add((name, tname))
+        _emit(
+            f"long hold: {name!r} held {dt:.3f}s (> {thr}s) by thread "
+            f"{tname} — blocking work under a lock?"
+        )
+
+
+class _WatchedLockBase:
+    """Context-manager + acquire/release surface over a real lock."""
+
+    _inner_factory = staticmethod(threading.Lock)
+
+    def __init__(self, name: str):
+        self._name = name
+        self._inner = self._inner_factory()
+        # per-thread (depth, t0) for reentrant holders; plain Lock depth
+        # is always 0/1
+        self._holds = threading.local()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            depth = getattr(self._holds, "depth", 0)
+            if depth == 0:
+                self._holds.t0 = time.monotonic()
+                _record_acquire(self._name)
+            self._holds.depth = depth + 1
+        return got
+
+    def release(self) -> None:
+        depth = getattr(self._holds, "depth", 0)
+        # Capture BEFORE the real release: after it another thread owns.
+        t0 = getattr(self._holds, "t0", None)
+        self._inner.release()
+        if depth > 0:
+            self._holds.depth = depth - 1
+            if depth == 1 and t0 is not None:
+                _record_release(self._name, t0)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __repr__(self) -> str:
+        return f"<WatchedLock {self._name} {self._inner!r}>"
+
+
+class WatchedLock(_WatchedLockBase):
+    _inner_factory = staticmethod(threading.Lock)
+
+
+class WatchedRLock(_WatchedLockBase):
+    _inner_factory = staticmethod(threading.RLock)
+
+    def _is_owned(self) -> bool:
+        # RAY_TPU_DEBUG_LOCKS ownership asserts call this (runtime._locked).
+        return self._inner._is_owned()
+
+
+def make_lock(name: str, rlock: bool = False):
+    """Hook point: construct a (possibly watched) lock.  Production pays
+    one module-bool check and gets the plain primitive."""
+    if not ENABLED:
+        return threading.RLock() if rlock else threading.Lock()
+    return WatchedRLock(name) if rlock else WatchedLock(name)
+
+
+def reports() -> List[str]:
+    with _registry_lock:
+        return list(_reports)
+
+
+def reset() -> None:
+    """Test hook: clear observed edges and reports (NOT the env gate)."""
+    with _registry_lock:
+        _edges.clear()
+        _reported_pairs.clear()
+        _reported_holds.clear()
+        _reports.clear()
+
+
+def collect_dir_reports(out_dir: str) -> List[str]:
+    """Every report written by any process into out_dir (soak harness)."""
+    out: List[str] = []
+    try:
+        names = sorted(os.listdir(out_dir))
+    except OSError:
+        return out
+    for fn in names:
+        if not fn.endswith(".watchdog"):
+            continue
+        try:
+            with open(os.path.join(out_dir, fn)) as f:
+                out.extend(
+                    f"{fn}: {line.rstrip()}" for line in f if line.strip()
+                )
+        except OSError:
+            pass
+    return out
+
+
+def _enable_for_tests(enabled: bool = True) -> None:
+    """Flip the gate in-process (tests); real runs use the env var so
+    child processes inherit it."""
+    global ENABLED
+    ENABLED = enabled

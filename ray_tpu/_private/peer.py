@@ -48,6 +48,7 @@ import threading
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from ray_tpu._private import faults
+from ray_tpu._private import lock_watchdog
 
 
 class PeerServer:
@@ -142,17 +143,21 @@ class PeerServer:
 
 
 class PeerReply:
-    """Send side of one accepted peer connection (executor threads share it)."""
+    """Send side of one accepted peer connection (executor threads share
+    it).  send_lock is a dedicated wire-serialization lock — it exists
+    only to keep concurrent reply frames from interleaving on the shared
+    conn, never wraps anything but the send, and is named for the
+    concurrency lint's serialization-idiom exemption."""
 
-    __slots__ = ("conn", "lock")
+    __slots__ = ("conn", "send_lock")
 
     def __init__(self, conn):
         self.conn = conn
-        self.lock = threading.Lock()
+        self.send_lock = lock_watchdog.make_lock("PeerReply.send_lock")
 
     def send(self, msg: tuple) -> None:
         try:
-            with self.lock:
+            with self.send_lock:
                 self.conn.send(msg)
         except (OSError, ValueError):
             pass  # caller vanished; its results are owner-lost
@@ -179,7 +184,7 @@ class PeerConn:
         self.conn = _connect_with_deadline(
             self.endpoint, authkey, _config.get("object_transfer_timeout_s")
         )
-        self.send_lock = threading.Lock()
+        self.send_lock = lock_watchdog.make_lock("PeerConn.send_lock")
         self.dead = False
         self._on_done = on_done
         self._on_death = on_death
@@ -314,7 +319,7 @@ class DirectTransport:
 
     def __init__(self, wr):
         self.wr = wr  # WorkerRuntime
-        self.lock = threading.Lock()
+        self.lock = lock_watchdog.make_lock("DirectTransport.lock")
         self.routes: Dict[str, Any] = {}  # actor_id -> ActorRoute | "head"
         self.conns: Dict[Tuple[str, int], PeerConn] = {}
         self.used_head_path: set = set()  # actor_ids relayed at least once

@@ -32,7 +32,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 import cloudpickle
 
 from ray_tpu._private import faults
-from ray_tpu._private import ids, serialization as ser
+from ray_tpu._private import ids, lock_watchdog, serialization as ser
 from ray_tpu._private.gcs import (
     ALIVE,
     DEAD,
@@ -415,7 +415,7 @@ class Runtime:
         self.namespace = namespace
         self.state = GlobalState()
         self.store = OwnerStore(self.session_name, spill_dir=f"/tmp/raytpu-spill-{self.session_name}")
-        self.lock = threading.RLock()
+        self.lock = lock_watchdog.make_lock("Runtime.lock", rlock=True)
         self.head_node_id = ids.node_id()
         if num_cpus is None:
             num_cpus = max(os.cpu_count() or 1, 4)
@@ -1534,24 +1534,42 @@ class Runtime:
                 if h is None:
                     conn.close()
                 return
-            h.conn = conn
             h.pid = first[2]
-            for msg in h.pending_sends:
+        # Flush messages queued while the worker was starting OFF the
+        # runtime lock (pipe I/O under the global lock stalls the whole
+        # control plane if the pipe buffer is full; the concurrency lint's
+        # blocking-under-lock pass flags the old shape).  Ordering holds:
+        # h.conn stays None until the backlog drains, so concurrent
+        # _send()s keep appending to pending_sends and every queued frame
+        # precedes the first direct send; no other thread sees this conn
+        # before the publication block below registers it.
+        while True:
+            with self.lock:
+                pending = h.pending_sends
+                if not pending:
+                    h.conn = conn
+                    if h.state == "starting":
+                        h.state = "idle"
+                        h.idle_since = time.monotonic()
+                        sp = self.starting_pool.get((h.node_id, h.env_key))
+                        if sp and wid in sp:
+                            sp.remove(wid)
+                        self.idle_pool.setdefault(
+                            (h.node_id, h.env_key), []
+                        ).append(wid)
+                    self._conn_to_worker[conn] = wid
+                    self._conns_version += 1
+                    self._grant_parked_leases(wid)
+                    break
+                h.pending_sends = []
+                self._pending_send_flushes = (
+                    getattr(self, "_pending_send_flushes", 0) + len(pending)
+                )
+            for msg in pending:
                 try:
                     conn.send(msg)
                 except OSError:
                     pass
-            h.pending_sends = []
-            if h.state == "starting":
-                h.state = "idle"
-                h.idle_since = time.monotonic()
-                sp = self.starting_pool.get((h.node_id, h.env_key))
-                if sp and wid in sp:
-                    sp.remove(wid)
-                self.idle_pool.setdefault((h.node_id, h.env_key), []).append(wid)
-            self._conn_to_worker[conn] = wid
-            self._conns_version += 1
-            self._grant_parked_leases(wid)
         with self.lock:
             self._dispatch()
 

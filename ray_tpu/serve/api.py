@@ -50,6 +50,13 @@ def start(
                     # parks one long-poll listener on a concurrency slot;
                     # leave generous headroom for control calls.
                     max_concurrency=64,
+                    # A crash-killed controller restarts instead of taking
+                    # the control plane down with it (ray: the serve
+                    # controller is detached + supervised the same way).
+                    # Deployment state is re-declared by the next deploy();
+                    # live replicas keep serving through the router tables
+                    # the proxy already holds.
+                    max_restarts=-1,
                 )
                 .remote()
             )
@@ -60,7 +67,12 @@ def start(
                 http_options = HTTPOptions(**http_options)
             _proxy = (
                 ray_tpu.remote(HTTPProxy)
-                .options(max_concurrency=32)
+                # max_restarts: a crash-killed proxy rebinds and serves
+                # again (PR 1 soak gap (c): it used to stay dead).  The
+                # restarted instance re-runs __init__ with the original
+                # creation args — controller handle included — and
+                # re-learns the routing table from the live controller.
+                .options(max_concurrency=32, max_restarts=-1)
                 .remote(
                     _controller, http_options.host, http_options.port,
                     http_options.max_connections,

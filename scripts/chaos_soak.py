@@ -330,8 +330,9 @@ def run_soak(
     out: Optional[str] = None,
     use_serve: bool = True,
     num_cpus: int = 4,
+    watch_locks: bool = True,
 ) -> Dict:
-    from ray_tpu._private import faults
+    from ray_tpu._private import faults, lock_watchdog
     from ray_tpu._private.head import launch_head_subprocess
 
     faults.configure(spec, seed)  # fail LOUDLY on a typo'd plan, up front
@@ -348,17 +349,35 @@ def run_soak(
             "RAY_TPU_FAULT_SPEC",
             "RAY_TPU_FAULT_SEED",
             "RAY_TPU_RECONNECT_WINDOW_S",
+            "RAY_TPU_LOCK_WATCHDOG",
+            "RAY_TPU_LOCK_WATCHDOG_DIR",
+            "RAY_TPU_LOCK_HOLD_S",
         )
     }
     os.environ["RAY_TPU_FAULT_SPEC"] = spec
     os.environ["RAY_TPU_FAULT_SEED"] = str(seed)
     os.environ["RAY_TPU_RECONNECT_WINDOW_S"] = "45"
+    watchdog_dir = os.path.join(workdir, "watchdog")
+    if watch_locks:
+        # Lock watchdog on across EVERY process of the soak cluster
+        # (children inherit the env; the driver flips its already-imported
+        # module gate directly).  Reports land in watchdog_dir per pid and
+        # any report fails the soak — order inversions and long holds must
+        # not ride along under chaos.  Hold threshold is looser than the
+        # 1s default: a 4-CPU CI box under storm-level GIL contention
+        # stretches legitimate dispatch holds.
+        os.makedirs(watchdog_dir, exist_ok=True)
+        os.environ["RAY_TPU_LOCK_WATCHDOG"] = "1"
+        os.environ["RAY_TPU_LOCK_WATCHDOG_DIR"] = watchdog_dir
+        os.environ.setdefault("RAY_TPU_LOCK_HOLD_S", "2.0")
+        lock_watchdog._enable_for_tests(True)
 
     report: Dict = {
         "seed": seed,
         "spec": spec,
         "duration_s": duration,
         "kills": {"head": 0, "daemon": 0},
+        "lock_watchdog": {"enabled": watch_locks, "reports": []},
         "result": "FAIL",
     }
     head = daemon = None
@@ -519,6 +538,11 @@ def run_soak(
         assert dup_execs >= 1, (
             "no task was ever re-executed: worker kill clauses never fired"
         )
+        if watch_locks:
+            wd = lock_watchdog.collect_dir_reports(watchdog_dir)
+            wd.extend(f"driver: {r}" for r in lock_watchdog.reports())
+            report["lock_watchdog"]["reports"] = wd
+            assert not wd, f"lock watchdog reports under chaos: {wd}"
         report["result"] = "PASS"
         return report
     except BaseException:
@@ -554,6 +578,10 @@ def run_soak(
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
+        if watch_locks:
+            lock_watchdog._enable_for_tests(
+                os.environ.get("RAY_TPU_LOCK_WATCHDOG") == "1"
+            )
         if out and report.get("result"):
             with open(out, "w") as f:
                 json.dump(report, f, indent=1, sort_keys=True)
@@ -568,6 +596,7 @@ def main(argv=None):
     ap.add_argument("--out", default=None)
     ap.add_argument("--no-serve", action="store_true")
     ap.add_argument("--num-cpus", type=int, default=4)
+    ap.add_argument("--no-lock-watchdog", action="store_true")
     args = ap.parse_args(argv)
     report = run_soak(
         duration=args.duration,
@@ -576,6 +605,7 @@ def main(argv=None):
         out=args.out,
         use_serve=not args.no_serve,
         num_cpus=args.num_cpus,
+        watch_locks=not args.no_lock_watchdog,
     )
     print(json.dumps(report, indent=1, sort_keys=True))
     return 0

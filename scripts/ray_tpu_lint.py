@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Concurrency lint CLI — ray_tpu's TSAN/clang-annotation stand-in.
+
+    python scripts/ray_tpu_lint.py [ray_tpu/] [--fix-allowlist] [-v]
+
+Runs the three analysis passes (blocking-under-lock, lock-order,
+fault-registry — see ray_tpu/_private/analysis/) over the package and
+exits non-zero on any violation not covered by the reviewed allowlist
+(ray_tpu/_private/analysis/allowlist.txt).  Tier-1 tests run this same
+entry point (tests/test_concurrency_lint.py), so a new blocking call
+under a lock fails CI before it costs a chaos soak to find.
+
+--fix-allowlist regenerates the allowlist DELIBERATELY (the only
+sanctioned way to grow it): current findings become the key set, existing
+justifications are preserved, new keys are marked "TODO: justify" (which
+the lint then reports until a human writes the reason).  It also rewrites
+the generated fault-point catalog (fault_points.txt).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from ray_tpu._private.analysis import run_analysis  # noqa: E402
+from ray_tpu._private.analysis import allowlist as allowlist_mod  # noqa: E402
+from ray_tpu._private.analysis import fault_registry  # noqa: E402
+from ray_tpu._private.analysis.common import iter_py_files  # noqa: E402
+
+DEFAULT_ALLOWLIST = os.path.join(
+    _REPO_ROOT, "ray_tpu", "_private", "analysis", "allowlist.txt"
+)
+DEFAULT_CATALOG = os.path.join(
+    _REPO_ROOT, "ray_tpu", "_private", "analysis", "fault_points.txt"
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "roots", nargs="*", default=[os.path.join(_REPO_ROOT, "ray_tpu")],
+        help="package dirs/files to analyze (default: ray_tpu/)",
+    )
+    ap.add_argument(
+        "--spec-roots", nargs="*",
+        default=[os.path.join(_REPO_ROOT, "tests"), os.path.join(_REPO_ROOT, "scripts")],
+        help="where fault-spec literals are validated (default: tests/ scripts/)",
+    )
+    ap.add_argument("--allowlist", default=DEFAULT_ALLOWLIST)
+    ap.add_argument("--catalog", default=DEFAULT_CATALOG)
+    ap.add_argument(
+        "--no-catalog-check", action="store_true",
+        help="skip the generated-catalog staleness check (fixture trees)",
+    )
+    ap.add_argument(
+        "--fix-allowlist", action="store_true",
+        help="regenerate allowlist keys + the fault-point catalog from "
+        "current findings (preserves existing justifications)",
+    )
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="also print allowlisted findings")
+    args = ap.parse_args(argv)
+
+    result = run_analysis(
+        args.roots,
+        spec_roots=args.spec_roots,
+        allowlist_path=args.allowlist,
+        catalog_path=None if args.no_catalog_check else args.catalog,
+    )
+
+    if args.fix_allowlist:
+        points = fault_registry.collect_points(
+            [f for root in args.roots for f in iter_py_files(root)]
+        )
+        fault_registry.write_catalog(points, args.catalog)
+        # Catalog staleness violations are cured by the rewrite above, so
+        # they never become allowlist entries.
+        keys = sorted(
+            {v.key for v in result.violations if not v.key.startswith("fault-registry:catalog:")}
+        )
+        existing = result.allowlist
+        merged, added, dropped = allowlist_mod.regenerate(existing, keys)
+        allowlist_mod.save(args.allowlist, merged)
+        print(f"allowlist: {len(merged)} entries "
+              f"(+{len(added)} new, -{len(dropped)} stale) -> {args.allowlist}")
+        for k in added:
+            print(f"  NEW (justify me): {k}")
+        print(f"catalog: {len(points)} fault points -> {args.catalog}")
+        return 0
+
+    by_pass = {}
+    for v in result.violations:
+        by_pass.setdefault(v.pass_name, []).append(v)
+    for pass_name in ("blocking-under-lock", "lock-order", "fault-registry"):
+        vs = by_pass.get(pass_name, [])
+        new = [v for v in vs if v.key not in result.allowlist]
+        print(
+            f"[{pass_name}] {len(vs)} finding(s), "
+            f"{len(vs) - len(new)} allowlisted, {len(new)} new"
+        )
+        for v in new:
+            print(f"  NEW: {v.message}")
+        if args.verbose:
+            for v in vs:
+                if v.key in result.allowlist:
+                    print(f"  allowlisted: {v.message}")
+                    print(f"    reason: {result.allowlist[v.key]}")
+
+    todo = allowlist_mod.unjustified(result.allowlist)
+    for k in todo:
+        print(f"  UNJUSTIFIED allowlist entry (write a reason): {k}")
+    for k in result.stale_allowlist:
+        print(f"  note: stale allowlist entry (no longer fires): {k}")
+
+    if result.new or todo:
+        print(
+            f"\nFAIL: {len(result.new)} new violation(s), "
+            f"{len(todo)} unjustified allowlist entr(ies).  Fix the code, or "
+            "review + run --fix-allowlist and write a justification."
+        )
+        return 1
+    print("\nOK: no new concurrency-lint violations.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -198,7 +198,10 @@ def test_chaos_soak_schedule_driven(tmp_path):
     workers (at their result-send hazard), a node daemon, and the head —
     with zero lost or duplicated results beyond retry budgets, and
     convergence to a quiescent, correct cluster afterwards.  On failure
-    the harness prints the seed + spec to replay."""
+    the harness prints the seed + spec to replay.  The lock watchdog
+    (RAY_TPU_LOCK_WATCHDOG=1) runs in every process of the cluster and
+    the soak requires ZERO reports: no lock-order inversion and no
+    over-threshold hold anywhere, even under the kill storm."""
     run_soak = _soak()
     report = run_soak(
         duration=65.0, seed=7, out=str(tmp_path / "CHAOS_soak.json")
@@ -207,6 +210,8 @@ def test_chaos_soak_schedule_driven(tmp_path):
     assert report["kills"]["head"] >= 1
     assert report["kills"]["daemon"] >= 1
     assert report["duplicate_executions"] >= 1  # worker kills fired + healed
+    assert report["lock_watchdog"]["enabled"]
+    assert report["lock_watchdog"]["reports"] == []
 
 
 @pytest.mark.slow

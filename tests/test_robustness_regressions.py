@@ -380,3 +380,104 @@ def test_spill_freed_race_delete_queued_not_synchronous(tmp_path):
         assert deletes and all(t != me for t in deletes)
     finally:
         store.destroy()
+
+
+# ------------------------------------------- PR 2: blocking-under-lock fixes
+
+
+def test_make_room_spill_io_runs_off_the_store_lock(tmp_path):
+    """_make_room used to run the whole LRU spill — including the pluggable
+    backend's put(), a network call on URI backends — inside self._lock,
+    stalling every store operation behind admission control.  The
+    concurrency lint flags that shape now; this regression pins the fix:
+    while a strict put is spilling on a SLOW backend, concurrent readers
+    of other objects must get through the store lock immediately."""
+    import numpy as np
+
+    from ray_tpu._private.store import OwnerStore
+
+    store = OwnerStore(
+        f"mrtest-{os.getpid()}",
+        spill_dir=str(tmp_path / "spill"),
+        capacity_bytes=500_000,
+    )
+    try:
+        real = store._spill_storage
+
+        class SlowStorage:
+            def put(self, o, data):
+                time.sleep(0.8)  # a slow network backend
+                return real.put(o, data)
+
+            def get(self, p):
+                return real.get(p)
+
+            def delete(self, p):
+                real.delete(p)
+
+            def destroy(self):
+                real.destroy()
+
+        store._spill_storage = SlowStorage()
+        store.put("victim", np.zeros(300_000, dtype=np.uint8))  # shm-sealed
+        store.put("tiny", 42)  # in-process memory store
+        t0 = time.monotonic()
+
+        worst = {"dt": 0.0}
+        stop = threading.Event()
+
+        def reader():
+            while not stop.is_set():
+                r0 = time.monotonic()
+                assert store.get_sealed("tiny") is not None
+                worst["dt"] = max(worst["dt"], time.monotonic() - r0)
+                time.sleep(0.005)
+
+        t = threading.Thread(target=reader)
+        t.start()
+        try:
+            # Triggers the LRU spill of "victim" through the slow backend.
+            store.put("incoming", np.zeros(300_000, dtype=np.uint8))
+        finally:
+            stop.set()
+            t.join(timeout=5)
+        assert time.monotonic() - t0 >= 0.8  # the slow spill really ran
+        assert "victim" in store._spilled and "incoming" in store._in_shm
+        assert worst["dt"] < 0.4, (
+            f"a reader stalled {worst['dt']:.3f}s behind the store lock "
+            "while _make_room was spilling — spill I/O is back under the lock"
+        )
+        # Transparent restore still works after the off-lock spill.
+        obj = store.get_sealed("victim")
+        assert obj is not None and obj.deserialize().shape == (300_000,)
+    finally:
+        store.destroy()
+
+
+def test_handshake_pending_send_flush_off_lock_preserves_order(
+    ray_start_regular,
+):
+    """_dispatch_handshake used to flush pending_sends while holding the
+    global runtime lock (pipe I/O under the control-plane lock).  The fix
+    drains the backlog off-lock BEFORE publishing the conn; this pins the
+    ordering contract: tasks queued to still-starting workers (the
+    pending_sends path) all execute, results land correctly, and at least
+    one flush actually exercised the drain loop."""
+    rt = _rt()
+
+    @ray_tpu.remote
+    def bump(x):
+        return x + 1
+
+    # Burst past the connected pool immediately: spawned-but-unconnected
+    # workers are leasable, so some of these queue into pending_sends and
+    # ride the off-lock flush when the worker says "ready".
+    for round_no in range(3):
+        refs = [bump.remote(i) for i in range(12)]
+        assert ray_tpu.get(refs, timeout=120) == [i + 1 for i in range(12)]
+        if getattr(rt, "_pending_send_flushes", 0) > 0:
+            break
+    assert getattr(rt, "_pending_send_flushes", 0) > 0, (
+        "no handshake ever drained a pending_sends backlog — the test "
+        "never exercised the flush path"
+    )
