@@ -18,7 +18,11 @@ equivalent as an AST lint over the package, run by tier-1 tests and
     (ray_tpu/_private/analysis/fault_points.txt), and every literal
     RAY_TPU_FAULT_SPEC / faults.configure() spec in tests+scripts
     validated against it (a typo'd spec silently injects nothing — false
-    robustness).
+    robustness);
+  * hot-send (hot_send.py) — direct `conn.send(...)` calls in the hot
+    streaming modules are reviewed allowlist entries: a new one must
+    route through wire.BatchingConn or justify bypassing coalescing
+    (silent regressions back to one-syscall-per-frame fail CI).
 
 Existing, reviewed sites live in allowlist.txt with one-line
 justifications; the lint fails only on NEW violations.  The runtime twin
@@ -32,10 +36,10 @@ import os
 from typing import Dict, List, Optional, Sequence
 
 from ray_tpu._private.analysis.common import Violation, iter_py_files
-from ray_tpu._private.analysis import blocking, fault_registry, lock_order
+from ray_tpu._private.analysis import blocking, fault_registry, hot_send, lock_order
 from ray_tpu._private.analysis import allowlist as allowlist_mod
 
-PASSES = ("blocking-under-lock", "lock-order", "fault-registry")
+PASSES = ("blocking-under-lock", "lock-order", "fault-registry", "hot-send")
 
 
 class AnalysisResult:
@@ -72,6 +76,7 @@ def run_analysis(
     for path, rel in files:
         violations.extend(blocking.scan_file(path, rel))
         violations.extend(lock_order.scan_file(path, rel))
+        violations.extend(hot_send.scan_file(path, rel))
     points = fault_registry.collect_points(files)
     if catalog_path is not None:
         violations.extend(fault_registry.check_catalog(points, catalog_path))

@@ -184,6 +184,27 @@ _DEFS: Dict[str, tuple] = {
         "actual hog — prestarted idle workers are never bigger) or "
         "'newest' spawned (ray: worker_killing_policy.h)",
     ),
+    "wire_batch_bytes": (
+        64 * 1024, int,
+        "control-plane frame coalescing: pending bytes at which a "
+        "BatchingConn flushes (one physical write per batch); 0 disables "
+        "batching entirely (every frame is its own write — the unbatched "
+        "comparison baseline; ray: gRPC stream buffering plays this role)",
+    ),
+    "wire_flush_us": (
+        200, int,
+        "linger bound on a pending control-frame batch: the background "
+        "flusher sweeps dirty conns after this many microseconds, so "
+        "fire-and-forget frames never wait longer than ~this (blocking "
+        "paths flush explicitly and never wait at all)",
+    ),
+    "wire_stats": (
+        0, int,
+        "1 = expose per-process wire counters (logical frames, physical "
+        "writes, bytes, flush-reason histogram) through the state API / "
+        "dashboard, emit them as a cluster event at shutdown, and have "
+        "workers report theirs to the head (counting itself is always on)",
+    ),
     "fault_spec": (
         "", str,
         "deterministic fault-injection plan (faults.py grammar: "

@@ -92,6 +92,9 @@ def attach(
             and os.path.isdir(head_store_dir)
         )
     conn.send(("driver_store", did, bool(shared_store)))
+    # Handshake done: the long-lived conn gets the coalescing sender
+    # (refop/put_ow oneway bursts become one write per request flush).
+    conn = wire.batching(conn)
 
     conn_lock = threading.Lock()
     store_dir = (
@@ -177,7 +180,7 @@ def _try_reconnect(rt) -> bool:
         # backlog, fail in-flight requests, replay subscriptions.  On a
         # second bounce mid-recovery, RETRY within the window — there is
         # no outer loop to re-enter here, unlike the worker recv loop.
-        if rt.reconnect_recover(c, lambda _c: None):
+        if rt.reconnect_recover(wire.batching(c), lambda _c: None):
             return True
         _time.sleep(0.5)
     return False

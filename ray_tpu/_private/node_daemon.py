@@ -125,7 +125,11 @@ def main() -> None:
     from ray_tpu._private.netutil import set_nodelay
 
     def connect():
-        c = wire.connect((host, port), authkey)
+        # Batching sender: heartbeats piggyback on whatever log_lines /
+        # worker_exited frames are pending — one physical write per loop
+        # tick instead of one per message (the flush sits right before
+        # the loop's blocking wait).
+        c = wire.batching(wire.connect((host, port), authkey))
         set_nodelay(c)
         c.send(
             (
@@ -140,6 +144,7 @@ def main() -> None:
                 os.getpid(),
             )
         )
+        c.flush()  # the head's handshake thread is waiting on this hello
         return c
 
     def reconnect():
@@ -421,22 +426,36 @@ def main() -> None:
                     conn.send(("heartbeat", node_id))
             except OSError:
                 pass  # EOF path below handles reconnection
+        # Flush-before-blocking-wait: the heartbeat above plus any pending
+        # log_lines / worker_exited / oom reports leave as one write.
         try:
-            waitset = [conn] + ([zyg["conn"]] if zyg["conn"] is not None else [])
-            ready = conn_wait(waitset, timeout=0.5)
-            has_msg = conn in ready
-        except (EOFError, OSError):
-            conn = reconnect()
-            if conn is None:
-                shutdown()
-                return
-            continue
+            conn.flush()
+        except OSError:
+            pass  # EOF path below handles reconnection
+        if conn.pending_frames():
+            has_msg = True  # a decoded batch tail would never wake wait()
+        else:
+            try:
+                waitset = [conn] + ([zyg["conn"]] if zyg["conn"] is not None else [])
+                ready = conn_wait(waitset, timeout=0.5)
+                has_msg = conn in ready
+            except (EOFError, OSError):
+                conn = reconnect()
+                if conn is None:
+                    shutdown()
+                    return
+                continue
         drain_zygote()
         reap()
         if not has_msg:
             continue
+        msgs = []
         try:
-            msg = conn.recv()
+            msgs.append(conn.recv())
+            while len(msgs) < 64 and conn.poll(0):
+                msgs.append(conn.recv())
+            while conn.pending_frames():
+                msgs.append(conn.recv())
         except (EOFError, OSError):
             # Head gone: reconnect in head-split mode, else this host's
             # pool dies with it.
@@ -445,55 +464,56 @@ def main() -> None:
                 shutdown()
                 return
             continue
-        kind = msg[0]
-        if kind == "spawn_worker":
-            _, wid, renv = msg
-            env = _build_worker_env(
-                wid, host, port, authkey_hex, session, renv, store_dir, node_id
-            )
-            if zyg["conn"] is None:
-                start_zygote()  # died/never started: next spawn forks
-            if not zygote_fork(wid, env):
-                outf, errf = open_worker_logs(log_dir, wid)
-                try:
-                    children[wid] = subprocess.Popen(
-                        [sys.executable, "-m", "ray_tpu._private.worker_proc"],
-                        env=env,
-                        close_fds=True,
-                        stdout=outf,
-                        stderr=errf,
-                    )
-                    spawn_ts[wid] = _time.monotonic()
-                finally:
-                    outf.close()
-                    errf.close()
-        elif kind == "kill_worker":
-            p = children.get(msg[1])
-            zpid = zpids.get(msg[1])
-            if p is not None:
-                try:
-                    p.terminate()
-                except OSError:
-                    pass
-                # reap() collects and reports it next cycle
-            elif zpid is not None and zpid > 0:
-                try:
-                    os.kill(zpid, signal.SIGTERM)
-                except OSError:
-                    pass
-                # the zygote reaps and reports it
-            elif zpid == -1:
-                # Fork in flight: remember the kill for the ("forked",
-                # pid) reply instead of dropping it.
-                pending_kills.add(msg[1])
-        elif kind == "delete_object":
-            # Owner freed the object (refcount hit zero): drop this node's
-            # copy (ray: the raylet's local object manager eviction on
-            # ownership release).
-            store.delete(msg[1])
-        elif kind == "shutdown":
-            shutdown()
-            return
+        for msg in msgs:
+            kind = msg[0]
+            if kind == "spawn_worker":
+                _, wid, renv = msg
+                env = _build_worker_env(
+                    wid, host, port, authkey_hex, session, renv, store_dir, node_id
+                )
+                if zyg["conn"] is None:
+                    start_zygote()  # died/never started: next spawn forks
+                if not zygote_fork(wid, env):
+                    outf, errf = open_worker_logs(log_dir, wid)
+                    try:
+                        children[wid] = subprocess.Popen(
+                            [sys.executable, "-m", "ray_tpu._private.worker_proc"],
+                            env=env,
+                            close_fds=True,
+                            stdout=outf,
+                            stderr=errf,
+                        )
+                        spawn_ts[wid] = _time.monotonic()
+                    finally:
+                        outf.close()
+                        errf.close()
+            elif kind == "kill_worker":
+                p = children.get(msg[1])
+                zpid = zpids.get(msg[1])
+                if p is not None:
+                    try:
+                        p.terminate()
+                    except OSError:
+                        pass
+                    # reap() collects and reports it next cycle
+                elif zpid is not None and zpid > 0:
+                    try:
+                        os.kill(zpid, signal.SIGTERM)
+                    except OSError:
+                        pass
+                    # the zygote reaps and reports it
+                elif zpid == -1:
+                    # Fork in flight: remember the kill for the ("forked",
+                    # pid) reply instead of dropping it.
+                    pending_kills.add(msg[1])
+            elif kind == "delete_object":
+                # Owner freed the object (refcount hit zero): drop this
+                # node's copy (ray: the raylet's local object manager
+                # eviction on ownership release).
+                store.delete(msg[1])
+            elif kind == "shutdown":
+                shutdown()
+                return
 
 
 if __name__ == "__main__":

@@ -144,15 +144,20 @@ class PeerServer:
 
 class PeerReply:
     """Send side of one accepted peer connection (executor threads share
-    it).  send_lock is a dedicated wire-serialization lock — it exists
-    only to keep concurrent reply frames from interleaving on the shared
-    conn, never wraps anything but the send, and is named for the
-    concurrency lint's serialization-idiom exemption."""
+    it).  Replies ride a BatchingConn: back-to-back pdone frames from a
+    pipelined caller coalesce into one physical write (the executor's
+    idle point and the linger sweep flush them — worker_proc main loop).
+    send_lock is a dedicated wire-serialization lock — it exists only to
+    keep concurrent reply frames from interleaving on the shared conn,
+    never wraps anything but the send, and is named for the concurrency
+    lint's serialization-idiom exemption."""
 
     __slots__ = ("conn", "send_lock")
 
     def __init__(self, conn):
-        self.conn = conn
+        from ray_tpu._private import wire as _wire
+
+        self.conn = _wire.batching(conn)
         self.send_lock = lock_watchdog.make_lock("PeerReply.send_lock")
 
     def send(self, msg: tuple) -> None:
@@ -181,8 +186,18 @@ class PeerConn:
             # error -> OSError out of the constructor: the route falls back
             # exactly as for a real connect failure (relay / retry).
             faults.point("peer.connect", key=f"{endpoint[0]}:{endpoint[1]}")
-        self.conn = _connect_with_deadline(
-            self.endpoint, authkey, _config.get("object_transfer_timeout_s")
+        from ray_tpu._private import wire as _wire
+
+        # Batching sender: a client's tight submit loop coalesces its
+        # pcall pushes into one write per flush wave (the caller's
+        # blocking points — get_local — flush explicitly; the linger
+        # sweep bounds fire-and-forget latency).  A flush failure marks
+        # the conn broken, so send() below still reports death at the
+        # call site like the unbatched conn did.
+        self.conn = _wire.batching(
+            _connect_with_deadline(
+                self.endpoint, authkey, _config.get("object_transfer_timeout_s")
+            )
         )
         self.send_lock = lock_watchdog.make_lock("PeerConn.send_lock")
         self.dead = False
@@ -206,6 +221,13 @@ class PeerConn:
             return True
         except (OSError, ValueError):
             return False
+
+    def flush(self) -> None:
+        """Push any pending pcall batch now (cancel paths, re-drives)."""
+        try:
+            self.conn.flush()
+        except (OSError, ValueError):
+            pass  # the recv loop's EOF owns the death handling
 
     def _recv_loop(self) -> None:
         while True:
@@ -913,6 +935,11 @@ class DirectTransport:
                     r.conn = conn
                     r.state = "direct"
                     r.recover_started = False
+                # The re-driven backlog must not sit in a batch (duck-typed:
+                # unit tests drive this path with plain mock conns).
+                flush = getattr(conn, "flush", None)
+                if flush is not None:
+                    flush()
                 if send_failed:
                     self._fail_inflight_on(conn)  # re-enters recovery
                 return
@@ -996,6 +1023,11 @@ class DirectTransport:
                 self.wr.unborrow_ref(c)
             return True
         conn.send(("pcancel", tid))
+        # Urgency frame: waiting in a batch lets the doomed call start
+        # (duck-typed — tests drive this path with plain mock conns).
+        flush = getattr(conn, "flush", None)
+        if flush is not None:
+            flush()
         return True
 
     # -- ownership -----------------------------------------------------------
@@ -1100,6 +1132,13 @@ class DirectTransport:
             dr = self.results.get(oid)
         if dr is None:
             return False, None
+        if not dr.event.is_set():
+            # Flush-before-blocking-wait: the pcall's companion oneways
+            # (borrow refops) and anything else pending must be on the
+            # wire before this thread parks on the result.
+            from ray_tpu._private import wire as _wire
+
+            _wire.flush_dirty()
         if not dr.event.wait(timeout):
             from ray_tpu.exceptions import GetTimeoutError
 

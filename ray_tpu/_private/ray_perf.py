@@ -26,16 +26,43 @@ import ray_tpu
 
 
 def timeit(name: str, fn: Callable[[], int], warmup: int = 1, repeat: int = 3):
-    """Run fn (returns ops count) repeat times; report best ops/s."""
+    """Run fn (returns ops count) repeat times; report the MEDIAN ops/s as
+    the headline (all runs listed).  Best-of-N on a shared host with ±35%
+    variance reports the luckiest scheduling window, which both masks and
+    fakes real regressions/wins — the median is the honest number.
+
+    Also reports this process's physical control-plane writes per op
+    (wire.stats delta over the timed runs): the deterministic coalescing
+    metric that doesn't care about host noise."""
+    import statistics
+
+    from ray_tpu._private import wire as _wire
+
     for _ in range(warmup):
         fn()
-    best = 0.0
+    runs: List[float] = []
+    w0 = _wire.stats()
+    total_ops = 0
     for _ in range(repeat):
         t0 = time.perf_counter()
         ops = fn()
         dt = time.perf_counter() - t0
-        best = max(best, ops / dt)
-    return {"name": name, "ops_per_s": round(best, 1)}
+        runs.append(round(ops / dt, 1))
+        total_ops += ops
+    w1 = _wire.stats()
+    out = {
+        "name": name,
+        "ops_per_s": round(statistics.median(runs), 1),
+        "runs": runs,
+    }
+    if total_ops:
+        out["writes_per_op"] = round(
+            (w1["physical_writes"] - w0["physical_writes"]) / total_ops, 3
+        )
+        out["frames_per_op"] = round(
+            (w1["logical_frames"] - w0["logical_frames"]) / total_ops, 3
+        )
+    return out
 
 
 @ray_tpu.remote
@@ -208,16 +235,23 @@ def bench_put_gigabytes(total_gb: float = 1.0, chunk_mb: int = 100) -> Dict:
             assert v.nbytes == chunk.nbytes
         return 1
 
-    # report GB/s moved (put+get of total_gb counts as total_gb)
+    # report GB/s moved (put+get of total_gb counts as total_gb); median
+    # of the timed runs, same honesty rule as timeit
+    import statistics
+
     for _ in range(1):
         run()
-    best = 0.0
-    for _ in range(2):
+    runs = []
+    for _ in range(3):
         t0 = time.perf_counter()
         run()
         dt = time.perf_counter() - t0
-        best = max(best, total_gb / dt)
-    return {"name": "single_client_put_gigabytes", "gb_per_s": round(best, 2)}
+        runs.append(round(total_gb / dt, 2))
+    return {
+        "name": "single_client_put_gigabytes",
+        "gb_per_s": round(statistics.median(runs), 2),
+        "runs": runs,
+    }
 
 
 ALL = [
@@ -243,7 +277,18 @@ def main(argv=None):
     # not core count; without it a small host can't place the n:n actor
     # pairs at all (the reference runs these on 64-core machines).
     ray_tpu.init(num_cpus=max(_os.cpu_count() or 1, 16), ignore_reinit_error=True)
-    results = [{"name": "host_note", "nproc": _os.cpu_count()}]
+    results = [
+        {
+            "name": "host_note",
+            "nproc": _os.cpu_count(),
+            "note": (
+                "ops_per_s is the MEDIAN of the 3 runs ('runs' lists all); "
+                "writes_per_op / frames_per_op are this process's wire-"
+                "counter deltas (physical writes vs logical control frames "
+                "per op — the frame-coalescing factor)"
+            ),
+        }
+    ]
     for bench in ALL:
         r = bench()
         results.append(r)

@@ -9,6 +9,9 @@ releases the underlying object (ray: src/ray/core_worker/reference_count.h:61).
 
 from __future__ import annotations
 
+import collections
+import os
+import threading
 from typing import Any, Callable, Optional
 
 # Process-local hook installed by the runtime so that ObjectRef GC can
@@ -17,11 +20,66 @@ from typing import Any, Callable, Optional
 _release_hook: Optional[Callable[[str], None]] = None
 _addref_hook: Optional[Callable[[str], None]] = None
 
+# Releases are DEFERRED out of __del__: GC runs at arbitrary allocation
+# points — including while the current thread holds the transport or wire
+# locks the release hooks themselves take (DirectTransport.decref /
+# oneway's conn lock).  A synchronous hook there is a self-deadlock on a
+# plain lock and an ABBA inversion otherwise (the chaos soak's lock
+# watchdog caught exactly this under batch-flush allocation pressure).
+# __del__ therefore only appends to a GIL-atomic deque; a tiny daemon
+# thread drains it in FIFO order.  Guard ADDS stay synchronous, so the
+# "add before any later del" ordering the ownership protocol needs is
+# unchanged — dels only ever get later, which is always safe.
+_pending_releases: "collections.deque[str]" = collections.deque()
+_release_event = threading.Event()
+_drainer_lock = threading.Lock()
+_drainer_pid: Optional[int] = None
+
+
+def _drain_releases() -> None:
+    import time as _time
+
+    while True:
+        _release_event.wait()
+        # Let a burst accumulate before draining: releases are not
+        # latency-critical, and waking per-ref would turn a put/task loop
+        # into a context-switch storm on small hosts.
+        _time.sleep(0.001)
+        _release_event.clear()
+        while True:
+            try:
+                oid = _pending_releases.popleft()
+            except IndexError:
+                break
+            hook = _release_hook
+            if hook is None:
+                continue  # hooks uninstalled (shutdown): drop, as before
+            try:
+                hook(oid)
+            except Exception:
+                pass
+
+
+def _ensure_drainer() -> None:
+    """Start (or, after a fork, restart) the release drainer.  Called from
+    set_ref_hooks — normal context, never from __del__."""
+    global _drainer_pid
+    with _drainer_lock:
+        if _drainer_pid == os.getpid():
+            return
+        _drainer_pid = os.getpid()
+        _pending_releases.clear()  # a forked parent's queue is not ours
+        threading.Thread(
+            target=_drain_releases, daemon=True, name="raytpu-ref-release"
+        ).start()
+
 
 def set_ref_hooks(addref, release) -> None:
     global _release_hook, _addref_hook
     _addref_hook = addref
     _release_hook = release
+    if release is not None:
+        _ensure_drainer()
 
 
 class ObjectRef:
@@ -54,9 +112,14 @@ class ObjectRef:
         return f"ObjectRef({self._id})"
 
     def __del__(self):
+        # Never call the hook here: __del__ runs at arbitrary GC points,
+        # possibly while THIS thread holds the very locks the hook takes.
+        # Queue the release for the drainer thread instead.
         if _release_hook is not None:
             try:
-                _release_hook(self._id)
+                _pending_releases.append(self._id)
+                if not _release_event.is_set():  # one wake per burst
+                    _release_event.set()
             except Exception:
                 pass
 

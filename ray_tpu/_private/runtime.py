@@ -33,6 +33,7 @@ import cloudpickle
 
 from ray_tpu._private import faults
 from ray_tpu._private import ids, lock_watchdog, serialization as ser
+from ray_tpu._private import wire as _wire
 from ray_tpu._private.gcs import (
     ALIVE,
     DEAD,
@@ -529,6 +530,10 @@ class Runtime:
         from collections import defaultdict
 
         self.req_counts: Dict[str, int] = defaultdict(int)
+        # Per-process wire counters reported by workers/drivers (their
+        # physical-write coalescing is invisible to the head's own
+        # counters) when RAY_TPU_WIRE_STATS=1.
+        self.worker_wire_stats: Dict[str, Dict[str, int]] = {}
         # Direct transport directory: worker_id -> peer (host, port) from
         # the ready handshake (ray: worker addresses in the GCS worker
         # table, resolved once per caller and cached).
@@ -1400,6 +1405,16 @@ class Runtime:
                 pass
 
     def _dispatch_handshake(self, conn, first) -> None:
+        from ray_tpu._private import wire
+
+        if first[0] in ("ready", "driver", "daemon"):
+            # Long-lived control conns get the coalescing sender: the
+            # head's reply/pub/fence streams to this peer ride one
+            # physical write per flush wave instead of one per frame.
+            # Wrapped BEFORE registration so every map (conn_to_*,
+            # selector) holds the same object identity.  One-shot conns
+            # (kv/object fetch) and the zygote stay direct.
+            conn = wire.batching(conn)
         if first[0] == "kv_fetch":
             # One-shot fetch channel: a STARTING worker materializes its
             # runtime-env packages before its main conn says "ready"
@@ -1782,95 +1797,70 @@ class Runtime:
             for conn in readable:
                 nid = self._conn_to_daemon.get(conn)
                 if nid is not None:
+                    # Drain the whole readable run INCLUDING decoded batch
+                    # sub-frames: a daemon's heartbeat piggybacks on its
+                    # log_lines/worker_exited batch, and a buffered tail
+                    # would otherwise strand until the next physical frame.
+                    dmsgs = []
                     try:
-                        dmsg = conn.recv()
+                        dmsgs.append(conn.recv())
+                        while len(dmsgs) < 256 and conn.poll(0):
+                            dmsgs.append(conn.recv())
+                        while conn.pending_frames():
+                            dmsgs.append(conn.recv())
                     except (EOFError, OSError):
+                        for dmsg in dmsgs:
+                            self._handle_daemon_msg(nid, dmsg)
                         with self.lock:
                             self._conn_to_daemon.pop(conn, None)
                             self._conns_version += 1
                             self._on_daemon_death(nid)
                         continue
-                    if isinstance(dmsg, tuple) and dmsg and dmsg[0] == "log_lines":
-                        # A remote node's monitor forwarded fresh worker
-                        # output: same sink as head-local files.
-                        self._on_log_lines(dmsg[1], dmsg[2], dmsg[3])
-                        continue
-                    if isinstance(dmsg, tuple) and dmsg and dmsg[0] == "heartbeat":
-                        self._daemon_heartbeats[nid] = time.monotonic()
-                        continue
-                    if isinstance(dmsg, tuple) and dmsg and dmsg[0] == "worker_oom_killed":
-                        with self.lock:
-                            self._oom_kills[dmsg[1]] = dmsg[2:]
-                        continue
-                    if isinstance(dmsg, tuple) and dmsg and dmsg[0] == "worker_exited":
-                        # A remote child died (possibly before connecting):
-                        # the driver-side reaper can't see it, the daemon can.
-                        with self.lock:
-                            h = self.workers.get(dmsg[1])
-                            if h is not None and isinstance(h.proc, _RemoteProcHandle):
-                                h.proc.dead = True
-                            self._deferred_crashes.pop(dmsg[1], None)
-                            if h is not None and h.state != "dead":
-                                # The daemon's report is authoritative on
-                                # WHY: its OOM rider survives even when the
-                                # victim's own conn EOF won the message race.
-                                if len(dmsg) > 3 and dmsg[3] is not None:
-                                    self._oom_kills.setdefault(
-                                        dmsg[1], tuple(dmsg[3])
-                                    )
-                                if (
-                                    h.conn is None
-                                    and h.state == "starting"
-                                    and dmsg[1] not in self._oom_kills
-                                    and dmsg[1] not in self._env_failures
-                                ):
-                                    # A starting worker that died without
-                                    # connecting usually failed env setup;
-                                    # its env_failed hello rides a separate
-                                    # conn — wait briefly so the crash
-                                    # classifies as RuntimeEnvSetupError,
-                                    # not a retriable generic death.
-                                    self._deferred_crashes[dmsg[1]] = (
-                                        time.monotonic() + 2.0
-                                    )
-                                else:
-                                    self._on_worker_crash(dmsg[1])
-                            else:
-                                # Crash already classified (EOF saw the
-                                # earlier worker_oom_killed): drop any
-                                # re-inserted rider or it leaks forever.
-                                self._oom_kills.pop(dmsg[1], None)
+                    for dmsg in dmsgs:
+                        self._handle_daemon_msg(nid, dmsg)
                     continue
                 did = self._conn_to_driver.get(conn)
                 if did is not None:
+                    # Drain like a worker conn (attached drivers batch
+                    # their oneway/req streams too), including any decoded
+                    # sub-frames left past the cap.
+                    eof = False
+                    msgs = []
                     try:
-                        msg = conn.recv()
+                        msgs.append(conn.recv())
+                        while len(msgs) < 256 and conn.poll(0):
+                            msgs.append(conn.recv())
+                        while conn.pending_frames():
+                            msgs.append(conn.recv())
                     except (EOFError, OSError):
-                        with self.lock:
-                            self._conn_to_driver.pop(conn, None)
-                            self._conns_version += 1
-                            superseded = self.drivers.get(did) is not conn
-                        if not superseded:
-                            window = _cfg.get("reconnect_window_s")
-                            if window > 0:
-                                # Transient reset on a LIVE head: give the
-                                # driver's reconnect loop a beat before
-                                # freeing its refs and killing its actors
-                                # (a same-millisecond EOF would otherwise
-                                # always beat the re-handshake).
-                                with self.lock:
-                                    self._driver_death_grace[did] = (
-                                        time.monotonic() + min(window, 5.0)
-                                    )
-                            else:
-                                self._on_driver_death(did)
-                        continue
-                    try:
-                        self._handle_msg(did, msg)
-                    except Exception:
-                        import traceback
+                        eof = True
+                    for msg in msgs:
+                        try:
+                            self._handle_msg(did, msg)
+                        except Exception:
+                            import traceback
 
-                        traceback.print_exc()
+                            traceback.print_exc()
+                    if not eof:
+                        continue
+                    with self.lock:
+                        self._conn_to_driver.pop(conn, None)
+                        self._conns_version += 1
+                        superseded = self.drivers.get(did) is not conn
+                    if not superseded:
+                        window = _cfg.get("reconnect_window_s")
+                        if window > 0:
+                            # Transient reset on a LIVE head: give the
+                            # driver's reconnect loop a beat before
+                            # freeing its refs and killing its actors
+                            # (a same-millisecond EOF would otherwise
+                            # always beat the re-handshake).
+                            with self.lock:
+                                self._driver_death_grace[did] = (
+                                    time.monotonic() + min(window, 5.0)
+                                )
+                        else:
+                            self._on_driver_death(did)
                     continue
                 wid = self._conn_to_worker.get(conn)
                 if wid is None:
@@ -1880,12 +1870,17 @@ class Runtime:
                 # lock round-trips convoy against the N submitting client
                 # threads (measured: 4-client task throughput collapsed 4x
                 # with per-message locking; the reference batches the same
-                # way in its io-service event handlers).
+                # way in its io-service event handlers).  The cap bounds
+                # PHYSICAL reads; decoded batch sub-frames past it are
+                # drained too — the socket shows no data for them, so the
+                # selector would never wake for a buffered tail.
                 eof = False
                 msgs = []
                 try:
                     msgs.append(conn.recv())
                     while len(msgs) < 256 and conn.poll(0):
+                        msgs.append(conn.recv())
+                    while conn.pending_frames():
                         msgs.append(conn.recv())
                 except (EOFError, OSError):
                     eof = True
@@ -1908,6 +1903,59 @@ class Runtime:
                             self._deferred_crashes[wid] = time.monotonic() + 2.0
                         else:
                             self._on_worker_crash(wid)
+            # End of the select round: every reply/pub/fence queued while
+            # handling this wave goes out as one physical write per conn
+            # (the flush-before-blocking-wait rule — select() is this
+            # thread's blocking wait).
+            _wire.flush_dirty()
+
+    def _handle_daemon_msg(self, nid: str, dmsg) -> None:
+        if not (isinstance(dmsg, tuple) and dmsg):
+            return
+        if dmsg[0] == "log_lines":
+            # A remote node's monitor forwarded fresh worker output: same
+            # sink as head-local files.
+            self._on_log_lines(dmsg[1], dmsg[2], dmsg[3])
+        elif dmsg[0] == "heartbeat":
+            self._daemon_heartbeats[nid] = time.monotonic()
+        elif dmsg[0] == "worker_oom_killed":
+            with self.lock:
+                self._oom_kills[dmsg[1]] = dmsg[2:]
+        elif dmsg[0] == "worker_exited":
+            # A remote child died (possibly before connecting): the
+            # driver-side reaper can't see it, the daemon can.
+            with self.lock:
+                h = self.workers.get(dmsg[1])
+                if h is not None and isinstance(h.proc, _RemoteProcHandle):
+                    h.proc.dead = True
+                self._deferred_crashes.pop(dmsg[1], None)
+                if h is not None and h.state != "dead":
+                    # The daemon's report is authoritative on WHY: its OOM
+                    # rider survives even when the victim's own conn EOF
+                    # won the message race.
+                    if len(dmsg) > 3 and dmsg[3] is not None:
+                        self._oom_kills.setdefault(dmsg[1], tuple(dmsg[3]))
+                    if (
+                        h.conn is None
+                        and h.state == "starting"
+                        and dmsg[1] not in self._oom_kills
+                        and dmsg[1] not in self._env_failures
+                    ):
+                        # A starting worker that died without connecting
+                        # usually failed env setup; its env_failed hello
+                        # rides a separate conn — wait briefly so the
+                        # crash classifies as RuntimeEnvSetupError, not a
+                        # retriable generic death.
+                        self._deferred_crashes[dmsg[1]] = (
+                            time.monotonic() + 2.0
+                        )
+                    else:
+                        self._on_worker_crash(dmsg[1])
+                else:
+                    # Crash already classified (EOF saw the earlier
+                    # worker_oom_killed): drop any re-inserted rider or it
+                    # leaks forever.
+                    self._oom_kills.pop(dmsg[1], None)
 
     # ------------------------------------------------------------------
     # message handling
@@ -2040,6 +2088,12 @@ class Runtime:
             # latency path like task events.
             with self.lock:
                 self.trace_spans.extend(msg[1])
+        elif kind == "wire_stats":
+            # Per-process wire counters reported by workers/drivers when
+            # RAY_TPU_WIRE_STATS=1 (keyed by sender; cluster_metrics sums
+            # them with the head's own counters).
+            with self.lock:
+                self.worker_wire_stats[wid] = dict(msg[1])
         elif kind == "direct_lineage":
             # A lease-dispatched task produced shm results: remember its
             # spec so the head can re-execute the producer if the bytes are
@@ -2198,12 +2252,25 @@ class Runtime:
                         self.remote_subs.pop(ck, None)
 
     def _pub_sender_loop(self) -> None:
+        import queue as _queue
+
         while not getattr(self, "_shutdown", False):
             try:
                 wid, msg = self._pub_queue.get(timeout=1.0)
             except Exception:
                 continue
-            self._reply_raw(wid, msg)
+            # Drain the whole publish WAVE before flushing: a publish
+            # fanning to N subscribers (or a burst of publishes) lands as
+            # one physical write per subscriber conn, replacing the old
+            # per-subscriber per-message write loop.
+            while True:
+                self._reply_raw(wid, msg)
+                try:
+                    wid, msg = self._pub_queue.get_nowait()
+                except _queue.Empty:
+                    break
+            # This thread is about to block in get(): flush first.
+            _wire.flush_dirty()
 
     def _reply_raw(self, wid: str, msg: tuple) -> None:
         # Resolve the conn UNDER the lock, send OUTSIDE it: a subscriber
@@ -3548,6 +3615,10 @@ class Runtime:
         import time as _time
 
         deadline = None if timeout is None else _time.monotonic() + timeout
+        # Flush-before-blocking-wait: task/kill frames this thread queued
+        # (local-mode submits run on the caller's thread) must be on the
+        # wire before we park on their results.
+        _wire.flush_dirty()
         ready = self.store.wait(oids, len(oids), timeout)
         if len(ready) < len(oids):
             raise GetTimeoutError(f"get timed out after {timeout}s")
@@ -3574,6 +3645,7 @@ class Runtime:
             remaining = (
                 None if deadline is None else max(deadline - _time.monotonic(), 0.0)
             )
+            _wire.flush_dirty()  # the reconstruction dispatch just queued
             if not self.store.wait([oid], 1, remaining):
                 raise GetTimeoutError(f"reconstruction of {oid} timed out")
         raise ObjectLostError(oid)
@@ -3594,6 +3666,7 @@ class Runtime:
 
     def wait_refs(self, refs, num_returns=1, timeout=None):
         oids = [r.id for r in refs]
+        _wire.flush_dirty()  # same rule as get(): flush before parking
         ready_set = set(self.store.wait(oids, num_returns, timeout))
         ready, not_ready = [], []
         for r in refs:
@@ -3768,6 +3841,13 @@ class Runtime:
         except Exception:
             pass
         try:
+            if _wire.stats_enabled():
+                # Final per-process counters into the event log (workers'
+                # snapshots were folded in live via their wire_stats
+                # reports — see _handle_msg).
+                self.events.emit(
+                    "INFO", "wire", "head wire stats", **_wire.stats()
+                )
             self.events.emit("INFO", "runtime", "session shutting down")
             self.events.close()
         except Exception:
@@ -3794,6 +3874,9 @@ class Runtime:
                 h.proc.terminate()
             except Exception:
                 pass
+        # The kill/shutdown frames above are queued on batching conns:
+        # push them out before the fds die with the process.
+        _wire.flush_dirty()
         try:
             self.listener.close()
         except OSError:

@@ -1,4 +1,4 @@
-"""Versioned, schema-validated control-plane framing.
+"""Versioned, schema-validated control-plane framing + frame coalescing.
 
 ray: src/ray/protobuf/*.proto — the reference's control plane is typed
 protobuf-over-gRPC with versioned services.  Rounds 1-3 here sent raw
@@ -8,7 +8,7 @@ with arbitrary unpickling errors mid-stream) and no message validation
 
 This module gives every control connection:
 
-  * a 4-byte frame header (magic + u16 protocol version) on EVERY frame —
+  * a frame header (magic + u16 protocol version) on EVERY frame —
     a peer speaking a different protocol version fails at the first recv
     with a clean ProtocolError naming both versions, instead of a pickle
     traceback deep in a handler;
@@ -21,16 +21,45 @@ This module gives every control connection:
     `recv_bytes` / `fileno`) for the object-transfer body path, which is
     not pickled at all.
 
+Protocol v2 adds the BATCH frame: one physical write carrying N
+schema-validated sub-frames.  PROFILE_r5.md showed the head's steady
+state is raw syscall traffic — one posix.write and one epoll wakeup per
+logical control message (the reference amortizes this for free through
+gRPC stream buffering and its batched syncer/pubsub messages,
+src/ray/ray_syncer/ + pubsub/publisher.h).  `BatchingConn` is the sender
+side: messages queue into a pending buffer and flush on
+
+  (a) size      — pending bytes reach RAY_TPU_WIRE_BATCH_BYTES (~64KB);
+  (b) linger    — a short background sweep (RAY_TPU_WIRE_FLUSH_US,
+                  ~200µs) bounds the delay of fire-and-forget frames;
+  (c) explicit  — `flush()` / `flush_dirty()` BEFORE ANY BLOCKING WAIT,
+                  so latency-sensitive request/reply paths never stall
+                  behind the linger.  This is a RULE for new send paths:
+                  queue freely, but flush before you park.
+
+Per-sub-frame ordering, schema validation, and `wire.send`/`wire.recv`
+fault-injection semantics are preserved: a `drop` clause drops an
+individual sub-frame, never the whole batch; the new `wire.flush` point
+covers the physical write (crash = batch lost mid-flight).  A malformed
+sub-frame rejects the WHOLE batch at the boundary (no partial dispatch),
+and a truncated batch body is a clean ProtocolError.
+
 TypedConn wraps a multiprocessing.connection.Connection and preserves its
 surface (send/recv/poll/fileno/close), so `multiprocessing.connection
-.wait` and the recv_into fast path keep working unchanged.
+.wait` and the recv_into fast path keep working unchanged; decoded batch
+sub-frames queue receiver-side and `recv()` hands them out in order
+(`poll()` reports them, `pending_frames()` exposes the count so drain
+loops never strand a buffered tail behind an idle socket).
 """
 
 from __future__ import annotations
 
+import os
 import pickle
 import struct
-from typing import Any, Dict, Optional, Tuple
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import faults
 from ray_tpu._private import lock_watchdog
@@ -44,8 +73,16 @@ def _kind(obj: Any) -> Optional[str]:
     return None
 
 MAGIC = b"RT"
-PROTOCOL_VERSION = 1
+# Batch frames carry their own magic so a v2 receiver can tell one
+# physical write of N sub-frames from a plain single frame; a v1 receiver
+# fails both shapes with the same clean bad-magic/version error.
+MAGIC_BATCH = b"RB"
+# v2: batch frames exist (single frames are wire-compatible with v1, but
+# any conn may now carry a batch, so the version must fence old peers).
+PROTOCOL_VERSION = 2
 _HEADER = struct.pack("<2sH", MAGIC, PROTOCOL_VERSION)
+_BATCH_HEADER = struct.Struct("<2sHI")  # magic, version, sub-frame count
+_SUBLEN = struct.Struct("<I")
 
 
 class ProtocolError(ConnectionError):
@@ -73,6 +110,7 @@ SCHEMAS: Dict[str, Tuple[int, Optional[int], tuple]] = {
     "put_ow": (3, 3, (str,)),
     "task_events": (1, 1, (list,)),
     "spans": (1, 1, (list,)),
+    "wire_stats": (1, 1, (dict,)),
     # cross-process pubsub (pubsub.py remote delivery)
     "subscribe": (2, 3, (str,)),
     "unsubscribe": (2, 2, (str,)),
@@ -144,15 +182,8 @@ def _validate(obj: Any) -> None:
             )
 
 
-def encode(obj: Any) -> bytes:
-    return _HEADER + pickle.dumps(obj, protocol=5)
-
-
-def decode(buf) -> Any:
-    if len(buf) < 4:
-        raise ProtocolError("short control frame")
-    magic, version = struct.unpack_from("<2sH", buf, 0)
-    if magic != MAGIC:
+def _check_version(magic: bytes, version: int) -> None:
+    if magic not in (MAGIC, MAGIC_BATCH):
         raise ProtocolError(
             "peer is not speaking the ray_tpu control protocol "
             f"(bad magic {magic!r})"
@@ -162,9 +193,242 @@ def decode(buf) -> Any:
             f"protocol version mismatch: peer speaks v{version}, this "
             f"process speaks v{PROTOCOL_VERSION} — upgrade the older side"
         )
-    obj = pickle.loads(memoryview(buf)[4:])
-    _validate(obj)
-    return obj
+
+
+def encode(obj: Any) -> bytes:
+    return _HEADER + pickle.dumps(obj, protocol=5)
+
+
+def encode_batch(bodies: List[bytes]) -> bytes:
+    """One physical frame carrying N already-pickled sub-frame bodies."""
+    parts = [_BATCH_HEADER.pack(MAGIC_BATCH, PROTOCOL_VERSION, len(bodies))]
+    for b in bodies:
+        parts.append(_SUBLEN.pack(len(b)))
+        parts.append(b)
+    return b"".join(parts)
+
+
+def decode(buf) -> Any:
+    """Decode ONE single-kind frame (handshakes, tests).  Batch frames go
+    through decode_frames — a batch here would be a framing bug."""
+    objs = decode_frames(buf)
+    if len(objs) != 1:
+        raise ProtocolError(
+            f"expected a single control frame, got a batch of {len(objs)}"
+        )
+    return objs[0]
+
+
+def decode_frames(buf) -> List[Any]:
+    """Decode a physical frame into its validated sub-frames, in order.
+
+    A single frame yields [obj].  For a batch, EVERY sub-frame is
+    unpickled and schema-validated before any is returned: one malformed
+    sub-frame rejects the whole batch at the boundary (no partial
+    dispatch), and a body that doesn't exactly cover its declared
+    sub-frame lengths is a truncated write — a clean ProtocolError, the
+    shape a mid-batch sender crash leaves behind."""
+    if len(buf) < 4:
+        raise ProtocolError("short control frame")
+    magic, version = struct.unpack_from("<2sH", buf, 0)
+    _check_version(magic, version)
+    view = memoryview(buf)
+    if magic == MAGIC:
+        obj = pickle.loads(view[4:])
+        _validate(obj)
+        return [obj]
+    if len(buf) < _BATCH_HEADER.size:
+        raise ProtocolError("truncated batch frame (short header)")
+    _m, _v, count = _BATCH_HEADER.unpack_from(buf, 0)
+    objs: List[Any] = []
+    off = _BATCH_HEADER.size
+    for _ in range(count):
+        if off + _SUBLEN.size > len(buf):
+            raise ProtocolError(
+                f"truncated batch frame ({len(objs)}/{count} sub-frames "
+                "before the body ran out)"
+            )
+        (n,) = _SUBLEN.unpack_from(buf, off)
+        off += _SUBLEN.size
+        if off + n > len(buf):
+            raise ProtocolError(
+                f"truncated batch frame (sub-frame {len(objs)} declares "
+                f"{n} bytes, {len(buf) - off} remain)"
+            )
+        obj = pickle.loads(view[off:off + n])
+        _validate(obj)
+        objs.append(obj)
+        off += n
+    if off != len(buf):
+        raise ProtocolError(
+            f"batch frame has {len(buf) - off} trailing bytes after "
+            f"{count} sub-frames"
+        )
+    return objs
+
+
+# ---------------------------------------------------------------------------
+# per-process wire statistics
+#
+# Counting is always on (a few int adds under a lock already serializing
+# the physical write path); EXPOSURE through the state API / dashboard /
+# bench output is gated on RAY_TPU_WIRE_STATS=1.  logical_frames counts
+# control messages handed to send layers; physical_writes counts actual
+# send_bytes calls — their ratio is the coalescing factor the
+# acceptance bar is measured by.
+
+_stats_lock = threading.Lock()
+_stats_pid = os.getpid()
+_STAT_KEYS = (
+    "logical_frames",
+    "physical_writes",
+    "bytes_written",
+    "batched_frames",   # logical frames that rode a multi-frame batch
+    "flush_size",
+    "flush_linger",
+    "flush_explicit",
+    "flush_direct",     # unbatched TypedConn.send / single passthrough
+)
+_stats: Dict[str, int] = {k: 0 for k in _STAT_KEYS}
+
+
+def _count(n_logical: int, n_bytes: int, reason: str) -> None:
+    with _stats_lock:
+        _stats["logical_frames"] += n_logical
+        _stats["physical_writes"] += 1
+        _stats["bytes_written"] += n_bytes
+        if n_logical > 1:
+            _stats["batched_frames"] += n_logical
+        key = f"flush_{reason}"
+        if key in _stats:
+            _stats[key] += 1
+
+
+def stats() -> Dict[str, int]:
+    """Snapshot of this process's wire counters."""
+    _fork_check()
+    with _stats_lock:
+        return dict(_stats)
+
+
+def stats_enabled() -> bool:
+    from ray_tpu._private import config as _config
+
+    return bool(_config.get("wire_stats"))
+
+
+def _reset_stats_for_tests() -> None:
+    with _stats_lock:
+        for k in _STAT_KEYS:
+            _stats[k] = 0
+
+
+# ---------------------------------------------------------------------------
+# background linger flusher
+#
+# One daemon thread per process sweeps dirty BatchingConns after a short
+# linger (RAY_TPU_WIRE_FLUSH_US).  It is the BOUND on fire-and-forget
+# latency, not the main flush path: bursts flush on size, and every
+# blocking wait flushes explicitly first.  Forked children (zygote
+# workers, fork-start daemons) inherit the module state but not the
+# thread — _fork_check() detects the pid change and resets.
+
+_dirty_lock = threading.Lock()
+_dirty: "set[BatchingConn]" = set()
+_dirty_event = threading.Event()
+_flusher_started = False
+
+
+def _linger_s() -> float:
+    from ray_tpu._private import config as _config
+
+    return max(_config.get("wire_flush_us"), 0) / 1e6
+
+
+def _fork_check() -> None:
+    global _stats_pid, _flusher_started
+    if os.getpid() == _stats_pid:
+        return
+    with _dirty_lock, _stats_lock:
+        if os.getpid() == _stats_pid:
+            return
+        _stats_pid = os.getpid()
+        _flusher_started = False  # parent's thread did not survive the fork
+        _dirty.clear()            # nor did its conns
+        for k in _STAT_KEYS:
+            _stats[k] = 0
+
+
+def _note_dirty(bc: "BatchingConn") -> None:
+    global _flusher_started
+    _fork_check()
+    with _dirty_lock:
+        was_empty = not _dirty
+        _dirty.add(bc)
+        if not _flusher_started:
+            _flusher_started = True
+            threading.Thread(
+                target=_flusher_loop, daemon=True, name="raytpu-wire-flush"
+            ).start()
+        if was_empty:
+            # Arm the linger sweep only on the empty->dirty transition; an
+            # explicit flush_dirty() that empties the set DISARMS it
+            # (_take_dirty clears the event under the same lock), so the
+            # common send-then-flush-before-park pattern never wakes the
+            # flusher thread at all — per-op thread wakeups were a
+            # measured ~2x latency hit on a 1-vCPU host.
+            _dirty_event.set()
+
+
+def _forget_dirty(bc: "BatchingConn") -> None:
+    with _dirty_lock:
+        _dirty.discard(bc)
+
+
+def _take_dirty() -> List["BatchingConn"]:
+    with _dirty_lock:
+        out = list(_dirty)
+        _dirty.clear()
+        # Atomic with the emptying: a concurrent _note_dirty serializes on
+        # _dirty_lock, so it either re-arms after this clear or found the
+        # set non-empty (no arm needed — we are taking its conn).
+        _dirty_event.clear()
+    return out
+
+
+def _flusher_loop() -> None:
+    while True:
+        _dirty_event.wait()
+        linger = _linger_s()
+        if linger > 0:
+            time.sleep(linger)
+        # _take_dirty disarms the event; usually an explicit flush already
+        # did both and this sweep finds nothing (then goes back to sleep
+        # without having cost the hot path anything).
+        for bc in _take_dirty():
+            try:
+                bc.flush(_reason="linger")
+            except (OSError, ValueError):
+                pass  # conn died; its owner's recv side handles it
+
+
+def flush_dirty() -> None:
+    """Flush every pending batch in this process NOW.  Call this before
+    any blocking wait (the rule latency-sensitive paths live by) — the
+    io loop, request/reply muxes, and executor idle points all do."""
+    for bc in _take_dirty():
+        try:
+            bc.flush(_reason="explicit")
+        except (OSError, ValueError):
+            pass
+
+
+def flush_conn(conn) -> None:
+    """Flush one conn if it batches (no-op for plain TypedConns/mocks);
+    transport errors surface to the caller like a failed send."""
+    f = getattr(conn, "flush", None)
+    if f is not None:
+        f()
 
 
 class TypedConn:
@@ -172,28 +436,58 @@ class TypedConn:
     the raw-byte surface for transfer bodies.  send() is atomic per conn:
     Connection.send_bytes is NOT safe under concurrent writers (header and
     body interleave), and several head threads (reply path, pub sender)
-    legitimately share one driver/worker conn."""
+    legitimately share one driver/worker conn.
 
-    __slots__ = ("_c", "_send_lock")
+    Received batch frames are decoded whole (validate-all-then-dispatch)
+    into an internal queue; recv() returns sub-frames in order.  The
+    queue is only touched by the conn's single reader thread — recv
+    concurrency was never supported and still isn't."""
+
+    __slots__ = ("_c", "_send_lock", "_rbuf")
 
     def __init__(self, conn):
         self._c = conn
-        import threading
-
         self._send_lock = lock_watchdog.make_lock("TypedConn._send_lock")
+        self._rbuf: List[Any] = []  # decoded-but-undelivered sub-frames
 
     def send(self, obj: Any) -> None:
         if faults.ENABLED and faults.point("wire.send", key=_kind(obj)) == "drop":
             return  # frame lost on the wire; the sender believes it went out
+        buf = encode(obj)
         with self._send_lock:
-            self._c.send_bytes(encode(obj))
+            self._c.send_bytes(buf)
+            _count(1, len(buf), "direct")
+
+    def _send_frame(self, buf: bytes, n_logical: int, reason: str) -> None:
+        """Physical write of a pre-encoded frame (BatchingConn flush path)
+        — shares the send lock so batched and direct writers never
+        interleave on the wire."""
+        with self._send_lock:
+            self._c.send_bytes(buf)
+            _count(n_logical, len(buf), reason)
 
     def recv(self) -> Any:
         while True:
-            obj = decode(self._c.recv_bytes())
-            if faults.ENABLED and faults.point("wire.recv", key=_kind(obj)) == "drop":
-                continue  # frame lost before delivery; wait for the next
-            return obj
+            if self._rbuf:
+                return self._rbuf.pop(0)
+            objs = decode_frames(self._c.recv_bytes())
+            if faults.ENABLED:
+                # drop clauses fire per SUB-frame (key = message kind),
+                # exactly as they did per physical frame pre-batching.
+                objs = [
+                    o for o in objs
+                    if faults.point("wire.recv", key=_kind(o)) != "drop"
+                ]
+            if not objs:
+                continue  # everything dropped; wait for the next frame
+            self._rbuf = objs
+            return self._rbuf.pop(0)
+
+    def pending_frames(self) -> int:
+        """Decoded sub-frames awaiting recv().  Drain loops must consume
+        these before blocking on the fd — the socket shows no data for
+        them, so an epoll/wait would strand a buffered tail."""
+        return len(self._rbuf)
 
     # raw passthrough (object-transfer body, recv_into via fileno)
     def send_bytes(self, b) -> None:
@@ -203,6 +497,8 @@ class TypedConn:
         return self._c.recv_bytes()
 
     def poll(self, timeout: float = 0.0) -> bool:
+        if self._rbuf:
+            return True
         return self._c.poll(timeout)
 
     def fileno(self) -> int:
@@ -219,8 +515,192 @@ class TypedConn:
         return f"TypedConn({self._c!r})"
 
 
+class BatchingConn:
+    """Coalescing sender over a TypedConn (recv side passes through).
+
+    send() pickles the message immediately (cheap, and the bytes are what
+    the size threshold meters) and queues it; the pending run is flushed
+    as ONE physical frame on size / linger / explicit flush.  A single
+    pending message flushes as a plain frame — the batch envelope only
+    appears when it pays for itself.
+
+    Failure model: the first flush that hits a dead socket marks the conn
+    broken; from then on send() raises OSError AT THE CALL, restoring the
+    pre-batching contract that callers (oneway backlogs, reply paths)
+    detect a dead conn at send time.  Messages stranded in the pending
+    buffer by the breaking flush are recoverable via drain_pending() —
+    the worker reconnect path replays them ahead of its oneway backlog.
+
+    send_lock is the wire-serialization lock for the PENDING BUFFER; the
+    physical write additionally serializes on the TypedConn's own send
+    lock, so batched flushes and direct TypedConn sends on the same conn
+    never interleave frames."""
+
+    __slots__ = (
+        "_c", "send_lock", "_pending", "_pending_bytes", "_batch_bytes",
+        "_broken", "flush_reasons", "_pending_first_kind",
+    )
+
+    def __init__(self, conn, batch_bytes: Optional[int] = None):
+        from ray_tpu._private import config as _config
+
+        self._c = wrap(conn)
+        self.send_lock = lock_watchdog.make_lock("BatchingConn.send_lock")
+        self._pending: List[bytes] = []
+        self._pending_bytes = 0
+        self._batch_bytes = (
+            _config.get("wire_batch_bytes") if batch_bytes is None else batch_bytes
+        )
+        self._broken = False
+        # Per-conn flush-reason histogram (the per-process aggregate lives
+        # in wire.stats()).
+        self.flush_reasons: Dict[str, int] = {}
+        # Kind of the batch's LEADING message: the wire.flush fault key,
+        # so clauses scope by stream exactly like wire.send ones
+        # (match=^done kills a task executor at its done-batch flush
+        # without touching a replica's pdone batches).
+        self._pending_first_kind: Optional[str] = None
+
+    @property
+    def conn(self):
+        """The underlying TypedConn (tests, fd surgery)."""
+        return self._c
+
+    def send(self, obj: Any) -> None:
+        if self._batch_bytes <= 0:
+            # Coalescing disabled (RAY_TPU_WIRE_BATCH_BYTES=0): behave as
+            # a plain TypedConn — the unbatched comparison baseline.
+            self._c.send(obj)
+            return
+        if self._broken:
+            raise OSError("connection previously failed a batch flush")
+        if faults.ENABLED and faults.point("wire.send", key=_kind(obj)) == "drop":
+            return  # frame lost on the wire; the sender believes it went out
+        body = pickle.dumps(obj, protocol=5)
+        with self.send_lock:
+            if not self._pending:
+                self._pending_first_kind = _kind(obj)
+            self._pending.append(body)
+            self._pending_bytes += len(body) + _SUBLEN.size
+            if self._pending_bytes >= self._batch_bytes:
+                self._flush_locked("size")
+                return
+        _note_dirty(self)
+
+    def flush(self, _reason: str = "explicit") -> None:
+        with self.send_lock:
+            self._flush_locked(_reason)
+
+    def _flush_locked(self, reason: str) -> None:
+        # caller holds self.send_lock
+        if not self._pending:
+            return
+        if faults.ENABLED:
+            # crash = die with the batch in flight (the receiver sees a
+            # torn physical stream — EOF, or a truncated frame that
+            # decode_frames rejects whole); delay stretches the flush
+            # window; error/drop fail/lose the whole batch, which is one
+            # physical message now.  Key = "<leading kind>:<reason>" so
+            # clauses scope per stream (match=^done) or per trigger
+            # (match=linger).
+            key = f"{self._pending_first_kind or 'payload'}:{reason}"
+            if faults.point("wire.flush", key=key) == "drop":
+                self._pending = []
+                self._pending_bytes = 0
+                self._pending_first_kind = None
+                return
+        bodies = self._pending
+        if len(bodies) == 1:
+            buf = _HEADER + bodies[0]
+        else:
+            buf = encode_batch(bodies)
+        try:
+            self._c._send_frame(buf, len(bodies), reason)
+        except (OSError, ValueError):
+            # Leave the pending run in place for drain_pending(): the
+            # conn is dead, but the messages may carry ownership state a
+            # reconnect path can replay.
+            self._broken = True
+            raise
+        self._pending = []
+        self._pending_bytes = 0
+        self._pending_first_kind = None
+        self.flush_reasons[reason] = self.flush_reasons.get(reason, 0) + 1
+
+    def drain_pending_bodies(self) -> List[bytes]:
+        """Take back queued-but-unflushed PICKLED bodies (a broken conn's
+        tail) for replay on a replacement conn via send_body().  Raw by
+        design: unpickling can construct ObjectRefs, whose refcount hooks
+        take the transport lock — poison while the caller holds a conn
+        lock (the reconnect path does)."""
+        with self.send_lock:
+            bodies, self._pending = self._pending, []
+            self._pending_bytes = 0
+            self._pending_first_kind = None
+        return bodies
+
+    def drain_pending(self) -> List[Any]:
+        """drain_pending_bodies, decoded (tests/diagnostics — do NOT call
+        while holding a conn lock, see above)."""
+        return [pickle.loads(b) for b in self.drain_pending_bodies()]
+
+    def send_body(self, body: bytes) -> None:
+        """Queue an already-pickled body (replay of a drained tail)."""
+        if self._broken:
+            raise OSError("connection previously failed a batch flush")
+        if self._batch_bytes <= 0:
+            with self.send_lock:
+                self._c._send_frame(_HEADER + body, 1, "direct")
+            return
+        with self.send_lock:
+            self._pending.append(body)
+            self._pending_bytes += len(body) + _SUBLEN.size
+            if self._pending_bytes >= self._batch_bytes:
+                self._flush_locked("size")
+                return
+        _note_dirty(self)
+
+    # -- recv + passthrough surface (the conn's reader side is unchanged)
+
+    def recv(self) -> Any:
+        return self._c.recv()
+
+    def pending_frames(self) -> int:
+        return self._c.pending_frames()
+
+    def send_bytes(self, b) -> None:
+        self._c.send_bytes(b)
+
+    def recv_bytes(self):
+        return self._c.recv_bytes()
+
+    def poll(self, timeout: float = 0.0) -> bool:
+        return self._c.poll(timeout)
+
+    def fileno(self) -> int:
+        return self._c.fileno()
+
+    def close(self) -> None:
+        _forget_dirty(self)
+        self._c.close()
+
+    @property
+    def closed(self) -> bool:
+        return self._c.closed
+
+    def __repr__(self) -> str:
+        return f"BatchingConn({self._c!r}, pending={len(self._pending)})"
+
+
 def wrap(conn) -> TypedConn:
-    return conn if isinstance(conn, TypedConn) else TypedConn(conn)
+    if isinstance(conn, (TypedConn, BatchingConn)):
+        return conn
+    return TypedConn(conn)
+
+
+def batching(conn) -> BatchingConn:
+    """Wrap a conn in the coalescing sender (idempotent)."""
+    return conn if isinstance(conn, BatchingConn) else BatchingConn(conn)
 
 
 def connect(address, authkey: bytes) -> TypedConn:

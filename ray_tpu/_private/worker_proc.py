@@ -155,6 +155,8 @@ class WorkerRuntime:
                 _time.sleep(0.2)  # recv thread is swapping the conn
 
     def _request_once(self, op: str, payload: Any, timeout: Optional[float]) -> Any:
+        from ray_tpu._private import wire as _wire
+
         with self._req_lock:
             self._req_counter += 1
             req_id = self._req_counter
@@ -163,6 +165,10 @@ class WorkerRuntime:
         try:
             with self.conn_lock:
                 self.conn.send(("req", req_id, op, payload))
+            # Flush-before-blocking-wait: the req (and every oneway
+            # coalesced ahead of it — refops, seals) goes out as one
+            # physical write before this thread parks on the reply.
+            _wire.flush_conn(self.conn)
         except OSError as e:
             self._pending.pop(req_id, None)
             raise ConnectionError("head connection lost mid-send") from e
@@ -268,7 +274,16 @@ class WorkerRuntime:
         the retriable ConnectionError, replay promotions + subscriptions.
         Returns False when the head bounced again mid-recovery (caller
         retries within its window)."""
+        from ray_tpu._private import wire as _wire
+
         with self.conn_lock:
+            # Frames the dead conn queued but never flushed (a batch flush
+            # failing marks the conn broken and strands its pending run)
+            # carry the same ownership state the backlog does — and they
+            # are OLDER, so they replay first.  Replayed as RAW bodies:
+            # unpickling here would run ObjectRef refcount hooks (transport
+            # lock) under this conn lock — the watchdog-caught ABBA shape.
+            stranded = getattr(self.conn, "drain_pending_bodies", lambda: [])()
             try:
                 self.conn.close()
             except OSError:
@@ -276,14 +291,19 @@ class WorkerRuntime:
             self.conn = newconn
             try:
                 send_hello(newconn)
+                _wire.flush_conn(newconn)
             except OSError:
                 return False
             with self._backlog_lock:
                 backlog, self._oneway_backlog = self._oneway_backlog, []
             try:
+                while stranded:
+                    newconn.send_body(stranded[0])
+                    stranded.pop(0)
                 while backlog:
                     newconn.send(backlog[0])
                     backlog.pop(0)
+                _wire.flush_conn(newconn)
             except OSError:
                 # Unsent tail goes back: ownership state must survive
                 # repeated bounces.
@@ -745,7 +765,7 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
     )
     watchdog.daemon = True
     watchdog.start()
-    conn = wire.connect(address, authkey)
+    conn = wire.batching(wire.connect(address, authkey))
     watchdog.cancel()
     _tr("connected")
     from ray_tpu._private.netutil import set_nodelay
@@ -852,9 +872,17 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
     def _events_ticker() -> None:
         import time as _time
 
+        from ray_tpu._private import config as _cfg2
+
+        report_wire = bool(_cfg2.get("wire_stats"))
         while True:
             _time.sleep(0.5)
             flush_task_events()
+            if report_wire:
+                rt.oneway(("wire_stats", wire.stats()), droppable=True)
+            # Telemetry rides the next linger/idle flush; nudge it here so
+            # a fully-busy executor still reports within a beat.
+            wire.flush_dirty()
 
     threading.Thread(
         target=_events_ticker, daemon=True, name="raytpu-task-events"
@@ -905,7 +933,7 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
         newconn = None
         while _time.monotonic() < deadline:
             try:
-                newconn = wire.connect(address, authkey)
+                newconn = wire.batching(wire.connect(address, authkey))
                 set_nodelay(newconn)
                 break
             except Exception:
@@ -942,7 +970,12 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
                 # certifies every earlier task on this conn is already in
                 # the executor queue — a direct call sent after the ack
                 # cannot overtake a relayed one (see peer.py docstring).
+                # The head is parked on this ack: flush immediately.
                 rt.oneway(("fence_ack", msg[1]))
+                try:
+                    wire.flush_conn(rt.conn)
+                except OSError:
+                    pass
             elif kind == "kill":
                 os._exit(0)
             elif kind == "shutdown":
@@ -1037,6 +1070,7 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
             try:
                 with conn_lock:
                     conn.send(("env_failed", worker_id, f"{type(e).__name__}: {e}"))
+                wire.flush_conn(conn)
             except OSError:
                 pass
             sys.exit(1)
@@ -1044,12 +1078,22 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
     _tr("pre_ready")
     with conn_lock:
         conn.send(("ready", worker_id, os.getpid(), node_id, peer_endpoint))
+    wire.flush_conn(conn)
 
     while True:
-        msg, reply = task_q.get()
+        try:
+            msg, reply = task_q.get_nowait()
+        except queue.Empty:
+            # About to block on the task queue: flush every pending batch
+            # (done/refop runs to the head, pdone runs to peer callers).
+            # While tasks are queued back-to-back, consecutive results
+            # keep coalescing — the linger sweep bounds their latency.
+            wire.flush_dirty()
+            msg, reply = task_q.get()
         if msg[0] == "__shutdown__":
             break
         _run_and_reply(msg, reply)
+    wire.flush_dirty()
     sys.exit(0)
 
 

@@ -176,6 +176,22 @@ def cluster_metrics() -> Dict[str, float]:
             "lineage_bytes": float(rt.lineage_bytes),
         }
     )
+    from ray_tpu._private import wire as _wire
+
+    if _wire.stats_enabled():
+        # Control-plane coalescing counters (RAY_TPU_WIRE_STATS=1): the
+        # head's own process counters plus every worker/driver snapshot
+        # reported over the wire_stats channel.  writes-per-frame below 1.0
+        # is the batching win the dashboard/bench read off directly.
+        head = _wire.stats()
+        with rt.lock:
+            remotes = list(rt.worker_wire_stats.values())
+        for key in head:
+            m[f"wire_{key}"] = float(
+                head[key] + sum(s.get(key, 0) for s in remotes)
+            )
+        m["wire_head_physical_writes"] = float(head["physical_writes"])
+        m["wire_head_logical_frames"] = float(head["logical_frames"])
     return m
 
 

@@ -71,9 +71,20 @@ import ray_tpu  # noqa: E402
 #   * only the FIRST daemon (soak-d1) dies — its store loss must heal via
 #     lineage before the head kill lands at t=30;
 #   * each head incarnation SIGKILLs itself mid-snapshot at its t=30.
+#   * wire.flush clauses exercise the BATCH hazard window: a worker dies
+#     mid-flush with a coalesced run of frames in flight (the receiver
+#     sees a torn stream — EOF or a truncated batch decode_frames rejects
+#     whole, never a partial dispatch), and a small probabilistic delay
+#     stretches flush windows to keep batch/ordering races warm.  The
+#     flush key is "<leading kind>:<reason>", so match=^done scopes the
+#     crash to done-batch flushes of relayed executors — same actor-safe
+#     scoping as the wire.send clause (a replica's pdone batches don't
+#     match, see the anonymous-actor gap note above).
 DEFAULT_SPEC = (
     "wire.send:crash@proc=worker,match=^done,after=40,every=53,times=2;"
     "wire.send:delay=0.002@prob=0.02;"
+    "wire.flush:crash@proc=worker,match=^done,after=30,every=41,times=1;"
+    "wire.flush:delay=0.002@prob=0.02;"
     "wire.send:crash@proc=daemon:soak-d1,at=18,times=1;"
     "gcs.save:crash@proc=head,at=30,times=1"
 )

@@ -18,7 +18,12 @@ sys.path.insert(0, os.path.join(REPO, "scripts"))
 
 from ray_tpu._private.analysis import run_analysis  # noqa: E402
 from ray_tpu._private.analysis import allowlist as allowlist_mod  # noqa: E402
-from ray_tpu._private.analysis import blocking, fault_registry, lock_order  # noqa: E402
+from ray_tpu._private.analysis import (  # noqa: E402
+    blocking,
+    fault_registry,
+    hot_send,
+    lock_order,
+)
 from ray_tpu._private.analysis.common import iter_py_files  # noqa: E402
 
 
@@ -344,6 +349,51 @@ def test_committed_catalog_matches_tree():
     # The PR 1 hazard sites are all registered.
     for expected in ("wire.send", "wire.recv", "peer.send", "gcs.save"):
         assert expected in points
+
+
+# ---------------------------------------------------------------------------
+# pass 4: hot-send
+
+
+def test_hot_send_flags_direct_conn_send_in_hot_modules(tmp_path):
+    """A direct conn send added to a hot streaming module is a finding
+    (until reviewed into the allowlist); the same code outside the hot
+    module set is not."""
+    src = """
+    class S:
+        def stream(self, msg):
+            self.conn.send(msg)  # seeded: bypasses BatchingConn review
+
+        def not_a_conn(self, sock, msg):
+            sock.send(msg)  # non-conn receiver: out of scope
+    """
+    import textwrap
+
+    p = tmp_path / "peer.py"
+    p.write_text(textwrap.dedent(src))
+    found = hot_send.scan_file(str(p), "ray_tpu/_private/peer.py")
+    assert len(found) == 1
+    assert found[0].key == (
+        "hot-send:ray_tpu/_private/peer.py:S.stream:self.conn.send"
+    )
+    assert hot_send.scan_file(str(p), "ray_tpu/rllib/policy_client.py") == []
+
+
+def test_hot_send_every_committed_site_is_justified():
+    """The real tree's hot-send findings are all reviewed entries with
+    real justifications (the coalescing regression gate is armed)."""
+    result = run_analysis(
+        [os.path.join(REPO, "ray_tpu")],
+        spec_roots=[],
+        allowlist_path=os.path.join(
+            REPO, "ray_tpu", "_private", "analysis", "allowlist.txt"
+        ),
+    )
+    hot = [v for v in result.violations if v.pass_name == "hot-send"]
+    assert hot, "hot-send pass found nothing — the pass regressed"
+    for v in hot:
+        why = result.allowlist.get(v.key)
+        assert why and why != allowlist_mod.TODO_JUSTIFICATION, v.key
 
 
 # ---------------------------------------------------------------------------

@@ -481,3 +481,43 @@ def test_handshake_pending_send_flush_off_lock_preserves_order(
         "no handshake ever drained a pending_sends backlog — the test "
         "never exercised the flush path"
     )
+
+
+# ------------------------------------------------- GC-safe ref releases
+
+
+def test_objectref_release_runs_on_drainer_thread_not_in_gc():
+    """ObjectRef.__del__ must NEVER call the release hook synchronously:
+    GC runs at arbitrary allocation points, possibly while the current
+    thread holds the very locks the hook takes (DirectTransport.lock, a
+    conn lock) — a self-deadlock on a plain lock, an ABBA inversion
+    otherwise (the chaos soak's lock watchdog caught this under
+    batch-flush allocation pressure).  Releases are queued and drained by
+    a dedicated thread."""
+    import time as _time
+
+    from ray_tpu._private import refs as refs_mod
+
+    released = []
+    saved = (refs_mod._addref_hook, refs_mod._release_hook)
+    refs_mod.set_ref_hooks(
+        lambda oid: None,
+        lambda oid: released.append(
+            (oid, threading.current_thread().name)
+        ),
+    )
+    try:
+        r = refs_mod.ObjectRef("o-gc-test", _count=False)
+        del r
+        deadline = _time.monotonic() + 5.0
+        while not released and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        assert released, "release hook never ran after GC"
+        oid, thread_name = released[0]
+        assert oid == "o-gc-test"
+        assert thread_name == "raytpu-ref-release", (
+            f"release ran on {thread_name!r} — synchronous __del__ hooks "
+            "are the GC-context deadlock the drainer exists to prevent"
+        )
+    finally:
+        refs_mod.set_ref_hooks(*saved)
