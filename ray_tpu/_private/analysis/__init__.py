@@ -3,7 +3,7 @@
 The reference hardens its C++ concurrency with clang thread-safety
 annotations (GUARDED_BY) + TSAN in CI; this Python runtime gets the
 equivalent as an AST lint over the package, run by tier-1 tests and
-`scripts/ray_tpu_lint.py`.  Three passes:
+`scripts/ray_tpu_lint.py`.  Five passes:
 
   * blocking-under-lock (blocking.py) — calls from a catalog of blocking
     operations (time.sleep, conn.recv/sock.recv, .result(), wire
@@ -22,7 +22,12 @@ equivalent as an AST lint over the package, run by tier-1 tests and
   * hot-send (hot_send.py) — direct `conn.send(...)` calls in the hot
     streaming modules are reviewed allowlist entries: a new one must
     route through wire.BatchingConn or justify bypassing coalescing
-    (silent regressions back to one-syscall-per-frame fail CI).
+    (silent regressions back to one-syscall-per-frame fail CI);
+  * gcs-mutation (gcs_mutation.py) — the journaled GCS tables (actor /
+    named-binding / job) may only be written through the mutators in
+    gcs.py: a direct dict write elsewhere takes effect in memory but
+    skips the durability journal, so the mutation silently would not
+    survive a head bounce.
 
 Existing, reviewed sites live in allowlist.txt with one-line
 justifications; the lint fails only on NEW violations.  The runtime twin
@@ -36,10 +41,22 @@ import os
 from typing import Dict, List, Optional, Sequence
 
 from ray_tpu._private.analysis.common import Violation, iter_py_files
-from ray_tpu._private.analysis import blocking, fault_registry, hot_send, lock_order
+from ray_tpu._private.analysis import (
+    blocking,
+    fault_registry,
+    gcs_mutation,
+    hot_send,
+    lock_order,
+)
 from ray_tpu._private.analysis import allowlist as allowlist_mod
 
-PASSES = ("blocking-under-lock", "lock-order", "fault-registry", "hot-send")
+PASSES = (
+    "blocking-under-lock",
+    "lock-order",
+    "fault-registry",
+    "hot-send",
+    "gcs-mutation",
+)
 
 
 class AnalysisResult:
@@ -77,6 +94,7 @@ def run_analysis(
         violations.extend(blocking.scan_file(path, rel))
         violations.extend(lock_order.scan_file(path, rel))
         violations.extend(hot_send.scan_file(path, rel))
+        violations.extend(gcs_mutation.scan_file(path, rel))
     points = fault_registry.collect_points(files)
     if catalog_path is not None:
         violations.extend(fault_registry.check_catalog(points, catalog_path))

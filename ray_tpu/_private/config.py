@@ -85,6 +85,27 @@ _DEFS: Dict[str, tuple] = {
         "'sqlite' (WAL-journaled, crash-safe) "
         "(ray: gcs store_client in-memory vs redis backends)",
     ),
+    "gcs_journal": (
+        1, int,
+        "1 = append-only mutation journal between snapshot ticks (actor "
+        "register/restart/death, named bindings, job transitions, inline-"
+        "result lineage), replayed over the snapshot at head restart; "
+        "0 = snapshot-only durability (up to one tick of mutations lost) "
+        "(ray: the GCS writes each table mutation through its store "
+        "client instead of snapshotting)",
+    ),
+    "gcs_journal_fsync": (
+        0, int,
+        "journal append durability: 0 = write+flush only (survives "
+        "process SIGKILL via the page cache — the chaos-soak envelope), "
+        "1 = fsync every append (survives host power loss), N>1 = fsync "
+        "every N-th append (bounded-loss middle ground)",
+    ),
+    "gcs_journal_compact_bytes": (
+        4 * 1024 * 1024, int,
+        "journal size that forces an immediate snapshot (which folds the "
+        "journal in and resets it) instead of waiting for the next tick",
+    ),
     "snapshot_inflight_max_blob_bytes": (
         256 * 1024, int,
         "in-flight tasks with args blobs over this size are not persisted "

@@ -73,6 +73,24 @@ class PlacementGroupInfo:
     name: Optional[str] = None
 
 
+def actor_record(info: "ActorInfo") -> Dict[str, Any]:
+    """Persistable dict form of one actor-table row (snapshot AND journal
+    use the same shape, so restore merges them field-for-field)."""
+    return {
+        "actor_id": info.actor_id,
+        "name": info.name,
+        "namespace": info.namespace,
+        "state": info.state,
+        "worker_id": info.worker_id,
+        "node_id": info.node_id,
+        "max_restarts": info.max_restarts,
+        "num_restarts": info.num_restarts,
+        "detached": info.detached,
+        "owner_did": info.owner_did,
+        "creation_spec": info.creation_spec,
+    }
+
+
 class GlobalState:
     def __init__(self):
         self.lock = lock_watchdog.make_lock("GlobalState.lock", rlock=True)
@@ -82,13 +100,31 @@ class GlobalState:
         self.functions: Dict[str, bytes] = {}
         self.kv: Dict[str, Dict[str, bytes]] = {}  # namespace -> {key: val}
         self.placement_groups: Dict[str, PlacementGroupInfo] = {}
+        # Job table (ray: gcs_job_manager): attached drivers are this
+        # build's jobs — job_id == did, transitions RUNNING -> FINISHED.
+        self.jobs: Dict[str, Dict[str, Any]] = {}
         self.job_start_time = time.time()
+        # Durability hook (runtime._journal_append when the mutation
+        # journal is enabled): every actor/named-binding/job mutation the
+        # mutators below apply is mirrored into the append-only journal so
+        # it survives a head death between snapshot ticks.  The lint's
+        # gcs-mutation pass enforces that these tables are only ever
+        # written through this module.
+        self.journal_hook: Optional[Callable[[tuple], None]] = None
         # Cluster-event channels on the SHARED pubsub abstraction
         # (ray: src/ray/pubsub/publisher.h:298 — same Publisher the
         # runtime's object-ready plane and serve's long-poll use).
         from ray_tpu._private.pubsub import Publisher
 
         self.publisher = Publisher()
+
+    def _journal(self, entry: tuple) -> None:
+        """Mirror one table mutation into the durability journal (no-op
+        until the runtime installs its hook; best-effort by contract —
+        the hook swallows I/O failures, the next snapshot re-captures)."""
+        hook = self.journal_hook
+        if hook is not None:
+            hook(entry)
 
     # -- events --------------------------------------------------------------
 
@@ -130,12 +166,16 @@ class GlobalState:
 
     def register_actor(self, info: ActorInfo) -> None:
         with self.lock:
-            self.actors[info.actor_id] = info
             if info.name:
                 key = (info.namespace, info.name)
                 if key in self.named_actors:
                     raise ValueError(f"actor name {info.name!r} already taken")
                 self.named_actors[key] = info.actor_id
+            self.actors[info.actor_id] = info
+            # ALL actor records are durable — anonymous ones too (ray:
+            # gcs_actor_manager persists every record; the named binding
+            # rides in the same record).
+            self._journal(("actor_register", actor_record(info)))
 
     def get_actor(self, actor_id: str) -> Optional[ActorInfo]:
         with self.lock:
@@ -156,7 +196,30 @@ class GlobalState:
                 setattr(a, k, v)
             if state == DEAD and a.name:
                 self.named_actors.pop((a.namespace, a.name), None)
+            # num_restarts is snapshotted with every transition so a
+            # journal replay lands the restart budget, not just the state.
+            self._journal(
+                ("actor_state", actor_id, state,
+                 {**kw, "num_restarts": a.num_restarts})
+            )
         self.publish("actor_state", actor_id, state)
+
+    # -- jobs (ray: gcs_job_manager) -----------------------------------------
+
+    def set_job_state(self, job_id: str, state: str, **kw) -> None:
+        """Journaled job-table transition (attached drivers are the jobs:
+        RUNNING at attach, FINISHED at death/detach).  Restore replays
+        these so a restarted head knows which owners were already gone."""
+        with self.lock:
+            rec = self.jobs.setdefault(job_id, {"job_id": job_id})
+            rec["state"] = state
+            rec.update(kw)
+            self._journal(("job_state", job_id, state, dict(kw)))
+
+    def get_job(self, job_id: str) -> Optional[Dict[str, Any]]:
+        with self.lock:
+            rec = self.jobs.get(job_id)
+            return dict(rec) if rec else None
 
     # -- kv (ray: gcs_kv_manager.cc) ----------------------------------------
 

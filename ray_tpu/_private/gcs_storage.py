@@ -203,6 +203,214 @@ class SqliteSnapshotStorage(SnapshotStorage):
                 pass
 
 
+class MutationJournal:
+    """Append-only mutation log between snapshot ticks.
+
+    ray: the reference's GCS has no snapshot window at all — every table
+    mutation goes through the store client (redis_store_client.h) before
+    the RPC is acked.  Ours keeps the cheap snapshot document but closes
+    the between-tick loss window with this journal: every actor
+    register/restart/death, named binding, job transition, and inline-
+    result lineage record appends one entry; restore replays the entries
+    over the snapshot.  The journal is RESET after every successful
+    snapshot save (the snapshot now contains everything the journal did —
+    compaction), so it stays tick-sized.
+
+    Record format (after a pickled header stamping session + version):
+
+        u32 length | u32 crc32(blob) | blob=pickle(entry)
+
+    A torn tail (head SIGKILLed mid-append) is TOLERATED: replay stops at
+    the first short/corrupt record and truncates the file there — every
+    complete record before the tear still replays.  A foreign session or
+    a version-mismatched header refuses replay loudly, exactly like the
+    snapshot document (the file is set aside, never overwritten)."""
+
+    HEADER_VERSION = SNAPSHOT_VERSION
+
+    def __init__(self, path: str, session: str):
+        import threading
+
+        self.path = path
+        self.session = session
+        self._lock = threading.Lock()
+        self._f = None
+        self._appends_since_fsync = 0
+
+    # -- writing -------------------------------------------------------------
+
+    def _open_locked(self):
+        if self._f is None:
+            self._f = open(self.path, "ab")
+        return self._f
+
+    def append(self, entry) -> bool:
+        """Persist one mutation; True when an fsync was issued (the caller
+        counts both for the perf report).  Raises on I/O failure — callers
+        treat the journal as best-effort (the next snapshot tick
+        re-captures the full tables)."""
+        import struct
+        import zlib
+
+        if faults.ENABLED:
+            # crash -> head death mid-append (the torn tail replay must
+            # tolerate); drop -> this mutation is silently lost (the
+            # reconciliation handshake must still recover the actor);
+            # error -> append fails, caller presses on un-durable.
+            if faults.point("gcs.journal_append", key=_entry_kind(entry)) == "drop":
+                return False
+        blob = pickle.dumps(entry)
+        rec = struct.pack("<II", len(blob), zlib.crc32(blob)) + blob
+        from ray_tpu._private import config as _config
+
+        fsync_every = _config.get("gcs_journal_fsync")
+        synced = False
+        with self._lock:
+            f = self._open_locked()
+            if f.tell() == 0:
+                hdr = pickle.dumps(
+                    {"session": self.session, "journal_version": self.HEADER_VERSION}
+                )
+                f.write(struct.pack("<II", len(hdr), zlib.crc32(hdr)) + hdr)
+            f.write(rec)
+            # flush() moves the bytes into the page cache: a SIGKILLed
+            # head loses nothing (fsync only defends against host death).
+            f.flush()
+            if fsync_every > 0:
+                self._appends_since_fsync += 1
+                if self._appends_since_fsync >= fsync_every:
+                    os.fsync(f.fileno())
+                    self._appends_since_fsync = 0
+                    synced = True
+        return synced
+
+    def size_bytes(self) -> int:
+        with self._lock:
+            if self._f is not None:
+                return self._f.tell()
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0
+
+    def reset(self) -> None:
+        """Compaction point: the snapshot just captured everything this
+        journal recorded — start a fresh (empty) journal."""
+        with self._lock:
+            if self._f is not None:
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+                self._f = None
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+            self._appends_since_fsync = 0
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+                self._f = None
+
+    # -- replay --------------------------------------------------------------
+
+    def _read_records(self, data: bytes):
+        """(entries, good_offset): decode until EOF or the first torn/
+        corrupt record."""
+        import struct
+        import zlib
+
+        entries = []
+        off = 0
+        while off + 8 <= len(data):
+            length, crc = struct.unpack_from("<II", data, off)
+            start = off + 8
+            end = start + length
+            if end > len(data):
+                break  # torn tail: length header written, body incomplete
+            blob = data[start:end]
+            if zlib.crc32(blob) != crc:
+                break  # torn/corrupt record: stop here, keep the prefix
+            try:
+                entries.append(pickle.loads(blob))
+            except Exception:
+                break
+            off = end
+        return entries, off
+
+    def replay(self):
+        """Entries recorded since the last snapshot (possibly many ticks
+        ago if saves kept failing), or [] when there is nothing to replay
+        / the journal must not replay (foreign session, version skew)."""
+        if faults.ENABLED:
+            faults.point("gcs.journal_replay", key=self.session)
+        try:
+            with open(self.path, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            return []
+        except OSError as e:
+            _corrupt_note(self.path, e)
+            return []
+        entries, good = self._read_records(data)
+        if good < len(data):
+            # Torn tail (head died mid-append): truncate to the last
+            # complete record so the NEXT incarnation's appends don't land
+            # after garbage.
+            print(
+                f"[ray_tpu] journal at {self.path}: torn tail at byte "
+                f"{good}/{len(data)} — recovered {max(len(entries) - 1, 0)} "
+                "complete record(s), truncating the tear",
+                file=sys.stderr,
+                flush=True,
+            )
+            try:
+                with open(self.path, "r+b") as f:
+                    f.truncate(good)
+            except OSError:
+                pass
+        if not entries:
+            return []
+        header, entries = entries[0], entries[1:]
+        if not isinstance(header, dict) or header.get("journal_version") != self.HEADER_VERSION:
+            ver = header.get("journal_version") if isinstance(header, dict) else None
+            print(
+                f"[ray_tpu] REFUSING journal replay from {self.path}: "
+                f"version {ver!r} != supported {self.HEADER_VERSION} — the "
+                "journaled mutations were NOT replayed (kept aside for a "
+                "matching-version binary)",
+                file=sys.stderr,
+                flush=True,
+            )
+            try:
+                os.replace(self.path, self.path + ".refused")
+            except OSError:
+                pass
+            return []
+        if header.get("session") != self.session:
+            return []  # a foreign session's mutations must never replay
+        return entries
+
+
+def _entry_kind(entry) -> str:
+    if isinstance(entry, tuple) and entry and isinstance(entry[0], str):
+        return entry[0]
+    return type(entry).__name__
+
+
+def make_mutation_journal(snapshot_path: str, session: str) -> MutationJournal:
+    """The journal rides next to the snapshot document regardless of the
+    snapshot backend (sqlite's transactional saves don't help the BETWEEN-
+    tick window; the file journal is one implementation for both)."""
+    return MutationJournal(snapshot_path + ".journal", session)
+
+
 def make_snapshot_storage(path: str) -> SnapshotStorage:
     """Backend per the gcs_storage_backend knob ('file' | 'sqlite')."""
     from ray_tpu._private import config as _config

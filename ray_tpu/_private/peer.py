@@ -1111,6 +1111,23 @@ class DirectTransport:
                 if dr.promoted and dr.event.is_set():
                     self._send_promotion(oid, dr)
 
+    def announce_routes(self) -> None:
+        """After a head restart: re-announce every live direct actor route
+        this caller holds (reconciliation handshake, caller leg).  The
+        head cross-checks the entries against its rebuilt actor table —
+        a route it cannot account for means a durability gap and is
+        surfaced loudly head-side."""
+        with self.lock:
+            entries = [
+                (aid, getattr(r.conn, "endpoint", None))
+                for aid, r in self.routes.items()
+                if isinstance(r, ActorRoute)
+                and r.conn is not None
+                and not r.conn.dead
+            ]
+        if entries:
+            self.wr.oneway(("actor_announce", entries))
+
     def _send_promotion(self, oid: str, dr: DirectResult) -> None:
         """Upload an owned object's bytes (inline) or error to the head.
         shm results were already registered by the callee's direct_seal —

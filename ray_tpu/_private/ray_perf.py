@@ -33,15 +33,30 @@ def timeit(name: str, fn: Callable[[], int], warmup: int = 1, repeat: int = 3):
 
     Also reports this process's physical control-plane writes per op
     (wire.stats delta over the timed runs): the deterministic coalescing
-    metric that doesn't care about host noise."""
+    metric that doesn't care about host noise.  With the GCS mutation
+    journal active (RAY_TPU_PERF_PERSIST=1), journal appends and fsyncs
+    per op ride along the same way — the durability-cost twin of
+    writes_per_op."""
     import statistics
 
     from ray_tpu._private import wire as _wire
+
+    def _journal_counts():
+        try:
+            from ray_tpu._private.runtime import get_runtime
+
+            rt = get_runtime()
+            if getattr(rt, "_journal", None) is None:
+                return None
+            return (rt.metrics["journal_appends"], rt.metrics["journal_fsyncs"])
+        except Exception:
+            return None
 
     for _ in range(warmup):
         fn()
     runs: List[float] = []
     w0 = _wire.stats()
+    j0 = _journal_counts()
     total_ops = 0
     for _ in range(repeat):
         t0 = time.perf_counter()
@@ -50,6 +65,7 @@ def timeit(name: str, fn: Callable[[], int], warmup: int = 1, repeat: int = 3):
         runs.append(round(ops / dt, 1))
         total_ops += ops
     w1 = _wire.stats()
+    j1 = _journal_counts()
     out = {
         "name": name,
         "ops_per_s": round(statistics.median(runs), 1),
@@ -62,7 +78,43 @@ def timeit(name: str, fn: Callable[[], int], warmup: int = 1, repeat: int = 3):
         out["frames_per_op"] = round(
             (w1["logical_frames"] - w0["logical_frames"]) / total_ops, 3
         )
+        if j0 is not None and j1 is not None:
+            out["journal_appends_per_op"] = round((j1[0] - j0[0]) / total_ops, 3)
+            out["journal_fsyncs_per_op"] = round((j1[1] - j0[1]) / total_ops, 3)
     return out
+
+
+def _enable_local_persistence() -> None:
+    """RAY_TPU_PERF_PERSIST=1: run the benches with the snapshot loop AND
+    the mutation journal active on the local runtime, exactly as a
+    standalone head runs them — so journal_appends_per_op /
+    journal_fsyncs_per_op measure the real durability tax on the hot
+    path (the honesty requirement: BENCH_core medians must stay within
+    noise of the journal-less tree)."""
+    import os as _os
+    import threading as _threading
+
+    from ray_tpu._private import config as _config
+    from ray_tpu._private.gcs_storage import (
+        make_mutation_journal,
+        make_snapshot_storage,
+    )
+    from ray_tpu._private.runtime import get_runtime
+
+    rt = get_runtime()
+    if rt._snapshot_storage is not None:
+        return  # already persistent (attached to a real head)
+    d = f"/tmp/raytpu-perf-{_os.getpid()}"
+    _os.makedirs(d, exist_ok=True)
+    path = _os.path.join(d, "gcs_snapshot.pkl")
+    rt.snapshot_path = path
+    rt._snapshot_storage = make_snapshot_storage(path)
+    rt._journal = make_mutation_journal(path, rt.session_name)
+    rt._journal_compact_bytes = _config.get("gcs_journal_compact_bytes")
+    rt.state.journal_hook = rt._journal_append
+    _threading.Thread(
+        target=rt._snapshot_loop, daemon=True, name="raytpu-snapshot"
+    ).start()
 
 
 @ray_tpu.remote
@@ -277,6 +329,8 @@ def main(argv=None):
     # not core count; without it a small host can't place the n:n actor
     # pairs at all (the reference runs these on 64-core machines).
     ray_tpu.init(num_cpus=max(_os.cpu_count() or 1, 16), ignore_reinit_error=True)
+    if _os.environ.get("RAY_TPU_PERF_PERSIST") == "1":
+        _enable_local_persistence()
     results = [
         {
             "name": "host_note",
@@ -285,7 +339,10 @@ def main(argv=None):
                 "ops_per_s is the MEDIAN of the 3 runs ('runs' lists all); "
                 "writes_per_op / frames_per_op are this process's wire-"
                 "counter deltas (physical writes vs logical control frames "
-                "per op — the frame-coalescing factor)"
+                "per op — the frame-coalescing factor); with "
+                "RAY_TPU_PERF_PERSIST=1 journal_appends_per_op / "
+                "journal_fsyncs_per_op report the GCS mutation journal's "
+                "per-op durability cost the same way"
             ),
         }
     ]

@@ -519,6 +519,8 @@ class Runtime:
             "workers_spawned": 0,
             "worker_crashes": 0,
             "pull_parks": 0,
+            "journal_appends": 0,
+            "journal_fsyncs": 0,
         }
         # Staggered broadcast admission (see _admit_pull): oid -> grant
         # timestamps of in-flight pulls; round-robin rotation counter.
@@ -627,13 +629,27 @@ class Runtime:
         # gcs/store_client/redis_store_client.h — ours is a snapshot file):
         # named/detached actors, KV, functions, PGs, object directory.
         self.snapshot_path = snapshot_path
+        self._journal = None
+        self._snapshot_kick = threading.Event()
         if snapshot_path:
-            from ray_tpu._private.gcs_storage import make_snapshot_storage
+            from ray_tpu._private.gcs_storage import (
+                make_mutation_journal,
+                make_snapshot_storage,
+            )
 
             self._snapshot_storage = make_snapshot_storage(snapshot_path)
+            if _config.get("gcs_journal"):
+                self._journal = make_mutation_journal(
+                    snapshot_path, self.session_name
+                )
+            self._journal_compact_bytes = _config.get("gcs_journal_compact_bytes")
         else:
             self._snapshot_storage = None
         self._restored_actors: Set[str] = set()
+        # Inline-result lineage (oids whose bytes lived ONLY in this
+        # process): journaled + snapshotted so a post-restart get() can
+        # re-execute the producer instead of erroring/parking forever.
+        self._inline_lineage: Set[str] = set()
         # Log pipeline (ray: log_monitor.py + driver print subscriber):
         # head workers' stdout/stderr redirect into per-worker files under
         # log_dir; a LogMonitor tails them (daemons tail their own nodes
@@ -656,6 +672,19 @@ class Runtime:
         self._log_monitor = LogMonitor(self.log_dir, self._on_log_lines)
         if snapshot_path:
             self._restore_snapshot()
+            if self._journal is not None:
+                # Fold the just-replayed journal into a fresh snapshot NOW:
+                # the reset inside _write_snapshot would otherwise race a
+                # crash-before-first-tick (old snapshot on disk, replayed
+                # entries gone).
+                try:
+                    self._write_snapshot()
+                except Exception:
+                    pass
+                # Mutations from here on are journaled (the hook is
+                # installed before the accept/io threads below can deliver
+                # any request).
+                self.state.journal_hook = self._journal_append
             threading.Thread(
                 target=self._snapshot_loop, daemon=True, name="raytpu-snapshot"
             ).start()
@@ -763,36 +792,53 @@ class Runtime:
 
     def _snapshot_loop(self) -> None:
         while not self._shutdown:
-            time.sleep(0.5)
+            # The kick short-circuits the tick when the journal crosses its
+            # compaction threshold (the snapshot folds the journal in).
+            self._snapshot_kick.wait(0.5)
+            self._snapshot_kick.clear()
+            if self._shutdown:
+                return
             try:
                 self._write_snapshot()
             except Exception:
                 pass  # next tick retries; persistence is best-effort
 
+    def _journal_append(self, entry: tuple) -> None:
+        """GlobalState journal hook + inline-lineage writer: mirror one
+        control-plane mutation into the append-only journal.  Best-effort
+        by contract — a failed append degrades this mutation back to
+        snapshot-tick durability, and the reconciliation handshake covers
+        the actor records regardless."""
+        j = self._journal
+        if j is None:
+            return
+        try:
+            synced = j.append(entry)
+        except Exception:
+            return
+        self.metrics["journal_appends"] += 1
+        if synced:
+            self.metrics["journal_fsyncs"] += 1
+        if j.size_bytes() >= self._journal_compact_bytes:
+            self._snapshot_kick.set()
+
     def _write_snapshot(self) -> None:
-        import pickle
+        from ray_tpu._private.gcs import actor_record
 
         # Lock order everywhere else is self.lock -> state.lock (handshake
         # and io threads take self.lock then call into GlobalState); taking
         # them in the opposite order here would be an ABBA deadlock.
         with self.lock, self.state.lock:
-            actors = []
-            for aid, info in self.state.actors.items():
-                if not (info.detached or info.name):
-                    continue  # anonymous non-detached actors die with drivers
-                actors.append(
-                    {
-                        "actor_id": aid,
-                        "name": info.name,
-                        "namespace": info.namespace,
-                        "state": info.state,
-                        "worker_id": info.worker_id,
-                        "node_id": info.node_id,
-                        "max_restarts": info.max_restarts,
-                        "detached": info.detached,
-                        "creation_spec": info.creation_spec,
-                    }
-                )
+            # EVERY live actor record is persisted — anonymous ones too
+            # (ray: gcs_actor_manager keeps all records in the GCS tables;
+            # only terminal DEAD rows are dropped, restore skips them
+            # anyway).  Anonymous records are what let a replica that died
+            # during a head outage be re-resolved and restarted.
+            actors = [
+                actor_record(info)
+                for info in self.state.actors.values()
+                if info.state != DEAD
+            ]
             # In-flight PLAIN task specs: a head crash mid-flight re-drives
             # them on restart so their results still materialize for
             # reconnected drivers (ray: lineage-based resubmission after
@@ -830,17 +876,38 @@ class Runtime:
                 },
                 "object_sizes": dict(self.object_sizes),
                 "inflight_tasks": inflight,
+                "jobs": {jid: dict(rec) for jid, rec in self.state.jobs.items()},
+                # Completed inline results' producer specs (bounded: a
+                # subset of the lineage table, which lineage_max_bytes /
+                # lineage_max_entries already cap) — these bytes live only
+                # in this process, so lineage is their ONLY recovery.
+                "lineage": [
+                    (oid, self.lineage[oid])
+                    for oid in self.lineage
+                    if oid in self._inline_lineage
+                ],
             }
         self._snapshot_storage.save(self.session_name, snap)
+        if self._journal is not None:
+            # Compaction: the snapshot now contains every journaled
+            # mutation.  Skipped when the save above raised (the journal
+            # then still replays over the PREVIOUS snapshot).
+            self._journal.reset()
 
     def _restore_snapshot(self) -> None:
         """Replay persisted control state on head restart: KV, exported
         functions, the object directory, PGs (re-reserved as nodes return),
-        and named/detached actors (recreated from their creation specs;
-        live-worker adoption upgrades this when the worker reconnects)."""
+        inline-result lineage, the job table, and ALL actor records —
+        named, detached, AND anonymous (recreated from their creation
+        specs; live-worker adoption / re-announcement upgrades this when
+        the worker reconnects).  The rebuilt actor table is snapshot +
+        journal replay; the reconciliation handshake layers worker
+        re-announcements on top."""
         snap = self._snapshot_storage.load(self.session_name)
-        if snap is None:
+        journal_entries = self._journal.replay() if self._journal is not None else []
+        if snap is None and not journal_entries:
             return
+        snap = snap or {}
         from ray_tpu._private import config as _config
         for ns, d in snap.get("kv", {}).items():
             self.state.kv.setdefault(ns, {}).update(d)
@@ -860,17 +927,72 @@ class Runtime:
             pg = PlacementGroupInfo(pid, bundles, strategy, name=name)
             self.state.placement_groups[pid] = pg
             self.pending_pgs.append(pid)  # re-reserve once nodes register
-        for a in snap.get("actors", []):
+        # ---- merge the actor/job tables: snapshot + journal replay.  The
+        # journal holds every mutation since the snapshot's tick (torn
+        # tail already truncated by replay()), so applying the entries in
+        # order rebuilds the tables as of the crash.
+        actors_by_id = {a["actor_id"]: dict(a) for a in snap.get("actors", [])}
+        jobs: Dict[str, dict] = {
+            jid: dict(rec) for jid, rec in snap.get("jobs", {}).items()
+        }
+        restored_lineage = list(snap.get("lineage", []))
+        for entry in journal_entries:
+            try:
+                kind = entry[0]
+                if kind == "actor_register":
+                    rec = dict(entry[1])
+                    actors_by_id[rec["actor_id"]] = rec
+                elif kind == "actor_state":
+                    _, aid, astate, kw = entry
+                    rec = actors_by_id.get(aid)
+                    if rec is not None:
+                        rec["state"] = astate
+                        for k, v in kw.items():
+                            rec[k] = v
+                elif kind == "job_state":
+                    _, jid, jstate, kw = entry
+                    jobs.setdefault(jid, {"job_id": jid}).update(
+                        {"state": jstate, **kw}
+                    )
+                elif kind == "lineage":
+                    restored_lineage.append((entry[1], entry[2]))
+            except (IndexError, KeyError, TypeError, ValueError):
+                continue  # malformed journal entry: skip, don't block boot
+        for jid, rec in jobs.items():
+            kw = {k: v for k, v in rec.items() if k not in ("job_id", "state")}
+            self.state.set_job_state(jid, rec.get("state", "RUNNING"), **kw)
+        # Inline-result lineage: the bytes died with the old head, but the
+        # producer specs survive — a get() on one of these re-executes from
+        # lineage instead of parking forever (ray: task_manager.h:97 +
+        # object_recovery_manager.h:41 across GCS failover).
+        with self.lock:
+            for oid, spec in restored_lineage:
+                try:
+                    self._lineage_record(oid, spec)
+                    self._inline_lineage.add(oid)
+                except Exception:
+                    continue
+        for a in actors_by_id.values():
             if a["state"] == DEAD or a["actor_id"] in self.state.actors:
                 continue
             spec = a["creation_spec"]
+            if spec is None:
+                continue
+            if (
+                a.get("owner_did")
+                and not a["detached"]
+                and jobs.get(a["owner_did"], {}).get("state") == "FINISHED"
+            ):
+                continue  # non-detached actor whose owner job already ended
             info = ActorInfo(
                 actor_id=a["actor_id"],
                 name=a["name"],
                 namespace=a["namespace"],
                 max_restarts=a["max_restarts"],
+                num_restarts=a.get("num_restarts", 0),
                 creation_spec=spec,
                 detached=a["detached"],
+                owner_did=a.get("owner_did"),
                 state=RESTARTING,
                 worker_id=a.get("worker_id"),
                 node_id=a.get("node_id"),
@@ -884,13 +1006,35 @@ class Runtime:
         if self._restored_actors:
             # Give live workers the adoption grace to reconnect and re-bind
             # (actor memory state PRESERVED); whatever stays unbound is then
-            # respawned from its creation spec (state reset) — ray:
-            # gcs_actor_manager reconstruction after GCS restart.
+            # respawned from its creation spec (state reset; anonymous
+            # actors charge their restart budget for the outage death) —
+            # ray: gcs_actor_manager reconstruction after GCS restart.
             t = threading.Timer(
                 _config.get("actor_adopt_grace_s"), self._respawn_unbound_actors
             )
             t.daemon = True
             t.start()
+            # Restored NON-detached actors whose owner driver never
+            # re-attaches die with their job, exactly as they would have
+            # on a live head (ray: OnJobFinished) — after a window long
+            # enough for the owner's own reconnect loop to win.
+            orphan_grace = max(
+                _config.get("reconnect_window_s"),
+                _config.get("actor_adopt_grace_s"),
+            ) + 2.0
+            orphans = [
+                aid
+                for aid in self._restored_actors
+                if (ar := self.actors.get(aid)) is not None
+                and ar.info.owner_did
+                and not ar.info.detached
+            ]
+            if orphans:
+                t2 = threading.Timer(
+                    orphan_grace, self._reap_ownerless_actors, args=(orphans,)
+                )
+                t2.daemon = True
+                t2.start()
         # Re-drive tasks that were in flight at the crash: their results
         # never sealed (or survive on a node — then the resubmit is
         # skipped), so reconnected drivers' gets park until the re-run
@@ -927,22 +1071,76 @@ class Runtime:
 
     def _respawn_unbound_actors(self) -> None:
         """Adoption grace expired: recreate restored actors whose worker
-        never came back."""
+        never came back.  Named/detached actors respawn unconditionally
+        (persistent by contract); anonymous actors — the records this PR
+        made durable — charge their restart budget for the outage death,
+        exactly as a live-head worker crash would (ray:
+        gcs_actor_manager.h:258 counts ALIVE->dead transitions)."""
+        specs = []
         with self.lock:
-            specs = []
+            doomed = []
             for aid in list(self._restored_actors):
                 ar = self.actors.get(aid)
                 self._restored_actors.discard(aid)
-                if (
+                if not (
                     ar is not None
                     and ar.info.state == RESTARTING
                     and ar.worker_id is None
                     and ar.info.creation_spec is not None
                 ):
-                    ar.info.worker_id = None
-                    specs.append(ar.info.creation_spec)
+                    continue
+                info = ar.info
+                info.worker_id = None
+                if info.detached or info.name:
+                    specs.append(info.creation_spec)
+                elif info.max_restarts == -1 or info.num_restarts < info.max_restarts:
+                    self.metrics["actor_restarts"] += 1
+                    self.events.emit(
+                        "WARNING", "actor",
+                        "anonymous actor restarting after head outage",
+                        actor_id=aid, restart=info.num_restarts + 1,
+                    )
+                    # set_actor_state journals the charged budget, so a
+                    # SECOND head bounce restores the decremented budget.
+                    self.state.set_actor_state(
+                        aid, RESTARTING, num_restarts=info.num_restarts + 1
+                    )
+                    specs.append(info.creation_spec)
+                else:
+                    doomed.append((aid, ar))
+            for aid, ar in doomed:
+                self.state.set_actor_state(
+                    aid, DEAD,
+                    death_cause="died during head outage; restart budget exhausted",
+                )
+                self._fail_actor_queue(ar, ActorDiedError(aid))
         for spec in specs:
             self.submit_task(spec)
+
+    def _reap_ownerless_actors(self, candidates: List[str]) -> None:
+        """Owner-reconnect grace expired: restored non-detached actors
+        whose owning driver (job) never re-attached die with their job —
+        the restarted head finishes what OnJobFinished would have done on
+        a live head, and journals the job as FINISHED so the NEXT bounce
+        does not resurrect them."""
+        doomed = []
+        with self.lock:
+            for aid in candidates:
+                ar = self.actors.get(aid)
+                if ar is None or ar.info.state == DEAD:
+                    continue
+                did = ar.info.owner_did
+                if did and did not in self.drivers:
+                    doomed.append((aid, did))
+            for _aid, did in doomed:
+                if self.state.jobs.get(did, {}).get("state") != "FINISHED":
+                    self.state.set_job_state(did, "FINISHED", reason="never re-attached")
+        for aid, _did in doomed:
+            self.events.emit(
+                "INFO", "actor", "reaping actor of non-returning owner",
+                actor_id=aid,
+            )
+            self.kill_actor(aid, no_restart=True)
 
     # ------------------------------------------------------------------
     # refcounting (owner side)
@@ -965,6 +1163,7 @@ class Runtime:
                 entry = self.lineage.pop(oid, None)
                 if entry is not None:
                     self.lineage_bytes -= self._lineage_cost(entry)
+                self._inline_lineage.discard(oid)
                 self.object_sizes.pop(oid, None)
                 # Remote copies die with the ownership release (ray: the
                 # owner's directory drives eviction on every holder node).
@@ -1005,6 +1204,7 @@ class Runtime:
             self.drivers.pop(did, None)
             self.driver_nodes.pop(did, None)
             self._drop_remote_subs(did)
+            self.state.set_job_state(did, "FINISHED", reason="driver death")
             refs = self.driver_refs.pop(did, {})
             doomed = [
                 aid
@@ -1486,6 +1686,10 @@ class Runtime:
                 self.driver_refs.setdefault(did, {})
                 self._conn_to_driver[conn] = did
                 self._conns_version += 1
+                # Attached drivers are this build's jobs (ray:
+                # gcs_job_manager): the journaled transition lets a
+                # restarted head know which owners were already live.
+                self.state.set_job_state(did, "RUNNING", pid=_pid)
             return
         if first[0] == "daemon":
             # Node daemon registration: ("daemon", node_id, cfg, pid).
@@ -1603,6 +1807,7 @@ class Runtime:
             return None  # classic mode: unknown workers are rejected
         wid, pid = first[1], first[2]
         node_id = first[3] if len(first) > 3 else None
+        announce = first[5] if len(first) > 5 else None
         nid = node_id or self.head_node_id
         if nid in self.node_daemons:
             proc: Any = _RemoteProcHandle(self, nid, wid)
@@ -1619,6 +1824,14 @@ class Runtime:
             if ar.info.worker_id == wid and ar.info.state == RESTARTING:
                 bound = aid
                 break
+        if bound is None and announce is not None:
+            # Reconciliation: the worker re-announced the live actor it
+            # hosts.  Normally the journal already restored the record
+            # (the loop above missed only because worker_id drifted); with
+            # the journal lost or disabled, the announcement itself
+            # carries the creation spec and re-registers the actor — the
+            # third leg of snapshot + journal + re-announcement.
+            bound = self._reconcile_announced_actor(wid, nid, announce)
         if bound is not None:
             ar = self.actors[bound]
             ar.worker_id = wid
@@ -1636,6 +1849,61 @@ class Runtime:
             self.idle_pool.setdefault((nid, None), []).append(wid)
         self._dispatch()
         return h
+
+    @_locked
+    def _reconcile_announced_actor(self, wid: str, nid: str, announce) -> Optional[str]:
+        """Caller holds self.lock.  A reconnecting worker announced the
+        actor it hosts: bind it to the restored record, or — when NO
+        record survived (journal disabled/lost) — re-register the actor
+        from the announced creation spec (ray: workers re-registering
+        their actors with a restarted GCS).  Returns the actor_id to bind
+        or None (worker is adopted as a plain idle worker)."""
+        try:
+            aid = announce.get("actor_id")
+            spec = announce.get("creation_spec")
+        except AttributeError:
+            return None
+        if not aid:
+            return None
+        ar = self.actors.get(aid)
+        info = self.state.get_actor(aid)
+        if ar is not None and info is not None:
+            if info.state not in (RESTARTING, PENDING_CREATION) or ar.worker_id:
+                return None  # DEAD, or another instance already bound
+            creation = info.creation_spec
+            rec = self.tasks.get(creation.task_id) if creation is not None else None
+            if rec is not None:
+                if rec.state not in ("PENDING", "READY") or rec.cancelled:
+                    return None  # a respawn already started: it wins
+                # A queued-but-undispatched respawn loses to the LIVE
+                # instance (memory state preserved beats state reset).
+                rec.cancelled = True
+                self.tasks.pop(creation.task_id, None)
+            return aid
+        if spec is None:
+            return None
+        info = ActorInfo(
+            actor_id=aid,
+            name=getattr(spec, "actor_name", None),
+            namespace=getattr(spec, "actor_namespace", None) or self.namespace,
+            max_restarts=getattr(spec, "max_restarts", 0),
+            creation_spec=spec,
+            detached=getattr(spec, "lifetime", None) == "detached",
+            state=RESTARTING,
+            worker_id=wid,
+            node_id=nid,
+        )
+        try:
+            self.state.register_actor(info)  # journals the rebuilt record
+        except ValueError:
+            return None  # name re-taken while the record was lost
+        self.actors[aid] = ActorRuntime(info)
+        self.events.emit(
+            "WARNING", "actor",
+            "actor record rebuilt from worker re-announcement",
+            actor_id=aid, worker_id=wid,
+        )
+        return aid
 
     def _io_loop(self):
         import selectors
@@ -2048,6 +2316,22 @@ class Runtime:
                 if ar:
                     ar.expected_death = True
                     ar.no_restart = True
+        elif kind == "actor_announce":
+            # Reconciliation hints from reconnecting CALLERS: each entry
+            # names a direct actor route the peer held when the old head
+            # died.  The rebuilt table (snapshot + journal + hosting-worker
+            # re-announcement) normally already accounts for every one; an
+            # entry it can't account for is surfaced as a WARNING event so
+            # a durability gap is visible instead of silent.
+            with self.lock:
+                for aid, ep in msg[1]:
+                    if self.state.get_actor(aid) is None:
+                        self.events.emit(
+                            "WARNING", "actor",
+                            "peer re-announced an actor with no surviving record",
+                            actor_id=aid, reporter=wid,
+                            endpoint=list(ep) if ep else None,
+                        )
         elif kind == "task_events":
             # Batched task-state reports for peer-executed (direct) tasks:
             # restores state-API/metrics visibility without a per-task
@@ -2619,6 +2903,13 @@ class Runtime:
     def _req_get_object(self, wid: str, req_id: int, oid: str):
         with self.lock:
             if not self.store.is_ready(oid):
+                # A lost-but-lineaged object (typically a journaled inline
+                # result whose bytes died with the previous head) would
+                # otherwise park forever: kick a reconstruction first, then
+                # park behind it.  Harmless when the producer is already in
+                # flight (_reconstruct dedupes by task_id).
+                if oid in self.lineage:
+                    self._reconstruct(oid)
                 self._park_get(wid, req_id, oid)
                 return _PARKED
         try:
@@ -2718,8 +3009,9 @@ class Runtime:
             len(self.lineage) > self.lineage_max
             or self.lineage_bytes > self.lineage_max_bytes
         ):
-            _, old = self.lineage.popitem(last=False)
+            evicted, old = self.lineage.popitem(last=False)
             self.lineage_bytes -= self._lineage_cost(old)
+            self._inline_lineage.discard(evicted)
 
     @_locked
     def _reconstruct(self, oid: str) -> bool:
@@ -3178,6 +3470,14 @@ class Runtime:
                 ready_ids.append(oid)
                 if spec.actor_id is None:
                     self._lineage_record(oid, spec)
+                    if kind != "shm":
+                        # Inline bytes live ONLY in this process: journal
+                        # the lineage entry so a post-restart get() can
+                        # re-execute the producer instead of erroring
+                        # (sealed results survive in node stores and need
+                        # no journal).
+                        self._inline_lineage.add(oid)
+                        self._journal_append(("lineage", oid, spec))
             if spec.is_actor_creation:
                 self._on_actor_alive(spec.actor_id)
         else:
@@ -3831,6 +4131,8 @@ class Runtime:
         set_ref_hooks(None, None)
         if getattr(self, "_snapshot_storage", None) is not None:
             self._snapshot_storage.close()
+        if getattr(self, "_journal", None) is not None:
+            self._journal.close()
         if getattr(self, "_mem_monitor", None) is not None:
             self._mem_monitor.stop()
         # Final log drain: crash output written moments ago must reach the

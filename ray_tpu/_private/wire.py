@@ -93,8 +93,10 @@ class ProtocolError(ConnectionError):
 # `None` in the types tuple = any.  Extra fields beyond the typed prefix
 # are unconstrained (payload positions).  max_extra None = unbounded.
 SCHEMAS: Dict[str, Tuple[int, Optional[int], tuple]] = {
-    # worker/driver -> head
-    "ready": (3, 4, (str, int)),
+    # worker/driver -> head.  ready's optional 5th extra field is the
+    # reconnect-time actor announcement (reconciliation handshake).
+    "ready": (3, 5, (str, int)),
+    "actor_announce": (1, 1, (list,)),
     "env_failed": (2, 2, (str, str)),
     "done": (3, 3, (str,)),
     "refop": (2, 2, (str, str)),

@@ -74,6 +74,10 @@ class WorkerRuntime:
         self._fn_cache: Dict[str, Any] = {}
         self.current_actor = None  # instance, when this worker hosts an actor
         self.current_actor_id: Optional[str] = None
+        # Creation TaskSpec of the hosted actor: re-announced with the
+        # reconnect hello so a restarted head can rebuild the actor record
+        # even when its journal was lost (reconciliation handshake).
+        self.current_actor_spec = None
         # Batched task-event reporter (installed by worker_main): the
         # direct transport records lease-dispatch RUNNING events here.
         self.task_event_sink = None
@@ -320,8 +324,25 @@ class WorkerRuntime:
                 q.put((False, err))
         if self.direct is not None:
             self.direct.replay_promotions()
+            # Reconciliation handshake, caller leg: re-announce the direct
+            # actor routes this process holds so the restarted head can
+            # cross-check its rebuilt actor table (the hosting worker's
+            # own hello carries the authoritative record).
+            self.direct.announce_routes()
         self._replay_subscriptions()
         return True
+
+    def actor_announcement(self):
+        """Reconciliation payload for the reconnect hello: the live actor
+        this worker hosts, creation spec included, so a restarted head can
+        rebuild the record even when its journal was lost (None for
+        stateless workers)."""
+        if self.current_actor_id is None:
+            return None
+        return {
+            "actor_id": self.current_actor_id,
+            "creation_spec": self.current_actor_spec,
+        }
 
     def _replay_subscriptions(self) -> None:
         """After a head bounce: the restarted head's registry is empty."""
@@ -633,6 +654,7 @@ def _execute(rt: WorkerRuntime, spec: TaskSpec, blob: Optional[bytes]):
             args, kwargs = _resolve_args(rt, spec.args_blob)
             rt.current_actor = cls(*args, **kwargs)
             rt.current_actor_id = spec.actor_id
+            rt.current_actor_spec = spec
             results = _store_results(rt, spec, None)
         elif spec.actor_id is not None:
             method = getattr(rt.current_actor, spec.method_name)
@@ -946,7 +968,8 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
         return rt.reconnect_recover(
             newconn,
             lambda c: c.send(
-                ("ready", worker_id, os.getpid(), node_id, peer_endpoint)
+                ("ready", worker_id, os.getpid(), node_id, peer_endpoint,
+                 rt.actor_announcement())
             ),
         )
 

@@ -8,13 +8,19 @@ and every delay comes from a named, seeded RAY_TPU_FAULT_SPEC clause, so a
 failing run prints its seed and the exact spec to rerun.
 
 The soak boots a SPLIT cluster (standalone head subprocess + one external
-node daemon) and keeps three workloads running while the spec fires:
+node daemon) and keeps four workloads running while the spec fires:
 
   * task chains (produce -> fold, lineage + retries) — every round's
     results must be exactly right;
-  * a restartable actor under max_task_retries — every reply must match;
-  * serve HTTP traffic against a 2-replica deployment — every logical
-    request must eventually succeed.
+  * a NAMED restartable actor under max_task_retries — every reply must
+    match;
+  * an ANONYMOUS restartable actor whose worker is killed in the SAME
+    window as a head kill (the overlap ISSUE 5's journaled GCS exists
+    for) — the driver's handle must be re-resolved and serving again,
+    and the ledger proves a restart happened;
+  * serve HTTP traffic against a 2-replica deployment (replicas are
+    killed in the head-kill window too) — every logical request must
+    eventually succeed.
 
 The default schedule (seeded, per-process deterministic):
   * workers crash at their result-send hazard (wire.send of done/pdone
@@ -61,31 +67,33 @@ import ray_tpu  # noqa: E402
 # Per-process deterministic kill schedule + latency noise:
 #   * match=^done (anchored) kills RELAYED executors — chain task workers
 #     and the soak actor's worker — at their result-send hazard, but not
-#     direct-path repliers (pdone does not match), so the serve data
-#     plane (replicas) and control actors (proxy/controller) ride through
-#     the head bounces on their open peer conns.  (Killing replicas near
-#     a head bounce is expressible — wire.send:crash@proc=actor:Replica,
-#     match=pdone — but exposes a known gap: anonymous actor records die
-#     with the head, so a replica that dies before re-registration cannot
-#     be re-resolved.  See ROADMAP.)
+#     direct-path repliers (pdone does not match);
+#   * the old replica-kill/head-bounce EXCLUSION is LIFTED: the journaled
+#     GCS (ISSUE 5) persists ANONYMOUS actor records, so the schedule now
+#     deliberately overlaps them — the AnonSoak worker and each serve
+#     Replica crash at their t=29 (their clocks start at worker spawn,
+#     so these land during/right after the head's own t=30 death): an
+#     actor that dies while the head is down must be re-resolved from the
+#     restored record and restarted on its budget;
+#   * each head incarnation dies TWICE over: SIGKILL mid-journal-append
+#     at its t=24 (torn-tail hazard — replay must recover the complete
+#     prefix) and, if it gets there, mid-snapshot at its t=30;
 #   * only the FIRST daemon (soak-d1) dies — its store loss must heal via
-#     lineage before the head kill lands at t=30;
-#   * each head incarnation SIGKILLs itself mid-snapshot at its t=30.
+#     lineage before the head kills land;
 #   * wire.flush clauses exercise the BATCH hazard window: a worker dies
 #     mid-flush with a coalesced run of frames in flight (the receiver
 #     sees a torn stream — EOF or a truncated batch decode_frames rejects
 #     whole, never a partial dispatch), and a small probabilistic delay
-#     stretches flush windows to keep batch/ordering races warm.  The
-#     flush key is "<leading kind>:<reason>", so match=^done scopes the
-#     crash to done-batch flushes of relayed executors — same actor-safe
-#     scoping as the wire.send clause (a replica's pdone batches don't
-#     match, see the anonymous-actor gap note above).
+#     stretches flush windows to keep batch/ordering races warm.
 DEFAULT_SPEC = (
     "wire.send:crash@proc=worker,match=^done,after=40,every=53,times=2;"
     "wire.send:delay=0.002@prob=0.02;"
     "wire.flush:crash@proc=worker,match=^done,after=30,every=41,times=1;"
     "wire.flush:delay=0.002@prob=0.02;"
     "wire.send:crash@proc=daemon:soak-d1,at=18,times=1;"
+    "wire.send:crash@proc=actor:AnonSoak,at=29,times=1;"
+    "wire.send:crash@proc=actor:Replica,at=29,times=1;"
+    "gcs.journal_append:crash@proc=head,at=24,times=1;"
     "gcs.save:crash@proc=head,at=30,times=1"
 )
 
@@ -132,6 +140,24 @@ class SoakActor:
 
     def echo(self, i):
         _append(self.log_path, f"actor:{i}")
+        return i
+
+
+@ray_tpu.remote(max_restarts=100, max_task_retries=ACTOR_RETRIES)
+class AnonSoak:
+    """ANONYMOUS restartable actor — the record class that used to die
+    with the head.  Its spec clause kills the hosting worker at its t=29,
+    overlapping the head's own deaths: recovery requires the restarted
+    head to re-resolve the actor from persisted GCS state (journal) and
+    restart it on its budget.  __init__ logs so the ledger can PROVE a
+    restart happened (anoninit count >= 2)."""
+
+    def __init__(self, log_path):
+        self.log_path = log_path
+        _append(log_path, "anoninit:0")
+
+    def echo(self, i):
+        _append(self.log_path, f"anon:{i}")
         return i
 
 
@@ -271,6 +297,30 @@ class _ActorLoad(_Workload):
         # hammer would recycle the actor's worker every ~1s and the
         # one-box cluster would spend itself respawning processes.
         time.sleep(0.1)
+
+
+class _AnonLoad(_Workload):
+    """Drives the ANONYMOUS actor through the overlapping replica-kill +
+    head-kill window.  The driver keeps calling the SAME handle — after
+    the overlap, the handle only works again if the restarted head
+    re-resolved the anonymous record (pre-ISSUE-5 this was impossible:
+    the record died with the head)."""
+
+    def __init__(self, stop, log_path):
+        super().__init__("soak-anon", stop)
+        self.actor = AnonSoak.remote(log_path)
+
+    def step(self):
+        i = self.iterations
+
+        def check(outs):
+            if outs != [i]:
+                raise AssertionError(
+                    f"anon echo({i}) returned {outs[0]} (CORRUPT reply)"
+                )
+
+        self.eventually(lambda: [self.actor.echo.remote(i)], check)
+        time.sleep(0.1)  # same shared-box pacing as the named actor load
 
 
 class _ServeLoad(_Workload):
@@ -421,6 +471,7 @@ def run_soak(
         loads = [
             _ChainLoad(stop, log_path),
             _ActorLoad(stop, log_path),
+            _AnonLoad(stop, log_path),
         ]
         if use_serve:
             loads.append(_ServeLoad(stop, addr, serve_mod.get_http_address))
@@ -524,6 +575,8 @@ def run_soak(
         dup_execs = sum(c - 1 for c in counts.values() if c > 1)
         chains = next(w for w in loads if w.name == "soak-chains")
         actor = next(w for w in loads if w.name == "soak-actor")
+        anon = next(w for w in loads if w.name == "soak-anon")
+        anon_inits = counts.get("anoninit:0", 0)
         report.update(
             {
                 "chain_rounds": chains.iterations,
@@ -531,6 +584,9 @@ def run_soak(
                 "chain_redrives": chains.redrives,
                 "actor_calls": actor.iterations,
                 "actor_redrives": actor.redrives,
+                "anon_actor_calls": anon.iterations,
+                "anon_actor_redrives": anon.redrives,
+                "anon_actor_restarts": max(anon_inits - 1, 0),
                 "distinct_executions": len(counts),
                 "duplicate_executions": dup_execs,
                 "execution_budget": budget,
@@ -549,6 +605,16 @@ def run_soak(
         assert dup_execs >= 1, (
             "no task was ever re-executed: worker kill clauses never fired"
         )
+        # ISSUE 5 acceptance: the anonymous actor was killed (at=29, in
+        # the head-kill window), RESTARTED from the restored record
+        # (>= 2 inits), and its handle kept serving to the drained end
+        # (anon workload finished with zero failures above).  Pre-journal,
+        # this workload could not survive the overlap at all.
+        assert anon_inits >= 2, (
+            "anonymous actor never restarted — the AnonSoak kill clause "
+            "never fired or the record did not survive the head bounce"
+        )
+        assert anon.iterations >= 10, "soak too short: <10 anon-actor calls ran"
         if watch_locks:
             wd = lock_watchdog.collect_dir_reports(watchdog_dir)
             wd.extend(f"driver: {r}" for r in lock_watchdog.reports())

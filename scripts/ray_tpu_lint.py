@@ -3,8 +3,8 @@
 
     python scripts/ray_tpu_lint.py [ray_tpu/] [--fix-allowlist] [-v]
 
-Runs the four analysis passes (blocking-under-lock, lock-order,
-fault-registry, hot-send — see ray_tpu/_private/analysis/) over the package and
+Runs the five analysis passes (blocking-under-lock, lock-order,
+fault-registry, hot-send, gcs-mutation — see ray_tpu/_private/analysis/) over the package and
 exits non-zero on any violation not covered by the reviewed allowlist
 (ray_tpu/_private/analysis/allowlist.txt).  Tier-1 tests run this same
 entry point (tests/test_concurrency_lint.py), so a new blocking call
@@ -97,7 +97,7 @@ def main(argv=None) -> int:
     for v in result.violations:
         by_pass.setdefault(v.pass_name, []).append(v)
     for pass_name in ("blocking-under-lock", "lock-order", "fault-registry",
-                      "hot-send"):
+                      "hot-send", "gcs-mutation"):
         vs = by_pass.get(pass_name, [])
         new = [v for v in vs if v.key not in result.allowlist]
         print(

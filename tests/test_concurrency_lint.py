@@ -451,3 +451,79 @@ def test_cli_fails_on_seeded_violation(tmp_path):
     entries = {k: "fixture: intentional" for k in entries}
     allowlist_mod.save(allow, entries)
     assert ray_tpu_lint.main(args) == 0
+
+
+# ---------------------------------------------------------------------------
+# pass 5: gcs-mutation (journaled-table writes outside gcs.py)
+
+
+def test_gcs_mutation_detects_direct_table_writes(tmp_path):
+    from ray_tpu._private.analysis import gcs_mutation
+
+    p = _write(
+        tmp_path,
+        "fix_gcs.py",
+        """
+        class Runtime:
+            def bad_subscript(self, info):
+                self.state.actors[info.actor_id] = info  # seeded violation
+
+            def bad_pop(self, aid):
+                self.state.named_actors.pop(("ns", "name"), None)  # seeded
+
+            def bad_update(self, jobs):
+                self.state.jobs.update(jobs)  # seeded violation
+
+            def bad_del(self, aid):
+                del self.state.actors[aid]  # seeded violation
+
+            def fine_reads(self, aid):
+                a = self.state.actors.get(aid)
+                for x in self.state.actors.values():
+                    pass
+                return a, len(self.state.jobs)
+
+            def fine_mutators(self, info):
+                self.state.register_actor(info)
+                self.state.set_actor_state(info.actor_id, "ALIVE")
+                self.state.set_job_state("j1", "RUNNING")
+
+            def fine_unrelated_tables(self, aid):
+                # runtime-side bookkeeping dicts are NOT the GCS tables
+                self.actors[aid] = object()
+                self.workers.pop(aid, None)
+        """,
+    )
+    found = gcs_mutation.scan_file(p, "fix_gcs.py")
+    assert len(found) == 4, [v.key for v in found]
+    tables = {v.key.split(":")[-2] for v in found}
+    assert tables == {
+        "self.state.actors", "self.state.named_actors", "self.state.jobs"
+    }
+
+
+def test_gcs_mutation_exempts_the_mutator_module(tmp_path):
+    from ray_tpu._private.analysis import gcs_mutation
+
+    p = _write(
+        tmp_path,
+        "gcs.py",
+        """
+        class GlobalState:
+            def register_actor(self, info):
+                self.actors[info.actor_id] = info
+        """,
+    )
+    # Only the real module path is exempt — a stray gcs.py elsewhere is not.
+    assert gcs_mutation.scan_file(p, "ray_tpu/_private/gcs.py") == []
+    # self.actors on a non-state receiver is out of scope anyway, so seed a
+    # state-shaped write to prove the non-exempt path fires.
+    p2 = _write(
+        tmp_path,
+        "other.py",
+        """
+        def bad(rt, info):
+            rt.state.actors[info.actor_id] = info  # seeded violation
+        """,
+    )
+    assert len(gcs_mutation.scan_file(p2, "other.py")) == 1

@@ -1,0 +1,275 @@
+"""Durable control plane across head failure (ISSUE 5).
+
+The two PR-1 chaos-soak gaps, closed and pinned here:
+  (a) ANONYMOUS actor records now live in persisted GCS state (snapshot +
+      mutation journal) — an actor that dies while the head is down is
+      restarted from its persisted ActorInfo and restart budget
+      (ray: gcs_actor_manager keeps ALL records in the GCS tables);
+  (b) completed INLINE results re-execute from journaled lineage after a
+      head bounce instead of erroring or parking forever
+      (ray: task_manager.h:97 lineage + object_recovery_manager.h:41).
+
+Plus the reconciliation handshake: with the journal disabled AND the
+snapshot destroyed, a surviving worker's re-announcement alone rebuilds
+the actor record on the restarted head.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.head import launch_head_subprocess
+from ray_tpu.exceptions import ActorDiedError, GetTimeoutError
+
+
+def _append(path, line):
+    with open(path, "a") as f:
+        f.write(line + "\n")
+
+
+def _count_lines(path):
+    try:
+        with open(path) as f:
+            return sum(1 for ln in f if ln.strip())
+    except FileNotFoundError:
+        return 0
+
+
+def _relaunch(tmp_path, session, proc):
+    os.kill(proc.pid, signal.SIGKILL)
+    proc.wait(timeout=10)
+    return launch_head_subprocess(str(tmp_path), num_cpus=4, session=session)
+
+
+def _cleanup(proc):
+    ray_tpu.shutdown()
+    if proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def test_anonymous_actor_restarts_after_overlapping_kill(tmp_path):
+    """The overlapping-kill shape the soak was forbidden from scheduling
+    before this PR: the actor's worker dies WHILE the head is down, so it
+    can never re-register with the restarted head.  The head restores the
+    ANONYMOUS record from the journal, waits out the adoption grace, and
+    respawns the actor from its creation spec, charging restart budget."""
+    marker = str(tmp_path / "inits.log")
+    proc, head_json = launch_head_subprocess(str(tmp_path), num_cpus=4, session="danon")
+    try:
+        ray_tpu.init(address=head_json)
+
+        @ray_tpu.remote(max_restarts=3, max_task_retries=3)
+        class Anon:
+            def __init__(self, marker):
+                _append(marker, "init")
+
+            def ping(self, i):
+                return i
+
+            def pid(self):
+                return os.getpid()
+
+        a = Anon.remote(marker)  # no name, not detached: anonymous
+        assert ray_tpu.get(a.ping.remote(1), timeout=60) == 1
+        wpid = ray_tpu.get(a.pid.remote(), timeout=60)
+        assert _count_lines(marker) == 1
+        time.sleep(1.0)  # a snapshot tick + the journal both have it now
+
+        # Head dies first; the worker dies DURING the outage — the
+        # record's only survival path is the persisted GCS state.
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10)
+        os.kill(wpid, signal.SIGKILL)
+        proc, head_json = launch_head_subprocess(
+            str(tmp_path), num_cpus=4, session="danon"
+        )
+        # The worker died WITH the head: nothing re-binds during the
+        # adoption grace, so the head must respawn from the persisted
+        # record.  Retry across the grace window.
+        deadline = time.monotonic() + 90
+        got = None
+        while time.monotonic() < deadline:
+            try:
+                got = ray_tpu.get(a.ping.remote(2), timeout=30)
+                break
+            except (ActorDiedError, GetTimeoutError, ConnectionError):
+                time.sleep(1.0)
+        assert got == 2, "anonymous actor never came back after the head bounce"
+        assert _count_lines(marker) >= 2, "actor was not actually respawned"
+    finally:
+        _cleanup(proc)
+
+
+def test_anonymous_actor_without_budget_stays_dead(tmp_path):
+    """max_restarts=0 + death during the outage: the restored record's
+    budget is exhausted, so the actor transitions to DEAD (with a loud
+    cause) instead of being resurrected for free."""
+    proc, head_json = launch_head_subprocess(str(tmp_path), num_cpus=4, session="dnobudget")
+    try:
+        ray_tpu.init(address=head_json)
+
+        @ray_tpu.remote  # max_restarts=0
+        class OneShot:
+            def ping(self, i):
+                return i
+
+            def pid(self):
+                return os.getpid()
+
+        a = OneShot.remote()
+        assert ray_tpu.get(a.ping.remote(1), timeout=60) == 1
+        wpid = ray_tpu.get(a.pid.remote(), timeout=60)
+        time.sleep(1.0)
+
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10)
+        os.kill(wpid, signal.SIGKILL)
+        proc, head_json = launch_head_subprocess(
+            str(tmp_path), num_cpus=4, session="dnobudget"
+        )
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                ray_tpu.get(a.ping.remote(2), timeout=20)
+            except ActorDiedError:
+                return  # the budget-exhausted death surfaced
+            except (GetTimeoutError, ConnectionError):
+                pass
+            time.sleep(0.5)
+        pytest.fail("budget-exhausted anonymous actor never surfaced ActorDiedError")
+    finally:
+        _cleanup(proc)
+
+
+def test_inline_result_reexecutes_after_head_bounce(tmp_path):
+    """A completed small (inline) result lived only in the old head's
+    memory.  Post-restart, get() on its ref re-executes the producer from
+    the journaled lineage entry — no client re-drive (PR-1 gap (b))."""
+    marker = str(tmp_path / "execs.log")
+    proc, head_json = launch_head_subprocess(str(tmp_path), num_cpus=4, session="dinline")
+    try:
+        ray_tpu.init(address=head_json)
+
+        @ray_tpu.remote
+        def produce(marker):
+            _append(marker, "run")
+            return 41 + 1  # far below max_direct_call_object_size: inline
+
+        ref = produce.remote(marker)
+        assert ray_tpu.get(ref, timeout=60) == 42
+        assert _count_lines(marker) == 1
+        time.sleep(1.0)  # let a snapshot tick persist the function export
+
+        proc, head_json = _relaunch(tmp_path, "dinline", proc)
+        deadline = time.monotonic() + 90
+        got = None
+        while time.monotonic() < deadline:
+            try:
+                got = ray_tpu.get(ref, timeout=30)
+                break
+            except (ConnectionError, GetTimeoutError):
+                time.sleep(1.0)
+        assert got == 42, "inline result was not recovered from lineage"
+        assert _count_lines(marker) >= 2, (
+            "producer was not re-executed — where did the bytes come from?"
+        )
+    finally:
+        _cleanup(proc)
+
+
+def _launch_external_daemon(head_json, node_id, resources):
+    with open(head_json) as f:
+        info = json.load(f)
+    env = os.environ.copy()
+    env.update(
+        {
+            "RAY_TPU_DRIVER_HOST": info["host"],
+            "RAY_TPU_DRIVER_PORT": str(info["port"]),
+            "RAY_TPU_AUTHKEY": info["authkey"],
+            "RAY_TPU_NODE_CONFIG": json.dumps(
+                {
+                    "node_id": node_id,
+                    "session": info["session"],
+                    "num_cpus": 2,
+                    "resources": resources,
+                    "labels": {},
+                }
+            ),
+            "RAY_TPU_RECONNECT_WINDOW_S": "30",
+            "RAY_TPU_GCS_JOURNAL": "0",
+            "PYTHONPATH": os.pathsep.join(sys.path),
+        }
+    )
+    return subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.node_daemon"], env=env, close_fds=True
+    )
+
+
+def test_worker_reannouncement_rebuilds_lost_record(tmp_path, monkeypatch):
+    """Belt-and-suspenders leg of the reconciliation handshake: journal
+    DISABLED and every persisted document destroyed between incarnations
+    — the surviving worker's reconnect hello re-announces its anonymous
+    actor (creation spec included) and the head rebuilds the record from
+    that alone; the driver's existing handle works again."""
+    monkeypatch.setenv("RAY_TPU_GCS_JOURNAL", "0")
+    proc, head_json = launch_head_subprocess(str(tmp_path), num_cpus=2, session="dreann")
+    daemon = _launch_external_daemon(head_json, "n-ann-1", {"ann": 4.0})
+    try:
+        ray_tpu.init(address=head_json)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if ray_tpu.cluster_resources().get("ann"):
+                break
+            time.sleep(0.2)
+        assert ray_tpu.cluster_resources().get("ann"), "external daemon never joined"
+
+        @ray_tpu.remote(max_restarts=1, max_task_retries=3, resources={"ann": 1.0})
+        class Keeper:
+            def __init__(self):
+                self.n = 0
+
+            def incr(self):
+                self.n += 1
+                return self.n
+
+        a = Keeper.remote()
+        assert ray_tpu.get(a.incr.remote(), timeout=60) == 1
+        assert ray_tpu.get(a.incr.remote(), timeout=60) == 2
+
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10)
+        # Destroy EVERY persisted control-plane document: only the
+        # re-announcement can rebuild the record now.
+        for fn in os.listdir(str(tmp_path)):
+            if fn.startswith("gcs_snapshot"):
+                os.unlink(str(tmp_path / fn))
+        proc, head_json = launch_head_subprocess(str(tmp_path), num_cpus=2, session="dreann")
+
+        got = None
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                got = ray_tpu.get(a.incr.remote(), timeout=20)
+                break
+            except (ActorDiedError, GetTimeoutError, ConnectionError):
+                time.sleep(0.5)
+        # n == 3: the LIVE worker re-bound with memory state intact —
+        # re-resolution, not a respawn.
+        assert got == 3, f"re-announced actor not re-resolved (got {got!r})"
+    finally:
+        daemon.terminate()
+        try:
+            daemon.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            daemon.kill()
+        _cleanup(proc)

@@ -128,3 +128,174 @@ def test_sqlite_version_stamp(tmp_path):
     snap = st.load("s2")
     assert snap is not None and snap["snapshot_version"] == gs.SNAPSHOT_VERSION
     st.close()
+
+
+# ---------------------------------------------------------------------------
+# mutation journal (append-only log between snapshot ticks)
+
+
+def _journal(tmp_path, session="s1"):
+    from ray_tpu._private.gcs_storage import make_mutation_journal
+
+    return make_mutation_journal(str(tmp_path / "snap.pkl"), session)
+
+
+def test_journal_append_replay_roundtrip(tmp_path):
+    j = _journal(tmp_path)
+    entries = [
+        ("actor_register", {"actor_id": "a1", "state": "PENDING_CREATION"}),
+        ("actor_state", "a1", "ALIVE", {"worker_id": "w1"}),
+        ("job_state", "drv-1", "RUNNING", {}),
+        ("lineage", "o:t1:0", {"spec": b"blob"}),
+    ]
+    for e in entries:
+        j.append(e)
+    j.close()
+    assert _journal(tmp_path).replay() == entries
+
+
+def test_journal_torn_tail_truncated_and_recovered(tmp_path, capsys):
+    j = _journal(tmp_path)
+    j.append(("actor_register", {"actor_id": "a1"}))
+    j.append(("actor_state", "a1", "ALIVE", {}))
+    j.close()
+    # Simulate a head SIGKILLed mid-append: a length header with a
+    # truncated body lands after the last complete record.
+    import struct
+
+    with open(j.path, "ab") as f:
+        f.write(struct.pack("<II", 500, 12345) + b"only-part-of-the-body")
+    size_torn = (tmp_path / "snap.pkl.journal").stat().st_size
+    replayed = _journal(tmp_path).replay()
+    assert replayed == [
+        ("actor_register", {"actor_id": "a1"}),
+        ("actor_state", "a1", "ALIVE", {}),
+    ]
+    assert "torn tail" in capsys.readouterr().err
+    # The tear was truncated so later appends don't land after garbage.
+    assert (tmp_path / "snap.pkl.journal").stat().st_size < size_torn
+    j2 = _journal(tmp_path)
+    j2.append(("actor_state", "a1", "DEAD", {}))
+    j2.close()
+    assert len(_journal(tmp_path).replay()) == 3
+
+
+def test_journal_foreign_session_refused(tmp_path):
+    j = _journal(tmp_path, "mine")
+    j.append(("actor_register", {"actor_id": "a1"}))
+    j.close()
+    assert _journal(tmp_path, "theirs").replay() == []
+    # ... but the rightful owner still replays it.
+    assert len(_journal(tmp_path, "mine").replay()) == 1
+
+
+def test_journal_version_mismatch_refused_loudly(tmp_path, capsys):
+    import pickle
+    import struct
+    import zlib
+
+    hdr = pickle.dumps({"session": "s1", "journal_version": 999})
+    rec = pickle.dumps(("actor_register", {"actor_id": "a1"}))
+    with open(str(tmp_path / "snap.pkl.journal"), "wb") as f:
+        for blob in (hdr, rec):
+            f.write(struct.pack("<II", len(blob), zlib.crc32(blob)) + blob)
+    assert _journal(tmp_path).replay() == []
+    assert "REFUSING journal replay" in capsys.readouterr().err
+    import os
+
+    assert os.path.exists(str(tmp_path / "snap.pkl.journal") + ".refused")
+
+
+def test_journal_fsync_policy(tmp_path, monkeypatch):
+    from ray_tpu._private import config
+
+    monkeypatch.setenv("RAY_TPU_GCS_JOURNAL_FSYNC", "2")
+    config._values.pop("gcs_journal_fsync", None)
+    j = _journal(tmp_path)
+    try:
+        # fsync every 2nd append: False, True, False, True...
+        assert j.append(("a", 1)) is False
+        assert j.append(("a", 2)) is True
+        assert j.append(("a", 3)) is False
+        assert j.append(("a", 4)) is True
+    finally:
+        j.close()
+        config._values.pop("gcs_journal_fsync", None)
+
+
+def test_journal_reset_compacts(tmp_path):
+    j = _journal(tmp_path)
+    j.append(("actor_register", {"actor_id": "a1"}))
+    assert j.size_bytes() > 0
+    j.reset()
+    assert j.size_bytes() == 0
+    assert _journal(tmp_path).replay() == []
+    # A fresh journal after reset stamps a new header and keeps working.
+    j.append(("actor_register", {"actor_id": "a2"}))
+    j.close()
+    assert _journal(tmp_path).replay() == [("actor_register", {"actor_id": "a2"})]
+
+
+def test_journal_compacted_into_next_snapshot(tmp_path):
+    """Runtime-level compaction: a journaled mutation is folded into the
+    next snapshot tick and the journal resets — restore then sees it in
+    the SNAPSHOT (and a replayed empty journal), not the journal."""
+    from ray_tpu._private.gcs import ActorInfo
+    from ray_tpu._private.runtime import Runtime
+    from ray_tpu._private.task_spec import TaskSpec
+
+    snap_path = str(tmp_path / "head-snap")
+    rt = Runtime(num_cpus=1, session_name="jcompact", snapshot_path=snap_path)
+    try:
+        spec = TaskSpec(
+            task_id="t1", name="mk", fn_id="f", args_blob=b"",
+            actor_id="act1", is_actor_creation=True,
+        )
+        rt.state.register_actor(
+            ActorInfo(actor_id="act1", name=None, max_restarts=1, creation_spec=spec)
+        )
+        assert rt._journal.size_bytes() > 0, "mutation must hit the journal"
+        rt._write_snapshot()
+        assert rt._journal.size_bytes() == 0, "snapshot must compact the journal"
+        snap = rt._snapshot_storage.load("jcompact")
+        assert any(a["actor_id"] == "act1" for a in snap["actors"])
+    finally:
+        rt.shutdown()
+
+
+def test_runtime_restores_anonymous_actor_from_journal_only(tmp_path):
+    """An ANONYMOUS actor registered+ALIVE'd after the last snapshot tick
+    survives a hard head death purely via the journal (the PR-1 gap:
+    these records used to die with the head)."""
+    from ray_tpu._private.gcs import ALIVE, RESTARTING, ActorInfo
+    from ray_tpu._private.runtime import Runtime
+    from ray_tpu._private.task_spec import TaskSpec
+
+    snap_path = str(tmp_path / "head-snap")
+    rt = Runtime(num_cpus=1, session_name="jrestore", snapshot_path=snap_path)
+    # Freeze the snapshot document: from here on ONLY the journal records
+    # mutations (pins that the restore below is journal-driven, not a
+    # lucky snapshot tick).
+    rt._write_snapshot = lambda: None
+    spec = TaskSpec(
+        task_id="t1", name="mk", fn_id="f", args_blob=b"",
+        actor_id="anon1", is_actor_creation=True,
+    )
+    rt.state.register_actor(
+        ActorInfo(actor_id="anon1", name=None, max_restarts=3, creation_spec=spec)
+    )
+    rt.state.set_actor_state("anon1", ALIVE, worker_id="w9", node_id="n1")
+    # Hard death: no shutdown, no final snapshot — only the journal knows.
+    rt._shutdown = True
+    rt.listener.close()
+
+    rt2 = Runtime(num_cpus=1, session_name="jrestore", snapshot_path=snap_path)
+    try:
+        info = rt2.state.get_actor("anon1")
+        assert info is not None
+        assert info.state == RESTARTING
+        assert info.worker_id == "w9"  # adoption binding preserved
+        assert info.max_restarts == 3
+        assert "anon1" in rt2._restored_actors
+    finally:
+        rt2.shutdown()
