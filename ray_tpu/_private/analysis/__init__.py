@@ -47,6 +47,7 @@ from ray_tpu._private.analysis import (
     gcs_mutation,
     hot_send,
     lock_order,
+    metric_names,
 )
 from ray_tpu._private.analysis import allowlist as allowlist_mod
 
@@ -56,6 +57,7 @@ PASSES = (
     "fault-registry",
     "hot-send",
     "gcs-mutation",
+    "metric-names",
 )
 
 
@@ -80,12 +82,13 @@ def run_analysis(
     spec_roots: Optional[Sequence[str]] = None,
     allowlist_path: Optional[str] = None,
     catalog_path: Optional[str] = None,
+    metric_catalog_path: Optional[str] = None,
 ) -> AnalysisResult:
-    """Run all three passes over `roots` (package dirs or files).
+    """Run every pass over `roots` (package dirs or files).
 
     spec_roots: where fault-spec literals are validated (tests/scripts);
-    catalog_path: committed fault-point catalog to check for staleness
-    (None = skip the staleness check, e.g. on fixture trees)."""
+    catalog_path / metric_catalog_path: committed generated catalogs to
+    check for staleness (None = skip, e.g. on fixture trees)."""
     files = []
     for root in roots:
         files.extend(iter_py_files(root))
@@ -95,9 +98,16 @@ def run_analysis(
         violations.extend(lock_order.scan_file(path, rel))
         violations.extend(hot_send.scan_file(path, rel))
         violations.extend(gcs_mutation.scan_file(path, rel))
+        violations.extend(metric_names.scan_file(path, rel))
     points = fault_registry.collect_points(files)
     if catalog_path is not None:
         violations.extend(fault_registry.check_catalog(points, catalog_path))
+    metrics = metric_names.collect_metrics(files)
+    violations.extend(metric_names.check_duplicates(metrics))
+    if metric_catalog_path is not None:
+        violations.extend(
+            metric_names.check_catalog(metrics, metric_catalog_path)
+        )
     spec_files = []
     for root in spec_roots or ():
         spec_files.extend(iter_py_files(root))

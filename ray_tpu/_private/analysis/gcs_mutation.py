@@ -37,9 +37,11 @@ from ray_tpu._private.analysis.common import (
 PASS = "gcs-mutation"
 
 # The journaled tables (GlobalState attributes whose mutations must ride
-# the journal).  kv/functions/placement_groups are snapshot-only by
-# design (full-table capture every tick) and stay out of scope.
-_JOURNALED_TABLES = frozenset({"actors", "named_actors", "jobs"})
+# the journal).  `functions` joined in the telemetry PR (function exports
+# are journaled so a lineage re-execution within the snapshot tick never
+# hits "unknown function" — the PR-4 residual); kv/placement_groups stay
+# snapshot-only by design (full-table capture every tick).
+_JOURNALED_TABLES = frozenset({"actors", "named_actors", "jobs", "functions"})
 
 # Mutating dict methods; everything else on the table is a read.
 _MUTATING_METHODS = frozenset({"pop", "popitem", "update", "setdefault", "clear"})

@@ -239,6 +239,32 @@ _DEFS: Dict[str, tuple] = {
         "replays the same injection schedule (print it on failure, rerun "
         "to reproduce)",
     ),
+    "metrics_push_ms": (
+        1000, int,
+        "how often every process (workers, daemons, attached drivers, the "
+        "head itself) snapshots its util/metrics registry + wire counters "
+        "and ships it to the head as a droppable oneway riding the v2 "
+        "batch frames; 0 disables the push (ray: "
+        "metrics_report_interval_ms, the OpenCensus export tick)",
+    ),
+    "telemetry_ring_samples": (
+        360, int,
+        "head-side bound on each aggregated metric's time series ring "
+        "(samples retained at the push period — 360 x 1s = 6 minutes; "
+        "ray: the GcsTaskManager ring-storage idiom applied to metrics)",
+    ),
+    "flight_ring_size": (
+        512, int,
+        "per-process flight-recorder ring: recent telemetry events "
+        "(spans, metric-push deltas, fault injections, cluster events) "
+        "retained in memory for a crash dump",
+    ),
+    "flight_dir": (
+        "", str,
+        "directory flight-recorder rings dump to (per-pid JSONL files) on "
+        "crash, lock-watchdog report, or fault-plane kill; empty disables "
+        "dumping (the ring still records)",
+    ),
     "zygote_fork_grace_s": (
         20.0, float,
         "how long a zygote-forked worker handle with no pid attribution "

@@ -77,7 +77,9 @@ def attach(
     conn = wire.connect((host, port), key)
     set_nodelay(conn)
     did = ids._fresh("drv")
-    conn.send(("driver", did, os.getpid()))
+    import time as _time
+
+    conn.send(("driver", did, os.getpid(), _time.time()))
     ack = conn.recv()
     if not (isinstance(ack, tuple) and ack[0] == "driver_ack"):
         conn.close()
@@ -131,7 +133,43 @@ def attach(
         # subscriber on the GCS log channel, _private/worker.py).
         rt.subscribe("logs", "*", _print_log_lines)
     _attached = rt
+    # Telemetry: the attached driver is a cluster process like any other —
+    # flight recorder armed, registry + span buffer pushed to the head on
+    # the period.  Started AFTER _attached lands: the loop's liveness
+    # check reads it, and a thread racing the assignment would exit
+    # before its first push.
+    from ray_tpu._private import telemetry
+
+    telemetry.install(f"driver:{did}")
+    threading.Thread(
+        target=_metrics_push_loop, args=(rt,), daemon=True,
+        name="raytpu-driver-telemetry",
+    ).start()
     return rt
+
+
+def _metrics_push_loop(rt) -> None:
+    """Periodic telemetry flush for an attached driver (workers push from
+    their events ticker; the driver has no executor loop, so it gets its
+    own): the metric snapshot AND this process's trace-span buffer — the
+    driver's submit:: spans are a leg of the merged cluster timeline.
+    Droppable oneways: a head bounce loses ticks, never wedges."""
+    import time as _time
+
+    from ray_tpu._private import config as _config
+    from ray_tpu._private import telemetry, wire
+    from ray_tpu.util import tracing
+
+    period = max(_config.get("metrics_push_ms"), 0) / 1000.0
+    if period <= 0:
+        return
+    while _attached is rt and not getattr(rt, "_detaching", False):
+        _time.sleep(period)
+        spans = tracing.drain_spans()
+        if spans:
+            rt.oneway(("spans", spans), droppable=True)
+        rt.oneway(("metrics_push", telemetry.snapshot_process()), droppable=True)
+        wire.flush_dirty()
 
 
 def _print_log_lines(wid, stream, lines) -> None:
@@ -166,7 +204,7 @@ def _try_reconnect(rt) -> bool:
         try:
             c = wire.connect((host, port), key)
             set_nodelay(c)
-            c.send(("driver", did, os.getpid()))
+            c.send(("driver", did, os.getpid(), _time.time()))
             ack = c.recv()
             if not (isinstance(ack, tuple) and ack and ack[0] == "driver_ack"):
                 c.close()

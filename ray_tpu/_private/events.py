@@ -59,6 +59,16 @@ class EventLog:
                     self._f.write(json.dumps(ev, default=str) + "\n")
                 except (OSError, ValueError):
                     pass
+        # Mirror into the flight-recorder ring (telemetry.py): a crash
+        # dump carries the control-plane transitions this process saw.
+        try:
+            from ray_tpu._private import telemetry
+
+            telemetry.note(
+                "event", severity=severity, source=source, message=message
+            )
+        except Exception:
+            pass
 
     def recent(
         self, limit: int = 100, severity: Optional[str] = None,

@@ -74,6 +74,7 @@ __all__ = [
     "log",
     "stats",
     "seed",
+    "set_crash_hook",
     "set_process_tag",
 ]
 
@@ -181,6 +182,18 @@ _PROC_TAG: str = (
 def set_process_tag(tag: str) -> None:
     global _PROC_TAG
     _PROC_TAG = tag
+
+
+# Pre-SIGKILL hook for 'crash' actions (telemetry.install sets the flight-
+# recorder dump here): the one chance to persist what this process saw
+# before the fault plane kills it.  Best-effort — a hook failure must not
+# turn a deterministic crash into anything else.
+_crash_hook: Optional[callable] = None
+
+
+def set_crash_hook(hook) -> None:
+    global _crash_hook
+    _crash_hook = hook
 
 
 def _parse_float(field: str, raw: str) -> float:
@@ -347,6 +360,11 @@ def point(name: str, key: Optional[str] = None) -> Optional[str]:
         elif c.action == "crash":
             import signal
 
+            if _crash_hook is not None:
+                try:
+                    _crash_hook(name)
+                except Exception:
+                    pass
             os.kill(os.getpid(), signal.SIGKILL)
         elif c.action == "error":
             raise InjectedFault(

@@ -155,8 +155,21 @@ class GlobalState:
     # -- functions -----------------------------------------------------------
 
     def export_function(self, fn_id: str, blob: bytes) -> None:
+        """Journaled (PR-4 residual closed): a lineage re-execution within
+        the first snapshot tick of an export used to hit "unknown
+        function" after a head bounce — the journal now carries the blob
+        the moment it is exported, not 0.5s later."""
         with self.lock:
+            if self.functions.get(fn_id) == blob:
+                return  # re-export of the same blob: don't re-journal it
             self.functions[fn_id] = blob
+            self._journal(("function", fn_id, blob))
+
+    def import_functions(self, functions: Dict[str, bytes]) -> None:
+        """Restore-path bulk load (snapshot merge) — NOT journaled: the
+        entries came from the journal/snapshot being replayed."""
+        with self.lock:
+            self.functions.update(functions)
 
     def get_function(self, fn_id: str) -> Optional[bytes]:
         with self.lock:

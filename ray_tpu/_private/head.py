@@ -107,6 +107,13 @@ def main() -> None:
         listen_port=listen_port,
         authkey=authkey,
     )
+    # Register the singleton: head-side surfaces that read through
+    # get_runtime() — the dashboard/timeline export an attached driver's
+    # `ray_tpu timeline` request serves, the state API — work in the
+    # standalone head exactly as they do in an in-process driver.
+    from ray_tpu._private import runtime as runtime_mod
+
+    runtime_mod._runtime = rt
     write_head_info(session_dir, rt)
 
     stop = {"flag": False}

@@ -59,6 +59,18 @@ def _held_stack() -> List[str]:
         return _tls.held
 
 
+# Report hook (telemetry.install sets the flight-recorder dump here): a
+# watchdog finding also dumps the process's recent-event ring, so the
+# report file names WHAT inverted and the flight dump shows what the
+# process was doing around it.
+_report_hook = None
+
+
+def set_report_hook(hook) -> None:
+    global _report_hook
+    _report_hook = hook
+
+
 def _emit(report: str) -> None:
     with _registry_lock:
         _reports.append(report)
@@ -70,6 +82,11 @@ def _emit(report: str) -> None:
             ) as f:
                 f.write(report + "\n")
         except OSError:
+            pass
+    if _report_hook is not None:
+        try:
+            _report_hook(report)
+        except Exception:
             pass
     import sys
 

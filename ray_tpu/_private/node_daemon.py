@@ -96,9 +96,10 @@ def main() -> None:
     cfg = json.loads(os.environ["RAY_TPU_NODE_CONFIG"])
     node_id = cfg["node_id"]
     session = cfg["session"]
-    from ray_tpu._private import faults
+    from ray_tpu._private import faults, telemetry
 
     faults.set_process_tag(f"daemon:{node_id}")
+    telemetry.install(f"daemon:{node_id}")
 
     # The node object store: an isolated per-node directory (distinct even
     # when several daemons share one machine in tests — no cross-node path
@@ -131,6 +132,8 @@ def main() -> None:
         # the loop's blocking wait).
         c = wire.batching(wire.connect((host, port), authkey))
         set_nodelay(c)
+        import time as _t
+
         c.send(
             (
                 "daemon",
@@ -140,6 +143,9 @@ def main() -> None:
                     "resources": cfg.get("resources") or {},
                     "labels": cfg.get("labels") or {},
                     "object_endpoint": obj_server.endpoint,
+                    # Clock-offset sample for the head's merged timeline
+                    # (same estimate the worker ready hello carries).
+                    "clock": _t.time(),
                 },
                 os.getpid(),
             )
@@ -370,6 +376,8 @@ def main() -> None:
     start_zygote()
     hb_period = _config.get("health_check_period_ms") / 1000.0
     last_hb = 0.0
+    push_period = max(_config.get("metrics_push_ms"), 0) / 1000.0
+    last_push = 0.0
 
     pending_kills: set = set()  # kill_worker raced a fork in flight
 
@@ -426,6 +434,23 @@ def main() -> None:
                     conn.send(("heartbeat", node_id))
             except OSError:
                 pass  # EOF path below handles reconnection
+        if push_period > 0 and now - last_push >= push_period:
+            # Telemetry push: the daemon's registry + wire counters plus
+            # its store gauges, riding the same batch flush the heartbeat
+            # does (droppable: a failed send just loses a tick).
+            last_push = now
+            snap = telemetry.snapshot_process(
+                extra={
+                    "node_live_workers": float(
+                        len(children) + sum(1 for p in zpids.values() if p > 0)
+                    ),
+                }
+            )
+            try:
+                with send_lock:
+                    conn.send(("metrics_push", snap))
+            except OSError:
+                pass
         # Flush-before-blocking-wait: the heartbeat above plus any pending
         # log_lines / worker_exited / oom reports leave as one write.
         try:

@@ -318,11 +318,120 @@ ALL = [
 ]
 
 
+# ---------------------------------------------------------------------------
+# telemetry A/B: the observability plane's performance acceptance bar
+
+
+def _multi_client_once(n_clients: int = 4, n_per: int = 1000) -> float:
+    """One timed multi_client_tasks_async wave on the CURRENT cluster
+    (fresh clients, one warm round): ops/s."""
+    clients = [_Client.remote() for _ in range(n_clients)]
+    ray_tpu.get([c.run_tasks.remote(1, 1) for c in clients], timeout=60)
+    t0 = time.perf_counter()
+    done = ray_tpu.get(
+        [c.run_tasks.remote(n_per, 100) for c in clients], timeout=300
+    )
+    dt = time.perf_counter() - t0
+    for c in clients:
+        ray_tpu.kill(c)
+    return round(sum(done) / dt, 1)
+
+
+def telemetry_ab(out_path=None, rounds: int = 3, budget_pct: float = 3.0):
+    """A/B the FULL telemetry plane (metric push + trace spans + flight
+    recorder) against telemetry-off on the multi_client_tasks_async
+    shape.  Runs interleave OFF/ON per round (drift on a shared host
+    cancels instead of biasing one side) and the medians-of-N compare —
+    the same honesty rule as the headline benches.  Asserts the overhead
+    budget (<3% by the ISSUE 6 acceptance bar) and writes the artifact.
+
+        python -m ray_tpu._private.ray_perf --telemetry-ab \
+            [--json BENCH_telemetry_r1.json]
+    """
+    import os as _os
+    import statistics
+
+    from ray_tpu._private import config as _config
+    from ray_tpu.util import tracing
+
+    flight_dir = f"/tmp/raytpu-ab-flight-{_os.getpid()}"
+    saved = {
+        k: _os.environ.get(k)
+        for k in ("RAY_TPU_METRICS_PUSH_MS", "RAY_TPU_TRACE", "RAY_TPU_FLIGHT_DIR")
+    }
+    runs = {"off": [], "on": []}
+    try:
+        for _r in range(rounds):
+            for mode in ("off", "on"):
+                if mode == "off":
+                    _os.environ["RAY_TPU_METRICS_PUSH_MS"] = "0"
+                    _os.environ.pop("RAY_TPU_TRACE", None)
+                    _os.environ.pop("RAY_TPU_FLIGHT_DIR", None)
+                    tracing.disable_tracing()
+                else:
+                    # The default push period, tracing on, flight dumps
+                    # armed — the whole plane, not a softened subset.
+                    _os.environ["RAY_TPU_METRICS_PUSH_MS"] = "1000"
+                    _os.environ["RAY_TPU_TRACE"] = "1"
+                    _os.environ["RAY_TPU_FLIGHT_DIR"] = flight_dir
+                    tracing.enable_tracing()
+                _config._reset_for_tests()
+                ray_tpu.init(num_cpus=max(_os.cpu_count() or 1, 16))
+                try:
+                    ops = _multi_client_once()
+                finally:
+                    ray_tpu.shutdown()
+                runs[mode].append(ops)
+                print(
+                    json.dumps({"mode": mode, "round": _r, "ops_per_s": ops}),
+                    flush=True,
+                )
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                _os.environ.pop(k, None)
+            else:
+                _os.environ[k] = v
+        _config._reset_for_tests()
+        tracing.disable_tracing()
+    off_m = statistics.median(runs["off"])
+    on_m = statistics.median(runs["on"])
+    overhead_pct = round((off_m - on_m) / off_m * 100, 2)
+    report = {
+        "name": "telemetry_ab_multi_client_tasks_async",
+        "note": (
+            "interleaved OFF/ON rounds; medians compared (median-of-"
+            f"{rounds}).  ON = RAY_TPU_METRICS_PUSH_MS=1000 + "
+            "RAY_TPU_TRACE=1 + flight recorder armed; OFF = push "
+            "disabled, no tracing, no flight dir"
+        ),
+        "off_runs": runs["off"],
+        "on_runs": runs["on"],
+        "off_median_ops_per_s": off_m,
+        "on_median_ops_per_s": on_m,
+        "overhead_pct": overhead_pct,
+        "budget_pct": budget_pct,
+        "pass": overhead_pct < budget_pct,
+    }
+    print(json.dumps(report, indent=1), flush=True)
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=1)
+            f.write("\n")
+    assert overhead_pct < budget_pct, (
+        f"telemetry plane costs {overhead_pct}% on multi_client_tasks_async "
+        f"(budget {budget_pct}%): off={runs['off']} on={runs['on']}"
+    )
+    return report
+
+
 def main(argv=None):
     argv = argv if argv is not None else sys.argv[1:]
     out_path = None
     if "--json" in argv:
         out_path = argv[argv.index("--json") + 1]
+    if "--telemetry-ab" in argv:
+        return telemetry_ab(out_path)
     import os as _os
 
     # Logical-CPU headroom: the benches measure control-plane throughput,

@@ -455,6 +455,21 @@ class Runtime:
         # Trace-span sink (util/tracing.py; ray: spans land in the GCS task
         # events the same batched way).
         self.trace_spans: deque = deque(maxlen=10000)
+        # Per-sender clock-offset estimates (seconds to ADD to a sender's
+        # timestamps to land them on this process's clock), sampled from
+        # the time.time() the ready/driver/daemon hellos carry.  The spans
+        # and task-event batches a sender ships are corrected at ingest so
+        # the merged timeline (`ray_tpu timeline`) is one coherent clock.
+        self.clock_offsets: Dict[str, float] = {}
+        # Telemetry sink: latest pushed metric snapshot per process plus
+        # bounded ring-buffer time series (telemetry.py; ray: the GCS-side
+        # metrics aggregation the dashboard agent performs).
+        from ray_tpu._private import config as _tcfg
+        from ray_tpu._private import telemetry as _telemetry
+
+        self.telemetry = _telemetry.TelemetrySink(
+            ring_samples=_tcfg.get("telemetry_ring_samples")
+        )
         self.pubsub = Publisher()
         import queue as _queue
 
@@ -694,6 +709,17 @@ class Runtime:
         self._io_thread = threading.Thread(target=self._io_loop, daemon=True, name="raytpu-io")
         self._accept_thread.start()
         self._io_thread.start()
+        # Telemetry plane, head side: arm the flight recorder in this
+        # process (workers/daemons/drivers arm their own at entry) and
+        # start the aggregation tick — the head "pushes to itself" by
+        # ingesting its own registry + internal queue-depth gauges, then
+        # folds the cluster aggregate into the time-series rings.
+        _telemetry.install(faults._PROC_TAG)
+        if _config.get("metrics_push_ms") > 0:
+            threading.Thread(
+                target=self._telemetry_loop, daemon=True,
+                name="raytpu-telemetry",
+            ).start()
 
         # Head-node OOM protection: the head process doubles as this node's
         # daemon for locally-spawned workers, so it runs the same memory
@@ -803,6 +829,50 @@ class Runtime:
             except Exception:
                 pass  # next tick retries; persistence is best-effort
 
+    def head_telemetry_snapshot(self) -> dict:
+        """This process's telemetry snapshot plus the head-internal gauges
+        remote processes can't see (scheduler/lease queue depths, journal
+        counters, store occupancy).  Used by the telemetry tick AND the
+        read-time fresh ingest (state API / prometheus endpoint) so both
+        carry the same fields."""
+        from ray_tpu._private import telemetry as _telemetry
+
+        with self.lock:
+            internal = {
+                "head_ready_queue_depth": float(len(self.ready_queue)),
+                "head_live_tasks": float(len(self.tasks)),
+                "head_peer_leases": float(len(self.peer_leases)),
+                "head_pending_fences": float(len(self._pending_fences)),
+                "head_live_workers": float(
+                    sum(1 for h in self.workers.values() if h.state != "dead")
+                ),
+                "journal_appends": float(self.metrics["journal_appends"]),
+                "journal_fsyncs": float(self.metrics["journal_fsyncs"]),
+                "tasks_finished": float(self.metrics["tasks_finished"]),
+                "tasks_failed": float(self.metrics["tasks_failed"]),
+            }
+        internal["object_store_bytes_used"] = float(self.store.shm_usage())
+        internal["objects_spilled"] = float(len(self.store._spilled))
+        return _telemetry.snapshot_process(extra=internal)
+
+    def _telemetry_loop(self) -> None:
+        """Head-side telemetry tick (telemetry.py): snapshot this
+        process's registry + internal queue-depth gauges into the sink,
+        then fold the cluster aggregate into the time-series rings.  One
+        sample per metrics_push_ms — same period the remote pushers use."""
+        from ray_tpu._private import config as _config
+
+        period = max(_config.get("metrics_push_ms"), 250) / 1000.0
+        while not self._shutdown:
+            time.sleep(period)
+            if self._shutdown:
+                return
+            try:
+                self.telemetry.ingest("head", self.head_telemetry_snapshot())
+                self.telemetry.sample()
+            except Exception:
+                pass  # telemetry must never take the control plane down
+
     def _journal_append(self, entry: tuple) -> None:
         """GlobalState journal hook + inline-lineage writer: mirror one
         control-plane mutation into the append-only journal.  Best-effort
@@ -911,7 +981,7 @@ class Runtime:
         from ray_tpu._private import config as _config
         for ns, d in snap.get("kv", {}).items():
             self.state.kv.setdefault(ns, {}).update(d)
-        self.state.functions.update(snap.get("functions", {}))
+        self.state.import_functions(snap.get("functions", {}))
         for oid, locs in snap.get("object_locations", {}).items():
             self.object_locations.setdefault(oid, set()).update(locs)
             # Surviving node copies must satisfy gets on the restarted
@@ -956,6 +1026,12 @@ class Runtime:
                     )
                 elif kind == "lineage":
                     restored_lineage.append((entry[1], entry[2]))
+                elif kind == "function":
+                    # Function exports journaled since the last snapshot:
+                    # without these, a lineage re-execution of a task whose
+                    # fn was exported within the final 0.5s tick fails
+                    # "unknown function" (the PR-4 residual).
+                    self.state.import_functions({entry[1]: entry[2]})
             except (IndexError, KeyError, TypeError, ValueError):
                 continue  # malformed journal entry: skip, don't block boot
         for jid, rec in jobs.items():
@@ -1200,6 +1276,7 @@ class Runtime:
         lives on.  Drop the driver's ref borrows, kill its non-detached
         actors; lifetime="detached" actors keep serving
         (ray: gcs_actor_manager OnJobFinished + gcs_job_manager)."""
+        self.telemetry.forget(did)
         with self.lock:
             self.drivers.pop(did, None)
             self.driver_nodes.pop(did, None)
@@ -1638,11 +1715,14 @@ class Runtime:
                 object_plane.stream_object(conn, self.store.get_raw_packed, first[1])
             return
         if first[0] == "driver":
-            # Attached driver client (head-split mode): ("driver", did, pid).
-            # Reply with session metadata, then a second message declares
-            # whether the driver co-locates with the head store (zero-copy
-            # reads) or stays remote (ray://-style: conn + transfer plane).
-            _, did, _pid = first
+            # Attached driver client (head-split mode): ("driver", did,
+            # pid[, t_sent]).  Reply with session metadata, then a second
+            # message declares whether the driver co-locates with the head
+            # store (zero-copy reads) or stays remote (ray://-style: conn
+            # + transfer plane).
+            did, _pid = first[1], first[2]
+            if len(first) > 3 and isinstance(first[3], float):
+                self.clock_offsets[did] = time.time() - first[3]
             try:
                 from ray_tpu._private import config as _config
 
@@ -1695,6 +1775,10 @@ class Runtime:
             # Node daemon registration: ("daemon", node_id, cfg, pid).
             _, node_id, cfg, _pid = first
             res = {"CPU": float(cfg.get("num_cpus", 1.0)), **(cfg.get("resources") or {})}
+            if isinstance(cfg.get("clock"), float):
+                self.clock_offsets[f"daemon:{node_id}"] = (
+                    time.time() - cfg["clock"]
+                )
             with self.lock:
                 if node_id not in self.state.nodes:
                     self.state.register_node(
@@ -1744,6 +1828,11 @@ class Runtime:
             conn.close()
             return
         wid = first[1]
+        if len(first) > 6 and isinstance(first[6], float):
+            # Clock-offset estimate: receive time minus the sender's send
+            # stamp (includes one-way latency — ms on loopback, fine for
+            # ordering spans across processes in the merged timeline).
+            self.clock_offsets[wid] = time.time() - first[6]
         with self.lock:
             if len(first) > 4 and first[4]:
                 self.worker_peer_endpoints[wid] = tuple(first[4])
@@ -2186,6 +2275,8 @@ class Runtime:
             self._on_log_lines(dmsg[1], dmsg[2], dmsg[3])
         elif dmsg[0] == "heartbeat":
             self._daemon_heartbeats[nid] = time.monotonic()
+        elif dmsg[0] == "metrics_push":
+            self.telemetry.ingest(f"daemon:{nid}", dmsg[1])
         elif dmsg[0] == "worker_oom_killed":
             with self.lock:
                 self._oom_kills[dmsg[1]] = dmsg[2:]
@@ -2340,8 +2431,13 @@ class Runtime:
             # EXECUTOR — different processes, so a completion may arrive
             # first (the recent-done set keeps such entries from sticking
             # as RUNNING forever).
+            off = self.clock_offsets.get(wid, 0.0)
             with self.lock:
                 for e in msg[1]:
+                    if off and isinstance(e.get("end_time"), float):
+                        # Land the sender's timestamps on the head clock so
+                        # the merged timeline orders across processes.
+                        e["end_time"] += off
                     tid = e.get("task_id")
                     if e.get("state") == "RUNNING":
                         if tid not in self._direct_done_recent:
@@ -2369,9 +2465,19 @@ class Runtime:
                     self.task_events.append(e)
         elif kind == "spans":
             # Worker-side trace spans (util/tracing.py), batched off the
-            # latency path like task events.
+            # latency path like task events.  Corrected onto the head
+            # clock at ingest (handshake-estimated offset) so the merged
+            # timeline is one coherent clock across processes.
+            from ray_tpu.util.tracing import apply_clock_offset
+
+            spans = apply_clock_offset(msg[1], self.clock_offsets.get(wid, 0.0))
             with self.lock:
-                self.trace_spans.extend(msg[1])
+                self.trace_spans.extend(spans)
+        elif kind == "metrics_push":
+            # Periodic per-process telemetry snapshot (telemetry.py):
+            # latest wins per sender; the head's telemetry tick folds the
+            # aggregate into the time-series rings.
+            self.telemetry.ingest(wid, msg[1])
         elif kind == "wire_stats":
             # Per-process wire counters reported by workers/drivers when
             # RAY_TPU_WIRE_STATS=1 (keyed by sender; cluster_metrics sums
@@ -2666,6 +2772,18 @@ class Runtime:
             return self.available_resources()
         if op == "get_logs":
             return self.get_logs(*payload)
+        if op == "telemetry":
+            # Attached-driver surface for `ray_tpu metrics` / `status`.
+            return self.telemetry.summary()
+        if op == "telemetry_series":
+            return self.telemetry.series_snapshot(payload)
+        if op == "timeline":
+            # Merged chrome-trace timeline (`ray_tpu timeline` from an
+            # attached driver): task rows + clock-corrected spans from
+            # every process of the cluster.
+            from ray_tpu.dashboard import timeline as _timeline
+
+            return _timeline()
         raise ValueError(f"unknown op {op}")
 
     def _req_resolve_actor(self, wid: str, req_id: int, actor_id: str,
@@ -3668,6 +3786,10 @@ class Runtime:
         oom = self._oom_kills.pop(wid, None)
         env_fail = self._env_failures.pop(wid, None)
         self.worker_peer_endpoints.pop(wid, None)
+        # Telemetry: a dead process's gauges (queue depths) must not keep
+        # contributing to the cluster aggregate (its own lock; no I/O).
+        self.telemetry.forget(wid)
+        self.clock_offsets.pop(wid, None)
         # Lease-dispatched tasks running ON this worker die with it; their
         # executors can never send the terminal event that would clear the
         # RUNNING entry (the caller's retry, if any, re-reports).

@@ -1,0 +1,432 @@
+"""Cluster telemetry plane: pushed metrics, head-side aggregation, and a
+per-process crash flight recorder.
+
+ray: the reference's observability layer is three pipelines — per-worker
+TaskEventBuffer batches task state transitions into a GCS-side ring buffer
+(gcs_task_manager.h:61), OpenCensus stats export to Prometheus through the
+metrics agent (metrics_agent.py:375), and per-component event files
+(src/ray/util/event.h).  This module is that layer for this build:
+
+  * PUSH — every process snapshots its util/metrics registry plus its
+    wire counters on a period (RAY_TPU_METRICS_PUSH_MS) and ships the
+    snapshot to the head as a DROPPABLE oneway riding the v2 batch
+    frames: telemetry never competes with ownership traffic (seals,
+    refops) for the reconnect backlog, and a dead conn just loses a tick;
+  * SINK — the head keeps the latest snapshot per process and folds them
+    into bounded ring-buffer time series (the GcsTaskManager ring-storage
+    idiom applied to metrics), exposed through util/state.py, the
+    dashboard's Prometheus endpoint, and the `ray_tpu metrics` /
+    `ray_tpu status` CLI verbs;
+  * FLIGHT RECORDER — a bounded in-process ring of recent telemetry
+    events (spans, metric-push deltas, fault injections, cluster events)
+    in EVERY process, dumped to per-pid JSONL files under
+    RAY_TPU_FLIGHT_DIR on an uncaught exception, a lock-watchdog report,
+    or a fault-plane `crash` kill — so a chaos-soak death is diagnosable
+    from what the process saw in its last seconds, without a replay.
+
+The ring always records (a deque append per event, at flush/tick
+granularity — not per task); only the DUMP is gated on the dir knob.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+_ring_lock = threading.Lock()
+_ring: Optional[deque] = None
+_ring_pid = os.getpid()
+_proc_tag = "main"
+_installed = False
+_dump_seq = 0
+
+
+def _get_ring() -> deque:
+    """Ring, lazily sized from config (and re-created after a fork: the
+    parent's entries describe the parent's life, not this process's)."""
+    global _ring, _ring_pid
+    with _ring_lock:
+        if _ring is None or _ring_pid != os.getpid():
+            from ray_tpu._private import config as _config
+
+            _ring = deque(maxlen=max(_config.get("flight_ring_size"), 16))
+            _ring_pid = os.getpid()
+        return _ring
+
+
+def note(kind: str, **fields: Any) -> None:
+    """Record one flight-recorder event.  Never raises — observability
+    must not take the process down."""
+    try:
+        ev = {"t": time.time(), "kind": kind}
+        ev.update(fields)
+        ring = _get_ring()
+        with _ring_lock:
+            ring.append(ev)
+    except Exception:
+        pass
+
+
+def flight_dir() -> str:
+    from ray_tpu._private import config as _config
+
+    return _config.get("flight_dir")
+
+
+def flight_dump(reason: str) -> Optional[str]:
+    """Dump the ring to a per-pid JSONL file under the flight dir (one
+    file per process, appended: a process that trips twice keeps both
+    dumps).  Returns the path, or None when dumping is disabled/fails.
+    Called from crash paths — must never raise and must stay signal-lean
+    (plain open/write, no locks beyond the ring's)."""
+    global _dump_seq
+    d = flight_dir()
+    if not d:
+        return None
+    try:
+        os.makedirs(d, exist_ok=True)
+        with _ring_lock:
+            events = list(_ring or ())
+        _dump_seq += 1
+        path = os.path.join(d, f"flight-{os.getpid()}.jsonl")
+        with open(path, "a") as f:
+            f.write(
+                json.dumps(
+                    {
+                        "kind": "dump",
+                        "reason": reason,
+                        "pid": os.getpid(),
+                        "proc": _proc_tag,
+                        "t": time.time(),
+                        "seq": _dump_seq,
+                        "events": len(events),
+                    }
+                )
+                + "\n"
+            )
+            for ev in events:
+                f.write(json.dumps(ev, default=str) + "\n")
+        return path
+    except Exception:
+        return None
+
+
+def collect_dumps(d: str) -> List[Dict[str, Any]]:
+    """Every dump header written by any process into dir `d` (the soak
+    harness attaches these to failing reports)."""
+    out: List[Dict[str, Any]] = []
+    try:
+        names = sorted(os.listdir(d))
+    except OSError:
+        return out
+    for fn in names:
+        if not (fn.startswith("flight-") and fn.endswith(".jsonl")):
+            continue
+        try:
+            with open(os.path.join(d, fn)) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if rec.get("kind") == "dump":
+                        rec["file"] = fn
+                        out.append(rec)
+        except OSError:
+            pass
+    return out
+
+
+def install(tag: Optional[str] = None) -> None:
+    """Arm the flight recorder's dump triggers in this process:
+
+      * sys.excepthook / threading.excepthook — an uncaught exception
+        dumps before the default handler prints it;
+      * faults.point `crash` — the pre-SIGKILL hook dumps the ring at the
+        exact hazard site the fault plane killed (the chaos soak's
+        worker/daemon/head deaths become diagnosable);
+      * lock_watchdog reports — an order inversion or long hold dumps the
+        ring alongside the watchdog's own report file.
+
+    Idempotent; cheap enough to call at every process entry."""
+    global _installed, _proc_tag
+    if tag:
+        _proc_tag = tag
+    if _installed:
+        return
+    _installed = True
+
+    from ray_tpu._private import faults, lock_watchdog
+
+    faults.set_crash_hook(
+        lambda point_name: flight_dump(f"fault-crash:{point_name}")
+    )
+    lock_watchdog.set_report_hook(
+        lambda report: flight_dump("lock-watchdog")
+    )
+
+    prev_except = sys.excepthook
+
+    def _excepthook(etype, value, tb):
+        note("uncaught", error=f"{etype.__name__}: {value}")
+        flight_dump(f"uncaught:{etype.__name__}")
+        prev_except(etype, value, tb)
+
+    sys.excepthook = _excepthook
+
+    prev_thread = threading.excepthook
+
+    def _thread_excepthook(args):
+        note(
+            "uncaught-thread",
+            error=f"{args.exc_type.__name__}: {args.exc_value}",
+            thread=getattr(args.thread, "name", "?"),
+        )
+        flight_dump(f"uncaught-thread:{args.exc_type.__name__}")
+        prev_thread(args)
+
+    threading.excepthook = _thread_excepthook
+
+
+# ---------------------------------------------------------------------------
+# per-process metric snapshots (the push payload)
+
+_last_push_wire: Dict[str, int] = {}
+
+
+def snapshot_process(extra: Optional[Dict[str, float]] = None) -> Dict:
+    """One process's telemetry snapshot: the full util/metrics registry
+    (histograms carry boundaries for head-side rendering), this process's
+    wire counters, and any caller-supplied internal gauges (head queue
+    depths, journal counters...).  Shipped verbatim as the metrics_push
+    payload — pickle carries the tag-tuple keys fine."""
+    from ray_tpu._private import wire as _wire
+    from ray_tpu.util import metrics as _metrics
+
+    snap = {
+        "pid": os.getpid(),
+        "proc": _proc_tag,
+        "t": time.time(),
+        "metrics": _metrics.collect(),
+        "wire": _wire.stats(),
+    }
+    if extra:
+        snap["internal"] = dict(extra)
+    # Flight-ring the push DELTA (bytes/frames moved since the last one):
+    # a crash dump then shows the process's recent control-plane activity.
+    try:
+        w = snap["wire"]
+        global _last_push_wire
+        note(
+            "metrics_push",
+            frames=w["logical_frames"] - _last_push_wire.get("logical_frames", 0),
+            writes=w["physical_writes"] - _last_push_wire.get("physical_writes", 0),
+            bytes=w["bytes_written"] - _last_push_wire.get("bytes_written", 0),
+            metrics=len(snap["metrics"]),
+        )
+        _last_push_wire = dict(w)
+    except Exception:
+        pass
+    return snap
+
+
+# ---------------------------------------------------------------------------
+# head-side sink: latest snapshot per process + ring-buffer time series
+
+def _flat_key(name: str, tag_key: Tuple) -> str:
+    if not tag_key:
+        return name
+    tags = ",".join(f"{k}={v}" for k, v in tag_key)
+    return f"{name}{{{tags}}}"
+
+
+class TelemetrySink:
+    """Aggregates pushed per-process snapshots on the head.
+
+    `processes` holds the LATEST snapshot per sender (worker id, driver
+    id, daemon:<node>, "head"); `series` holds bounded (t, value) rings
+    per aggregated scalar, appended by sample() at the head's push tick.
+    Counters and histogram buckets SUM across processes; gauges sum too
+    (queue depths add up — the per-process value stays readable in
+    `processes`)."""
+
+    def __init__(self, ring_samples: int = 360):
+        self._lock = threading.Lock()
+        self.processes: Dict[str, Dict] = {}
+        self.series: Dict[str, deque] = {}
+        self._ring_samples = max(ring_samples, 4)
+
+    def ingest(self, key: str, snap: Dict) -> None:
+        if not isinstance(snap, dict):
+            return
+        with self._lock:
+            # Bounded: a pathological sender churn (worker ids are fresh
+            # per spawn) must not grow the map forever.
+            while len(self.processes) >= 4096:
+                self.processes.pop(next(iter(self.processes)))
+            self.processes[key] = snap
+
+    def forget(self, key: str) -> None:
+        with self._lock:
+            self.processes.pop(key, None)
+
+    def aggregate(self) -> Dict[str, Dict]:
+        """Merge the latest snapshots: metric name -> {type, description,
+        boundaries?, data: {tag_key: merged value}} — the same shape one
+        process's collect() has, so renderers handle both."""
+        with self._lock:
+            snaps = list(self.processes.values())
+        out: Dict[str, Dict] = {}
+        for snap in snaps:
+            for name, rec in (snap.get("metrics") or {}).items():
+                cur = out.get(name)
+                if cur is None:
+                    cur = out[name] = {
+                        "type": rec.get("type"),
+                        "description": rec.get("description", ""),
+                        "data": {},
+                    }
+                    if "boundaries" in rec:
+                        cur["boundaries"] = rec["boundaries"]
+                elif cur.get("type") != rec.get("type"):
+                    continue  # name collision across processes: first wins
+                for k, v in (rec.get("data") or {}).items():
+                    prev = cur["data"].get(k)
+                    if prev is None:
+                        cur["data"][k] = (
+                            dict(v) if isinstance(v, dict) else v
+                        )
+                    elif isinstance(v, dict):  # histogram series
+                        if len(prev.get("buckets", ())) == len(v.get("buckets", ())):
+                            prev["buckets"] = [
+                                a + b for a, b in zip(prev["buckets"], v["buckets"])
+                            ]
+                            prev["sum"] = prev.get("sum", 0.0) + v.get("sum", 0.0)
+                            prev["count"] = prev.get("count", 0) + v.get("count", 0)
+                    else:
+                        cur["data"][k] = prev + v
+        return out
+
+    def scalars(self) -> Dict[str, float]:
+        """Flattened aggregate: one number per (metric, tag set).  The
+        series rings and the CLI read this."""
+        out: Dict[str, float] = {}
+        for name, rec in self.aggregate().items():
+            for k, v in rec["data"].items():
+                if isinstance(v, dict):
+                    out[_flat_key(name + "_count", k)] = float(v.get("count", 0))
+                    out[_flat_key(name + "_sum", k)] = float(v.get("sum", 0.0))
+                else:
+                    out[_flat_key(name, k)] = float(v)
+        return out
+
+    def internal_totals(self) -> Dict[str, float]:
+        """Cluster-wide sums of the per-process `internal` gauges (head
+        queue depths, journal counters) and wire counters."""
+        out: Dict[str, float] = {}
+        with self._lock:
+            snaps = list(self.processes.values())
+        for snap in snaps:
+            for k, v in (snap.get("internal") or {}).items():
+                out[k] = out.get(k, 0.0) + float(v)
+            for k, v in (snap.get("wire") or {}).items():
+                out[f"wire_{k}"] = out.get(f"wire_{k}", 0.0) + float(v)
+        return out
+
+    def sample(self, extra: Optional[Dict[str, float]] = None) -> None:
+        """Fold the current aggregate into the time-series rings (one
+        sample per metric per head push tick)."""
+        now = time.time()
+        values = self.scalars()
+        values.update(self.internal_totals())
+        if extra:
+            values.update(extra)
+        with self._lock:
+            for k, v in values.items():
+                ring = self.series.get(k)
+                if ring is None:
+                    ring = self.series[k] = deque(maxlen=self._ring_samples)
+                ring.append((now, v))
+
+    def series_snapshot(
+        self, name: Optional[str] = None
+    ) -> Dict[str, List[Tuple[float, float]]]:
+        with self._lock:
+            if name is not None:
+                return {name: list(self.series.get(name, ()))}
+            return {k: list(v) for k, v in self.series.items()}
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            procs = {
+                key: {
+                    "pid": s.get("pid"),
+                    "proc": s.get("proc"),
+                    "age_s": round(time.time() - s.get("t", 0.0), 3),
+                    "metrics": len(s.get("metrics") or ()),
+                }
+                for key, s in self.processes.items()
+            }
+            n_series = len(self.series)
+        return {
+            "processes": procs,
+            "series_tracked": n_series,
+            "aggregate": self.scalars(),
+            "internal": self.internal_totals(),
+        }
+
+
+def prometheus_cluster_text(
+    sink: TelemetrySink, extra_gauges: Optional[Dict[str, float]] = None
+) -> str:
+    """Prometheus text exposition of the CLUSTER aggregate: every pushed
+    process registry merged (counters/buckets summed), plus runtime-level
+    gauges — the head's /metrics endpoint body (ray: the metrics agent
+    re-exports every worker's OpenCensus views the same way)."""
+    from ray_tpu.util.metrics import (
+        _prom_help,
+        _prom_histogram_lines,
+        _prom_labels,
+        _prom_name,
+    )
+
+    agg = sink.aggregate()
+    lines: List[str] = []
+    for name, rec in sorted(agg.items()):
+        pname = _prom_name(name)
+        mtype = rec.get("type")
+        if mtype == "Counter":
+            lines.append(f"# HELP {pname}_total {_prom_help(rec['description'])}")
+            lines.append(f"# TYPE {pname}_total counter")
+            for k, v in sorted(rec["data"].items()):
+                lines.append(f"{pname}_total{_prom_labels(k)} {v}")
+        elif mtype == "Gauge":
+            lines.append(f"# HELP {pname} {_prom_help(rec['description'])}")
+            lines.append(f"# TYPE {pname} gauge")
+            for k, v in sorted(rec["data"].items()):
+                lines.append(f"{pname}{_prom_labels(k)} {v}")
+        elif mtype == "Histogram" and rec.get("boundaries"):
+            lines.append(f"# HELP {pname} {_prom_help(rec['description'])}")
+            lines.append(f"# TYPE {pname} histogram")
+            for k, d in sorted(rec["data"].items()):
+                if isinstance(d, dict):
+                    lines.extend(
+                        _prom_histogram_lines(pname, k, rec["boundaries"], d)
+                    )
+    for name, value in sorted((extra_gauges or {}).items()):
+        pname = _prom_name(f"ray_tpu_{name}")
+        lines.append(f"# TYPE {pname} gauge")
+        lines.append(f"{pname} {value}")
+    return "\n".join(lines) + "\n"
+
+
+def _reset_for_tests() -> None:
+    global _ring, _last_push_wire
+    with _ring_lock:
+        _ring = None
+    _last_push_wire = {}

@@ -94,8 +94,11 @@ class ProtocolError(ConnectionError):
 # are unconstrained (payload positions).  max_extra None = unbounded.
 SCHEMAS: Dict[str, Tuple[int, Optional[int], tuple]] = {
     # worker/driver -> head.  ready's optional 5th extra field is the
-    # reconnect-time actor announcement (reconciliation handshake).
-    "ready": (3, 5, (str, int)),
+    # reconnect-time actor announcement (reconciliation handshake); the
+    # optional 6th is the sender's time.time() at send — the head's
+    # clock-offset estimate for merging this process's spans/task events
+    # into one cluster timeline.
+    "ready": (3, 6, (str, int)),
     "actor_announce": (1, 1, (list,)),
     "env_failed": (2, 2, (str, str)),
     "done": (3, 3, (str,)),
@@ -113,6 +116,10 @@ SCHEMAS: Dict[str, Tuple[int, Optional[int], tuple]] = {
     "task_events": (1, 1, (list,)),
     "spans": (1, 1, (list,)),
     "wire_stats": (1, 1, (dict,)),
+    # Periodic per-process telemetry snapshot (util/metrics registry +
+    # wire counters + internal gauges) — droppable oneway, aggregated
+    # into the head's TelemetrySink (telemetry.py).
+    "metrics_push": (1, 1, (dict,)),
     # cross-process pubsub (pubsub.py remote delivery)
     "subscribe": (2, 3, (str,)),
     "unsubscribe": (2, 2, (str,)),
@@ -121,7 +128,9 @@ SCHEMAS: Dict[str, Tuple[int, Optional[int], tuple]] = {
     "sync": (0, 1, ()),
     "kv_fetch": (1, 1, (str,)),
     "object_fetch": (1, 1, (str,)),
-    "driver": (2, 2, (str,)),
+    # driver hello's optional 3rd extra = sender clock (same offset
+    # estimate the worker ready carries).
+    "driver": (2, 3, (str,)),
     "driver_store": (2, 2, ()),
     # head -> worker
     "reply": (3, 3, (int,)),

@@ -22,6 +22,7 @@ import os
 import queue
 import sys
 import threading
+import time
 
 from ray_tpu._private import lock_watchdog
 import traceback
@@ -775,7 +776,11 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
 
             threading.Thread(target=_orphan_watch, daemon=True).start()
     global _runtime
-    from ray_tpu._private import wire
+    from ray_tpu._private import telemetry, wire
+
+    # Flight recorder armed before anything can crash: a fault-plane kill
+    # or uncaught exception in this worker dumps its recent-event ring.
+    telemetry.install(f"worker:{worker_id}")
 
     # Watchdog: if the connect/auth handshake wedges (e.g. the driver
     # vanished between spawn and connect), die instead of lingering — the
@@ -849,6 +854,7 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
     events_lock = threading.Lock()
 
     def flush_task_events() -> None:
+        from ray_tpu._private import telemetry as _telemetry
         from ray_tpu.util import tracing as _tracing
 
         spans = _tracing.drain_spans()
@@ -859,6 +865,7 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
                 return
             batch = events_buf[:]
             events_buf.clear()
+        _telemetry.note("task_events_flush", n=len(batch))
         rt.oneway(("task_events", batch), droppable=True)
 
     def record_peer_task_event(spec, err_blob, t0: float, t1: float) -> None:
@@ -890,18 +897,38 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
             flush_task_events()
 
     rt.task_event_sink = _sink_event
+    ready_sent = threading.Event()
 
     def _events_ticker() -> None:
         import time as _time
 
         from ray_tpu._private import config as _cfg2
+        from ray_tpu._private import telemetry as _telemetry
 
         report_wire = bool(_cfg2.get("wire_stats"))
+        push_s = max(_cfg2.get("metrics_push_ms"), 0) / 1000.0
+        last_push = 0.0
         while True:
             _time.sleep(0.5)
+            if not ready_sent.is_set():
+                # NOTHING may precede the ready hello on this conn: the
+                # head's handshake dispatcher closes a conn whose first
+                # message is not a recognized hello — a push racing a
+                # slow runtime-env setup would sever the very conn the
+                # env_failed report needs.
+                continue
             flush_task_events()
             if report_wire:
                 rt.oneway(("wire_stats", wire.stats()), droppable=True)
+            if push_s > 0 and _time.monotonic() - last_push >= push_s:
+                # Metric push (telemetry.py): this process's util/metrics
+                # registry + wire counters, droppable by contract — a head
+                # bounce loses a tick, never wedges the backlog.
+                last_push = _time.monotonic()
+                rt.oneway(
+                    ("metrics_push", _telemetry.snapshot_process()),
+                    droppable=True,
+                )
             # Telemetry rides the next linger/idle flush; nudge it here so
             # a fully-busy executor still reports within a beat.
             wire.flush_dirty()
@@ -965,11 +992,13 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
         # Swap + hello + backlog flush + request-fail + replays run in ONE
         # shared implementation (WorkerRuntime.reconnect_recover — the
         # attached-driver path uses the same one).
+        import time as _time
+
         return rt.reconnect_recover(
             newconn,
             lambda c: c.send(
                 ("ready", worker_id, os.getpid(), node_id, peer_endpoint,
-                 rt.actor_announcement())
+                 rt.actor_announcement(), _time.time())
             ),
         )
 
@@ -1100,8 +1129,14 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
 
     _tr("pre_ready")
     with conn_lock:
-        conn.send(("ready", worker_id, os.getpid(), node_id, peer_endpoint))
+        # The trailing time.time() is the clock-offset sample the head
+        # uses to merge this process's spans into the cluster timeline.
+        conn.send(
+            ("ready", worker_id, os.getpid(), node_id, peer_endpoint,
+             None, time.time())
+        )
     wire.flush_conn(conn)
+    ready_sent.set()  # telemetry oneways may ride this conn from here on
 
     while True:
         try:

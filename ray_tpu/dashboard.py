@@ -73,6 +73,18 @@ def _events_endpoint(query=None):
     )
 
 
+def _telemetry_endpoint(query=None):
+    """Pushed-metrics plane: ?series=<name> returns that aggregate's ring
+    time series; without it, the per-process + aggregate summary."""
+    from ray_tpu.util import state as state_api
+
+    q = query or {}
+    series = (q.get("series") or [None])[0]
+    if series is not None:
+        return state_api.telemetry_series(series)
+    return state_api.telemetry_summary()
+
+
 def _logs_endpoint(worker=None, tail: int = 0, query=None):
     """Per-worker captured output (ray: dashboard log index + `ray logs`).
     Without ?worker=, lists workers that have log lines."""
@@ -105,15 +117,24 @@ class Dashboard:
             "/api/timeline": timeline,
             "/api/logs": _logs_endpoint,
             "/api/events": _events_endpoint,
+            "/api/telemetry": _telemetry_endpoint,
         }
 
         def _prometheus() -> str:
-            # Prometheus text exposition (ray: metrics_agent.py:375 →
-            # prometheus_exporter): user metrics from the registry +
-            # runtime gauges, served as text/plain for direct scraping.
-            from ray_tpu.util.metrics import prometheus_text
+            # Prometheus text exposition of the CLUSTER aggregate (ray:
+            # metrics_agent.py:375 → prometheus_exporter): every pushed
+            # per-process registry merged by the telemetry sink (counters
+            # and histogram buckets summed), plus runtime gauges.  The
+            # head's own registry is folded in fresh, so a local-only
+            # runtime serves exactly what prometheus_text used to.
+            from ray_tpu._private.runtime import get_runtime
+            from ray_tpu._private import telemetry as _telemetry
 
-            return prometheus_text(extra_gauges=state_api.cluster_metrics())
+            rt = get_runtime()
+            rt.telemetry.ingest("head", rt.head_telemetry_snapshot())
+            return _telemetry.prometheus_cluster_text(
+                rt.telemetry, extra_gauges=state_api.cluster_metrics()
+            )
 
         # Non-JSON routes share the same dispatch: (handler, content_type);
         # a None content_type means JSON-serialize the handler's result.
@@ -222,7 +243,7 @@ _INDEX_HTML = """<!doctype html>
 <code>/api/actors</code> <code>/api/objects</code> <code>/api/workers</code>
 <code>/api/placement_groups</code> <code>/api/metrics</code>
 <code>/api/summary</code> <code>/api/timeline</code> <code>/api/logs</code>
-<code>/metrics</code> (Prometheus)</p>
+<code>/api/telemetry</code> <code>/metrics</code> (Prometheus)</p>
 <script>
 function row(cells, tag){const tr=document.createElement('tr');
  for(const c of cells){const td=document.createElement(tag||'td');

@@ -22,33 +22,79 @@ def cmd_microbenchmark(args) -> int:
     return 0
 
 
+def _init_maybe_attached(args):
+    """init() against --address (head.json path / ray:// URL) when given,
+    else the local/current runtime.  Returns the attached WorkerRuntime or
+    None (head-local)."""
+    import ray_tpu
+    from ray_tpu._private.worker_proc import get_worker_runtime
+
+    ray_tpu.init(
+        ignore_reinit_error=True,
+        address=args.address if getattr(args, "address", None) else None,
+    )
+    return get_worker_runtime()
+
+
 def cmd_status(args) -> int:
     import ray_tpu
     from ray_tpu.util import state as state_api
 
-    ray_tpu.init(ignore_reinit_error=True)
-    print(json.dumps(
-        {
+    wr = _init_maybe_attached(args)
+    if wr is not None:
+        tele = wr.request("telemetry", None)
+        out = {
+            "resources": ray_tpu.cluster_resources(),
+            "available": ray_tpu.available_resources(),
+            "telemetry_processes": tele.get("processes", {}),
+            "telemetry": tele.get("internal", {}),
+        }
+    else:
+        tele = state_api.telemetry_summary()
+        out = {
             "nodes": state_api.list_nodes(),
             "resources": ray_tpu.cluster_resources(),
             "available": ray_tpu.available_resources(),
             "metrics": state_api.cluster_metrics(),
-        },
-        indent=1,
-        default=str,
-    ))
+            "telemetry_processes": tele.get("processes", {}),
+        }
+    print(json.dumps(out, indent=1, default=str))
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    """`ray_tpu metrics`: the pushed-metrics plane — cluster aggregate +
+    per-process snapshot ages; --series <name> dumps that aggregate's
+    ring time series (the bounded GCS-side storage)."""
+    from ray_tpu.util import state as state_api
+
+    wr = _init_maybe_attached(args)
+    if args.series:
+        if wr is not None:
+            out = wr.request("telemetry_series", args.series)
+        else:
+            out = state_api.telemetry_series(args.series)
+    elif wr is not None:
+        out = wr.request("telemetry", None)
+    else:
+        out = state_api.telemetry_summary()
+    print(json.dumps(out, indent=1, default=str))
     return 0
 
 
 def cmd_timeline(args) -> int:
-    import ray_tpu
     from ray_tpu.dashboard import timeline
 
-    ray_tpu.init(ignore_reinit_error=True)
+    wr = _init_maybe_attached(args)
     out = args.output or "timeline.json"
+    events = wr.request("timeline", None) if wr is not None else timeline()
     with open(out, "w") as f:
-        json.dump(timeline(), f)
-    print(f"wrote {out} (open in chrome://tracing or Perfetto)")
+        json.dump(events, f)
+    pids = {e.get("pid") for e in events}
+    print(
+        f"wrote {out}: {len(events)} events across {len(pids)} processes "
+        "(open in chrome://tracing or Perfetto)"
+    )
     return 0
 
 
@@ -223,10 +269,21 @@ def main(argv=None) -> int:
     mb.set_defaults(fn=cmd_microbenchmark)
 
     st = sub.add_parser("status", help="cluster nodes/resources/metrics")
+    st.add_argument("--address", help="head.json path or ray:// URL (attached mode)")
     st.set_defaults(fn=cmd_status)
 
-    tl = sub.add_parser("timeline", help="export chrome-trace task timeline")
+    me = sub.add_parser(
+        "metrics", help="pushed-metrics plane: aggregate + per-process ages"
+    )
+    me.add_argument("--series", help="dump one aggregate's ring time series")
+    me.add_argument("--address", help="head.json path or ray:// URL (attached mode)")
+    me.set_defaults(fn=cmd_metrics)
+
+    tl = sub.add_parser(
+        "timeline", help="export the merged chrome-trace cluster timeline"
+    )
     tl.add_argument("--output", "-o")
+    tl.add_argument("--address", help="head.json path or ray:// URL (attached mode)")
     tl.set_defaults(fn=cmd_timeline)
 
     js = sub.add_parser("job", help="submit a job and stream its logs")

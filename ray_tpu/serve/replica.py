@@ -47,6 +47,29 @@ class Replica:
         self._start_time = time.time()
         self._streams: Dict[str, Any] = {}  # stream_id -> live generator
         self._stream_counter = 0
+        # Replica telemetry (ray: serve's autoscaling_metrics push): queue
+        # depth + request latency recorded into this process's registry,
+        # shipped to the head by the worker's generic metric push — the
+        # measurement ROADMAP item 3's autoscaler consumes.
+        from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+        tags = {"deployment": deployment_name, "replica": replica_id}
+        self._m_queue = Gauge(
+            "serve_replica_queue_depth",
+            "in-flight requests on this replica",
+            tag_keys=("deployment", "replica"),
+        ).set_default_tags(tags)
+        self._m_latency = Histogram(
+            "serve_replica_request_latency_s",
+            "request handling latency",
+            boundaries=[0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0],
+            tag_keys=("deployment", "replica"),
+        ).set_default_tags(tags)
+        self._m_requests = Counter(
+            "serve_replica_requests",
+            "requests processed (by outcome)",
+            tag_keys=("deployment", "replica", "outcome"),
+        ).set_default_tags(tags)
         if user_config is not None:
             self.reconfigure(user_config)
 
@@ -54,8 +77,11 @@ class Replica:
     def handle_request(self, method_name: str, args: tuple, kwargs: dict):
         """Execute one request.  Called concurrently from the actor's
         thread pool (one slot per in-flight query)."""
+        t0 = time.perf_counter()
+        outcome = "error"
         with self._lock:
             self._ongoing += 1
+            self._m_queue.set(self._ongoing)
         try:
             if self._is_function:
                 fn = self._callable
@@ -66,11 +92,15 @@ class Replica:
                 import asyncio
 
                 out = asyncio.run(out)
+            outcome = "ok"
             return out
         finally:
             with self._lock:
                 self._ongoing -= 1
                 self._processed += 1
+                self._m_queue.set(self._ongoing)
+            self._m_latency.observe(time.perf_counter() - t0)
+            self._m_requests.inc(tags={"outcome": outcome})
 
     # -- streaming data plane (ray: replica.py handle_request_streaming /
     #    ObjectRefGenerator semantics, pulled replica-side) ----------------

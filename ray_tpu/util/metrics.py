@@ -149,6 +149,32 @@ def _prom_labels(tag_key: Tuple, extra: str = "") -> str:
     return "{" + ",".join(parts) + "}" if parts else ""
 
 
+def _prom_histogram_lines(
+    pname: str, tag_key: Tuple, boundaries: List[float], d: Dict
+) -> List[str]:
+    """Exposition lines for one histogram series.  Shared by the local
+    registry renderer below and the cluster renderer in
+    _private/telemetry.py (pushed per-process snapshots carry their
+    boundaries, so the head can render histograms it never constructed).
+
+    The le label is pre-built OUTSIDE the f-string expression: an escape
+    inside an f-string expression part is a SyntaxError before Python
+    3.12, and this module failing to IMPORT took the whole metric API
+    down with it (the standing tier-1 collection error this fixes)."""
+    lines: List[str] = []
+    cum = 0
+    for bound, n in zip(boundaries, d["buckets"]):
+        cum += n
+        labels = _prom_labels(tag_key, 'le="%s"' % bound)
+        lines.append(f"{pname}_bucket{labels} {cum}")
+    cum += d["buckets"][-1]
+    labels = _prom_labels(tag_key, 'le="+Inf"')
+    lines.append(f"{pname}_bucket{labels} {cum}")
+    lines.append(f"{pname}_sum{_prom_labels(tag_key)} {d['sum']}")
+    lines.append(f"{pname}_count{_prom_labels(tag_key)} {d['count']}")
+    return lines
+
+
 def prometheus_text(extra_gauges: Optional[Dict[str, float]] = None) -> str:
     """Render every registered metric in the Prometheus text exposition
     format (ray: _private/metrics_agent.py:375 re-exports OpenCensus views
@@ -177,18 +203,9 @@ def prometheus_text(extra_gauges: Optional[Dict[str, float]] = None) -> str:
             lines.append(f"# HELP {pname} {_prom_help(m.description)}")
             lines.append(f"# TYPE {pname} histogram")
             for k, d in sorted(m.snapshot().items()):
-                cum = 0
-                for bound, n in zip(m.boundaries, d["buckets"]):
-                    cum += n
-                    lines.append(
-                        f"{pname}_bucket{_prom_labels(k, f'le=\"{bound}\"')} {cum}"
-                    )
-                cum += d["buckets"][-1]
-                lines.append(
-                    f"{pname}_bucket{_prom_labels(k, 'le=\"+Inf\"')} {cum}"
+                lines.extend(
+                    _prom_histogram_lines(pname, k, m.boundaries, d)
                 )
-                lines.append(f"{pname}_sum{_prom_labels(k)} {d['sum']}")
-                lines.append(f"{pname}_count{_prom_labels(k)} {d['count']}")
     for name, value in sorted((extra_gauges or {}).items()):
         pname = _prom_name(f"ray_tpu_{name}")
         lines.append(f"# TYPE {pname} gauge")
@@ -197,14 +214,19 @@ def prometheus_text(extra_gauges: Optional[Dict[str, float]] = None) -> str:
 
 
 def collect() -> Dict[str, Dict]:
-    """Snapshot every registered metric in this process."""
+    """Snapshot every registered metric in this process.  Histograms carry
+    their bucket boundaries so a snapshot shipped to another process (the
+    telemetry push) renders and aggregates without the Metric object."""
     with _REGISTRY_LOCK:
         metrics = dict(_REGISTRY)
-    return {
-        name: {
+    out: Dict[str, Dict] = {}
+    for name, m in metrics.items():
+        rec = {
             "type": type(m).__name__,
             "description": m.description,
             "data": m.snapshot() if hasattr(m, "snapshot") else {},
         }
-        for name, m in metrics.items()
-    }
+        if isinstance(m, Histogram):
+            rec["boundaries"] = list(m.boundaries)
+        out[name] = rec
+    return out

@@ -202,3 +202,23 @@ def list_cluster_events(
     severity + source (ray: `ray list cluster-events` over the event files,
     src/ray/util/event.h:102)."""
     return _rt().events.recent(limit=limit, severity=severity, source=source)
+
+
+def telemetry_summary() -> Dict[str, Any]:
+    """The pushed-metrics plane: per-process snapshot ages, the cluster
+    aggregate (counters/buckets summed across processes), and the summed
+    internal gauges (queue depths, journal counters, wire totals).
+    Workers/daemons/drivers push on RAY_TPU_METRICS_PUSH_MS; the head
+    folds its own registry in on the same tick (telemetry.py)."""
+    rt = _rt()
+    # Fold a fresh head snapshot in first: a CLI/driver read right after a
+    # local metric record must see it without waiting out the tick.
+    rt.telemetry.ingest("head", rt.head_telemetry_snapshot())
+    return rt.telemetry.summary()
+
+
+def telemetry_series(name: Optional[str] = None) -> Dict[str, List]:
+    """Bounded time series of the cluster aggregate, one ring per metric
+    (the GcsTaskManager ring-storage idiom applied to metrics): [(t,
+    value), ...] per name, RAY_TPU_TELEMETRY_RING_SAMPLES samples deep."""
+    return _rt().telemetry.series_snapshot(name)

@@ -115,12 +115,64 @@ def span(name: str, parent: Optional[Dict[str, str]] = None,
             _buffer.append(rec)
             while len(_buffer) > _MAX_BUFFER:
                 _buffer.pop(0)
+        # Completed spans also land in the process's flight-recorder ring
+        # (telemetry.py): a crash dump shows what this process was doing
+        # in its last seconds, span by span.
+        try:
+            from ray_tpu._private import telemetry as _telemetry
+
+            _telemetry.note(
+                "span",
+                name=rec["name"],
+                span_id=rec["span_id"],
+                dur_ms=round((rec["end"] - rec["start"]) * 1000, 3),
+            )
+        except Exception:
+            pass
 
 
 def drain_spans() -> List[Dict[str, Any]]:
     """Take the buffered spans (worker flush loops ship them to the head)."""
     with _buffer_lock:
         out, _buffer[:] = _buffer[:], []
+    return out
+
+
+def apply_clock_offset(
+    spans: List[Dict[str, Any]], offset_s: float
+) -> List[Dict[str, Any]]:
+    """Land one process's span timestamps on the receiver's clock.  The
+    head calls this at span ingest with its handshake-estimated per-conn
+    offset; offset 0 returns the input unchanged (no copy)."""
+    if not offset_s:
+        return spans
+    out = []
+    for s in spans:
+        c = dict(s)
+        if isinstance(c.get("start"), (int, float)):
+            c["start"] = c["start"] + offset_s
+        if isinstance(c.get("end"), (int, float)):
+            c["end"] = c["end"] + offset_s
+        out.append(c)
+    return out
+
+
+def merge_process_spans(
+    streams: List[tuple],
+) -> List[Dict[str, Any]]:
+    """Merge per-process span streams into ONE ordered timeline.
+
+    `streams` is [(clock_offset_s, spans), ...] — each process's spans
+    with the offset that lands its clock on the merger's.  Deterministic:
+    the result is sorted by corrected start time with span_id as the
+    tiebreak, so the same inputs always produce the same order (the
+    clock-skew merge test asserts this).  This is the pure core of the
+    head's merged `ray_tpu timeline`; the head applies offsets at ingest
+    and the timeline export is already merged."""
+    out: List[Dict[str, Any]] = []
+    for offset_s, spans in streams:
+        out.extend(apply_clock_offset(list(spans), offset_s))
+    out.sort(key=lambda s: (s.get("start", 0.0), s.get("span_id") or ""))
     return out
 
 

@@ -371,6 +371,25 @@ class _ServeLoad(_Workload):
                     pass  # control plane mid-bounce: retry the old address
 
 
+def _collect_flight(report: Dict, flight_dir: str) -> int:
+    """Fold the flight-recorder dump headers into the report; returns the
+    dump count."""
+    from ray_tpu._private import telemetry
+
+    dumps = telemetry.collect_dumps(flight_dir)
+    by_reason: Dict[str, int] = {}
+    for d in dumps:
+        key = d.get("reason", "?")
+        by_reason[key] = by_reason.get(key, 0) + 1
+    report["flight_recorder"] = {
+        "dir": flight_dir,
+        "dumps": len(dumps),
+        "by_reason": by_reason,
+        "processes": sorted({d.get("proc", "?") for d in dumps}),
+    }
+    return len(dumps)
+
+
 def _count_log(path: str) -> Dict[str, int]:
     counts: Dict[str, int] = {}
     try:
@@ -413,11 +432,23 @@ def run_soak(
             "RAY_TPU_LOCK_WATCHDOG",
             "RAY_TPU_LOCK_WATCHDOG_DIR",
             "RAY_TPU_LOCK_HOLD_S",
+            "RAY_TPU_TRACE",
+            "RAY_TPU_FLIGHT_DIR",
+            "RAY_TPU_METRICS_PUSH_MS",
         )
     }
     os.environ["RAY_TPU_FAULT_SPEC"] = spec
     os.environ["RAY_TPU_FAULT_SEED"] = str(seed)
     os.environ["RAY_TPU_RECONNECT_WINDOW_S"] = "45"
+    # FULL telemetry plane on across every process of the soak cluster
+    # (ISSUE 6 acceptance: the soak passes with push + spans + flight
+    # recorder enabled, and every fault-plane kill leaves a flight dump
+    # behind — failures become diagnosable without a replay).
+    flight_dir = os.path.join(workdir, "flight")
+    os.makedirs(flight_dir, exist_ok=True)
+    os.environ["RAY_TPU_TRACE"] = "1"
+    os.environ["RAY_TPU_FLIGHT_DIR"] = flight_dir
+    os.environ.setdefault("RAY_TPU_METRICS_PUSH_MS", "1000")
     watchdog_dir = os.path.join(workdir, "watchdog")
     if watch_locks:
         # Lock watchdog on across EVERY process of the soak cluster
@@ -620,14 +651,29 @@ def run_soak(
             wd.extend(f"driver: {r}" for r in lock_watchdog.reports())
             report["lock_watchdog"]["reports"] = wd
             assert not wd, f"lock watchdog reports under chaos: {wd}"
+        # Flight recorder: every fault-plane crash dumped its ring.  The
+        # schedule provably killed processes (asserted above), so dumps
+        # MUST exist — a zero here means the recorder regressed.
+        dumps = _collect_flight(report, flight_dir)
+        assert dumps, (
+            "fault-plane kills fired but produced no flight-recorder dumps"
+        )
         report["result"] = "PASS"
         return report
     except BaseException:
+        # Attach the flight-recorder dumps to the failing report: what
+        # each killed/crashed process saw in its last seconds, without a
+        # replay (the dump files stay under the kept session dir).
+        try:
+            _collect_flight(report, flight_dir)
+        except Exception:
+            pass
         print(
             "\n=== CHAOS SOAK FAILED — replay with:\n"
             f"    python scripts/chaos_soak.py --seed {seed} "
             f"--duration {duration} --spec '{spec}'\n"
-            f"    (session dir kept at {workdir})",
+            f"    (session dir kept at {workdir}; flight-recorder dumps "
+            f"under {flight_dir})",
             file=sys.stderr,
             flush=True,
         )

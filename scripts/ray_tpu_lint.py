@@ -30,6 +30,7 @@ if _REPO_ROOT not in sys.path:
 from ray_tpu._private.analysis import run_analysis  # noqa: E402
 from ray_tpu._private.analysis import allowlist as allowlist_mod  # noqa: E402
 from ray_tpu._private.analysis import fault_registry  # noqa: E402
+from ray_tpu._private.analysis import metric_names  # noqa: E402
 from ray_tpu._private.analysis.common import iter_py_files  # noqa: E402
 
 DEFAULT_ALLOWLIST = os.path.join(
@@ -37,6 +38,9 @@ DEFAULT_ALLOWLIST = os.path.join(
 )
 DEFAULT_CATALOG = os.path.join(
     _REPO_ROOT, "ray_tpu", "_private", "analysis", "fault_points.txt"
+)
+DEFAULT_METRIC_CATALOG = os.path.join(
+    _REPO_ROOT, "ray_tpu", "_private", "analysis", "metric_names.txt"
 )
 
 
@@ -53,9 +57,10 @@ def main(argv=None) -> int:
     )
     ap.add_argument("--allowlist", default=DEFAULT_ALLOWLIST)
     ap.add_argument("--catalog", default=DEFAULT_CATALOG)
+    ap.add_argument("--metric-catalog", default=DEFAULT_METRIC_CATALOG)
     ap.add_argument(
         "--no-catalog-check", action="store_true",
-        help="skip the generated-catalog staleness check (fixture trees)",
+        help="skip the generated-catalog staleness checks (fixture trees)",
     )
     ap.add_argument(
         "--fix-allowlist", action="store_true",
@@ -71,17 +76,24 @@ def main(argv=None) -> int:
         spec_roots=args.spec_roots,
         allowlist_path=args.allowlist,
         catalog_path=None if args.no_catalog_check else args.catalog,
+        metric_catalog_path=None if args.no_catalog_check else args.metric_catalog,
     )
 
     if args.fix_allowlist:
-        points = fault_registry.collect_points(
-            [f for root in args.roots for f in iter_py_files(root)]
-        )
+        files = [f for root in args.roots for f in iter_py_files(root)]
+        points = fault_registry.collect_points(files)
         fault_registry.write_catalog(points, args.catalog)
-        # Catalog staleness violations are cured by the rewrite above, so
+        metrics = metric_names.collect_metrics(files)
+        metric_names.write_catalog(metrics, args.metric_catalog)
+        # Catalog staleness violations are cured by the rewrites above, so
         # they never become allowlist entries.
         keys = sorted(
-            {v.key for v in result.violations if not v.key.startswith("fault-registry:catalog:")}
+            {
+                v.key
+                for v in result.violations
+                if not v.key.startswith("fault-registry:catalog:")
+                and not v.key.startswith("metric-names:catalog:")
+            }
         )
         existing = result.allowlist
         merged, added, dropped = allowlist_mod.regenerate(existing, keys)
@@ -91,13 +103,16 @@ def main(argv=None) -> int:
         for k in added:
             print(f"  NEW (justify me): {k}")
         print(f"catalog: {len(points)} fault points -> {args.catalog}")
+        print(
+            f"catalog: {len(metrics)} metric names -> {args.metric_catalog}"
+        )
         return 0
 
     by_pass = {}
     for v in result.violations:
         by_pass.setdefault(v.pass_name, []).append(v)
     for pass_name in ("blocking-under-lock", "lock-order", "fault-registry",
-                      "hot-send", "gcs-mutation"):
+                      "hot-send", "gcs-mutation", "metric-names"):
         vs = by_pass.get(pass_name, [])
         new = [v for v in vs if v.key not in result.allowlist]
         print(

@@ -23,6 +23,7 @@ from ray_tpu._private.analysis import (  # noqa: E402
     fault_registry,
     hot_send,
     lock_order,
+    metric_names,
 )
 from ray_tpu._private.analysis.common import iter_py_files  # noqa: E402
 
@@ -440,6 +441,7 @@ def test_cli_fails_on_seeded_violation(tmp_path):
         "--spec-roots",
         "--allowlist", allow,
         "--catalog", str(tmp_path / "catalog.txt"),
+        "--metric-catalog", str(tmp_path / "metric_names.txt"),
         "--no-catalog-check",
     ]
     assert ray_tpu_lint.main(args) == 1
@@ -451,6 +453,104 @@ def test_cli_fails_on_seeded_violation(tmp_path):
     entries = {k: "fixture: intentional" for k in entries}
     allowlist_mod.save(allow, entries)
     assert ray_tpu_lint.main(args) == 0
+
+
+# ---------------------------------------------------------------------------
+# pass 6: metric-names (duplicate registrations + undeclared tags)
+
+
+def test_metric_names_collects_constructions(tmp_path):
+    p = _write(
+        tmp_path,
+        "m1.py",
+        """
+        from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+        REQS = Counter("app_requests", "reqs", tag_keys=("route",))
+        DEPTH = Gauge("app_depth")
+        LAT = Histogram("app_latency", "lat", boundaries=[0.1, 1.0])
+        """,
+    )
+    got = metric_names.collect_metrics([(p, "m1.py")])
+    assert sorted(got) == ["app_depth", "app_latency", "app_requests"]
+    assert got["app_requests"][0][1] == "Counter"
+
+
+def test_metric_names_flags_duplicates_and_type_conflicts(tmp_path):
+    p1 = _write(
+        tmp_path, "d1.py",
+        'from ray_tpu.util.metrics import Counter\nC = Counter("dup_m", "x")\n',
+    )
+    p2 = _write(
+        tmp_path, "d2.py",
+        'from ray_tpu.util.metrics import Gauge\nG = Gauge("dup_m", "x")\n',
+    )
+    got = metric_names.collect_metrics([(p1, "d1.py"), (p2, "d2.py")])
+    found = metric_names.check_duplicates(got)
+    assert len(found) == 1
+    assert found[0].key == "metric-names:dup:dup_m"
+    assert "CONFLICTING" in found[0].message
+
+
+def test_metric_names_flags_undeclared_tags(tmp_path):
+    p = _write(
+        tmp_path,
+        "m2.py",
+        """
+        from ray_tpu.util.metrics import Counter, Gauge
+
+        class S:
+            def __init__(self):
+                self.c = Counter("svc_reqs", "r", tag_keys=("route",))
+                self.g = Gauge("svc_depth", "d", tag_keys=("shard",)
+                               ).set_default_tags({"shard": "0"})
+
+            def good(self):
+                self.c.inc(tags={"route": "/a"})
+                self.g.set(1, tags={"shard": "1"})
+
+            def bad(self):
+                self.c.inc(tags={"rout": "/a"})  # seeded typo
+                self.g.set(1, tags={"replica": "x"})  # seeded undeclared
+
+        BAD_DEFAULT = Gauge("svc_other", "o", tag_keys=("a",)
+                            ).set_default_tags({"b": "1"})  # seeded
+        """,
+    )
+    found = metric_names.scan_file(p, "m2.py")
+    msgs = " | ".join(v.message for v in found)
+    assert len(found) == 3, [v.key for v in found]
+    assert "'rout'" in msgs and "'replica'" in msgs and "'b'" in msgs
+
+
+def test_metric_names_catalog_staleness_and_regen(tmp_path):
+    p = _write(
+        tmp_path, "m3.py",
+        'from ray_tpu.util.metrics import Counter\nC = Counter("cat_m", "x")\n',
+    )
+    got = metric_names.collect_metrics([(p, "m3.py")])
+    catalog = str(tmp_path / "metric_names.txt")
+    assert metric_names.check_catalog(got, catalog)  # missing -> stale
+    metric_names.write_catalog(got, catalog)
+    assert metric_names.check_catalog(got, catalog) == []
+    got["cat_new"] = [("m3.py:99", "Gauge")]
+    stale = metric_names.check_catalog(got, catalog)
+    assert stale and "cat_new" in stale[0].message
+
+
+def test_committed_metric_catalog_matches_tree():
+    files = iter_py_files(os.path.join(REPO, "ray_tpu"))
+    got = metric_names.collect_metrics(files)
+    committed = metric_names.load_catalog(
+        os.path.join(REPO, "ray_tpu", "_private", "analysis", "metric_names.txt")
+    )
+    actual = {
+        f"{name} {'/'.join(sorted({t for _s, t in sites}))}"
+        for name, sites in got.items()
+    }
+    assert actual == set(committed)
+    # The serve replica telemetry metrics are registered.
+    assert any(n.startswith("serve_replica_queue_depth") for n in committed)
 
 
 # ---------------------------------------------------------------------------

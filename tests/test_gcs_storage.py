@@ -263,6 +263,33 @@ def test_journal_compacted_into_next_snapshot(tmp_path):
         rt.shutdown()
 
 
+def test_function_exports_survive_head_death_via_journal_only(tmp_path):
+    """PR-4 residual closed: a function exported AFTER the last snapshot
+    tick survives a hard head death via the journal, so a lineage
+    re-execution right after restart can resolve the fn blob instead of
+    failing "unknown function"."""
+    from ray_tpu._private.runtime import Runtime
+
+    snap_path = str(tmp_path / "head-snap")
+    rt = Runtime(num_cpus=1, session_name="jfnexp", snapshot_path=snap_path)
+    # Freeze the snapshot document: only the journal may carry the export.
+    rt._write_snapshot = lambda: None
+    rt.state.export_function("fn-under-test", b"the-blob")
+    # Same-blob re-export must not re-journal (size bound on hot paths).
+    size_after_first = rt._journal.size_bytes()
+    rt.state.export_function("fn-under-test", b"the-blob")
+    assert rt._journal.size_bytes() == size_after_first
+    # Hard death: no shutdown, no final snapshot.
+    rt._shutdown = True
+    rt.listener.close()
+
+    rt2 = Runtime(num_cpus=1, session_name="jfnexp", snapshot_path=snap_path)
+    try:
+        assert rt2.state.get_function("fn-under-test") == b"the-blob"
+    finally:
+        rt2.shutdown()
+
+
 def test_runtime_restores_anonymous_actor_from_journal_only(tmp_path):
     """An ANONYMOUS actor registered+ALIVE'd after the last snapshot tick
     survives a hard head death purely via the journal (the PR-1 gap:

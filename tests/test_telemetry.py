@@ -1,0 +1,440 @@
+"""Cluster telemetry plane tests (ISSUE 6): pushed metrics, clock-offset
+timeline merge, and the crash flight recorder.
+
+Reference intents: ray's test_metrics_agent.py (push + aggregation),
+test_task_events.py (ring-buffer storage), and the crash-artifact idea the
+reference spreads across event files + `ray timeline`.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import config as _config
+from ray_tpu._private import telemetry as _telemetry
+from ray_tpu.util import state as state_api
+
+
+@pytest.fixture
+def telemetry_env(monkeypatch):
+    """Fast push period so tests see pushes within a beat; children
+    inherit via env.  Config cache reset so the knob lands."""
+    monkeypatch.setenv("RAY_TPU_METRICS_PUSH_MS", "150")
+    _config._reset_for_tests()
+    yield
+    _config._reset_for_tests()
+
+
+def _shutdown():
+    from ray_tpu._private import faults
+
+    try:
+        ray_tpu.shutdown()
+    finally:
+        faults.disable()
+        _config._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# clock-offset merge (pure: determinism under skewed process clocks)
+
+
+def _fake_span(name, span_id, start, end, pid, parent=None):
+    return {
+        "name": name,
+        "trace_id": "t" * 32,
+        "span_id": span_id,
+        "parent_span_id": parent,
+        "start": start,
+        "end": end,
+        "pid": pid,
+        "attrs": {},
+    }
+
+
+def test_clock_offset_merge_orders_skewed_processes_deterministically():
+    """Two fake processes with skewed clocks: process B's clock runs 10s
+    BEHIND, so its raw timestamps would sort its child span before the
+    parent that submitted it.  The offset-corrected merge restores true
+    order, and merging twice (and in either stream order) produces the
+    identical result."""
+    from ray_tpu.util.tracing import merge_process_spans
+
+    # True order: submit (A, t=100.0..100.1) -> run (B, true t=100.05..100.4)
+    # but B's clock reads 10s behind (90.05..90.4).
+    a = [_fake_span("submit::f", "a1", 100.0, 100.1, pid=1)]
+    b = [_fake_span("run::f", "b1", 90.05, 90.4, pid=2, parent="a1")]
+    raw = merge_process_spans([(0.0, a), (0.0, b)])
+    assert [s["span_id"] for s in raw] == ["b1", "a1"], "skew inverts raw order"
+
+    merged = merge_process_spans([(0.0, a), (10.0, b)])
+    assert [s["span_id"] for s in merged] == ["a1", "b1"]
+    assert merged[1]["start"] == pytest.approx(100.05)
+    assert merged[1]["parent_span_id"] == "a1"
+
+    # Determinism: same inputs, any stream order, same output.
+    again = merge_process_spans([(10.0, b), (0.0, a)])
+    assert merged == again
+    # Tiebreak on identical starts is span_id, not input order.
+    c = [_fake_span("x", "c0", 100.05, 100.2, pid=3)]
+    m1 = merge_process_spans([(0.0, a), (10.0, b), (0.0, c)])
+    m2 = merge_process_spans([(0.0, c), (10.0, b), (0.0, a)])
+    assert [s["span_id"] for s in m1] == [s["span_id"] for s in m2]
+
+
+def test_apply_clock_offset_zero_is_identity():
+    from ray_tpu.util.tracing import apply_clock_offset
+
+    spans = [_fake_span("s", "i1", 1.0, 2.0, pid=1)]
+    assert apply_clock_offset(spans, 0.0) is spans
+    shifted = apply_clock_offset(spans, 2.5)
+    assert shifted[0]["start"] == 3.5 and spans[0]["start"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# pushed metrics: worker registries aggregate on the head
+
+
+@ray_tpu.remote
+def _record_metrics(n):
+    from ray_tpu.util.metrics import Counter, Histogram
+
+    c = Counter("telemetry_test_ops", "ops", tag_keys=("kind",))
+    for _ in range(n):
+        c.inc(tags={"kind": "unit"})
+    h = Histogram("telemetry_test_lat", "lat", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    return os.getpid()
+
+
+def test_worker_metrics_push_aggregates_on_head(telemetry_env):
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    try:
+        wpid = ray_tpu.get(_record_metrics.remote(3), timeout=60)
+        assert wpid != os.getpid()
+        deadline = time.time() + 15
+        agg = {}
+        while time.time() < deadline:
+            agg = state_api.telemetry_summary()["aggregate"]
+            if agg.get("telemetry_test_ops{kind=unit}", 0) >= 3:
+                break
+            time.sleep(0.2)
+        assert agg.get("telemetry_test_ops{kind=unit}", 0) >= 3, sorted(agg)
+        assert agg.get("telemetry_test_lat_count", 0) >= 2
+
+        # The head's internal gauges ride the same sink.
+        summary = state_api.telemetry_summary()
+        assert "head_live_workers" in summary["internal"]
+        assert summary["internal"]["wire_logical_frames"] > 0
+        # Per-process rows name their senders (head + >=1 worker).
+        procs = {v["proc"] for v in summary["processes"].values()}
+        assert any(p.startswith("worker:") for p in procs)
+
+        # Time-series rings fill at the push tick (bounded deques).
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            series = state_api.telemetry_series("head_live_workers")
+            if series.get("head_live_workers"):
+                break
+            time.sleep(0.2)
+        pts = series["head_live_workers"]
+        assert pts and all(len(p) == 2 for p in pts)
+
+        # Clock offsets were estimated at handshake for every worker conn.
+        from ray_tpu._private.runtime import get_runtime
+
+        offs = get_runtime().clock_offsets
+        assert offs and all(abs(v) < 5.0 for v in offs.values())
+    finally:
+        _shutdown()
+
+
+def test_prometheus_endpoint_serves_pushed_worker_metrics(telemetry_env):
+    """The dashboard /metrics body includes metrics recorded in WORKER
+    processes — the cluster aggregate, not just the head registry."""
+    import urllib.request
+
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    try:
+        ray_tpu.get(_record_metrics.remote(5), timeout=60)
+        deadline = time.time() + 15
+        body = ""
+        dash = start_dashboard()
+        try:
+            while time.time() < deadline:
+                body = (
+                    urllib.request.urlopen(f"{dash.url}/metrics", timeout=10)
+                    .read()
+                    .decode()
+                )
+                if 'telemetry_test_ops_total{kind="unit"}' in body:
+                    break
+                time.sleep(0.2)
+        finally:
+            stop_dashboard()
+        assert 'telemetry_test_ops_total{kind="unit"}' in body
+        assert 'telemetry_test_lat_bucket{le="+Inf"}' in body
+        assert "ray_tpu_tasks_finished" in body  # runtime gauges still ride
+
+        # /api/telemetry serves the summary + ?series= rings.
+        dash = start_dashboard()
+        try:
+            out = json.loads(
+                urllib.request.urlopen(
+                    f"{dash.url}/api/telemetry", timeout=10
+                ).read()
+            )
+            assert "aggregate" in out and "processes" in out
+        finally:
+            stop_dashboard()
+    finally:
+        _shutdown()
+
+
+# ---------------------------------------------------------------------------
+# droppable push under faults: a worker crash mid-flush never wedges
+
+
+def test_metrics_push_survives_worker_crash_mid_flush(telemetry_env, monkeypatch):
+    """Kill a worker exactly at its metrics_push send: the push is a
+    droppable oneway, so nothing retries it, the crashed worker's task
+    re-drives on a fresh worker, and shutdown stays clean (no backlog
+    wedge).  The drop clause starves the head of that worker's pushes
+    without failing anything."""
+    monkeypatch.setenv(
+        "RAY_TPU_FAULT_SPEC",
+        "wire.send:crash@proc=worker,match=^metrics_push,nth=2;"
+        "wire.send:drop@proc=worker,match=^metrics_push,after=2",
+    )
+    _config._reset_for_tests()
+
+    @ray_tpu.remote(max_retries=5)
+    def slow(i):
+        time.sleep(0.4)  # spans several push ticks: the crash fires mid-run
+        return i * 7
+
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    try:
+        out = ray_tpu.get([slow.remote(i) for i in range(6)], timeout=120)
+        assert out == [i * 7 for i in range(6)]
+        # Aggregation still works off the surviving processes.
+        assert "aggregate" in state_api.telemetry_summary()
+    finally:
+        monkeypatch.delenv("RAY_TPU_FAULT_SPEC", raising=False)
+        _shutdown()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+
+
+def test_flight_recorder_dumps_on_injected_crash(telemetry_env, monkeypatch, tmp_path):
+    """A fault-plane `crash` kill dumps the victim's flight ring to a
+    per-pid JSONL file: the dump header names the killed point and the
+    ring carries the process's recent telemetry events."""
+    flight = tmp_path / "flight"
+    monkeypatch.setenv("RAY_TPU_FLIGHT_DIR", str(flight))
+    monkeypatch.setenv(
+        "RAY_TPU_FAULT_SPEC",
+        "wire.send:crash@proc=worker,match=^done,nth=3",
+    )
+    _config._reset_for_tests()
+
+    @ray_tpu.remote(max_retries=10)
+    def work(i):
+        return i + 1
+
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    try:
+        assert ray_tpu.get([work.remote(i) for i in range(12)], timeout=120) == [
+            i + 1 for i in range(12)
+        ]
+        deadline = time.time() + 20
+        dumps = []
+        while time.time() < deadline:
+            dumps = _telemetry.collect_dumps(str(flight))
+            if dumps:
+                break
+            time.sleep(0.2)
+        assert dumps, "no flight-recorder dump after a fault-plane crash"
+        d = dumps[0]
+        assert d["reason"].startswith("fault-crash:wire.send")
+        assert d["proc"].startswith("worker:")
+        # The dump body parses as JSONL and carries ring events.
+        lines = [
+            json.loads(l)
+            for l in open(flight / d["file"])
+            if l.strip()
+        ]
+        assert lines[0]["kind"] == "dump"
+    finally:
+        monkeypatch.delenv("RAY_TPU_FAULT_SPEC", raising=False)
+        monkeypatch.delenv("RAY_TPU_FLIGHT_DIR", raising=False)
+        _shutdown()
+
+
+def test_flight_ring_records_and_bounded(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_FLIGHT_RING_SIZE", "32")
+    _config._reset_for_tests()
+    _telemetry._reset_for_tests()
+    try:
+        for i in range(100):
+            _telemetry.note("unit", i=i)
+        ring = _telemetry._get_ring()
+        assert len(ring) == 32
+        assert ring[-1]["i"] == 99  # newest kept, oldest evicted
+    finally:
+        _config._reset_for_tests()
+        _telemetry._reset_for_tests()
+
+
+def test_flight_dump_disabled_without_dir(monkeypatch):
+    monkeypatch.delenv("RAY_TPU_FLIGHT_DIR", raising=False)
+    _config._reset_for_tests()
+    _telemetry.note("unit")
+    assert _telemetry.flight_dump("test") is None
+    _config._reset_for_tests()
+
+
+def test_lock_watchdog_report_triggers_flight_dump(monkeypatch, tmp_path):
+    from ray_tpu._private import lock_watchdog
+
+    flight = tmp_path / "flight"
+    monkeypatch.setenv("RAY_TPU_FLIGHT_DIR", str(flight))
+    _config._reset_for_tests()
+    _telemetry._reset_for_tests()
+    prev = lock_watchdog._report_hook
+    lock_watchdog.set_report_hook(lambda r: _telemetry.flight_dump("lock-watchdog"))
+    try:
+        lock_watchdog._emit("synthetic report (test)")
+        dumps = _telemetry.collect_dumps(str(flight))
+        assert dumps and dumps[0]["reason"] == "lock-watchdog"
+    finally:
+        lock_watchdog.set_report_hook(prev)
+        _config._reset_for_tests()
+        _telemetry._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# merged timeline: one chrome trace spanning >=3 processes
+
+
+def test_timeline_spans_three_processes_with_cross_process_parents(
+    telemetry_env, monkeypatch
+):
+    monkeypatch.setenv("RAY_TPU_TRACE", "1")
+    from ray_tpu.util import tracing
+
+    tracing.enable_tracing()
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    try:
+        @ray_tpu.remote
+        def inner(x):
+            return x + 1
+
+        @ray_tpu.remote
+        def outer():
+            return ray_tpu.get([inner.remote(i) for i in range(3)], timeout=30)
+
+        assert ray_tpu.get(outer.remote(), timeout=60) == [1, 2, 3]
+
+        from ray_tpu.dashboard import timeline
+
+        deadline = time.time() + 20
+        span_events = []
+        while time.time() < deadline:
+            events = timeline()
+            span_events = [
+                e for e in events if e.get("args", {}).get("span_id")
+            ]
+            pids = {e["pid"] for e in span_events}
+            if len(pids) >= 3 and any(
+                e["name"].startswith("run::inner") for e in span_events
+            ):
+                break
+            time.sleep(0.3)
+        pids = {e["pid"] for e in span_events}
+        assert len(pids) >= 3, f"timeline covers only pids {pids}"
+
+        # Cross-process parenting: a run:: span's parent_span_id is a
+        # submit:: span recorded in a DIFFERENT process.
+        by_id = {e["args"]["span_id"]: e for e in span_events}
+        linked = 0
+        for e in span_events:
+            parent = e["args"].get("parent_span_id")
+            if e["name"].startswith("run::") and parent in by_id:
+                if by_id[parent]["pid"] != e["pid"]:
+                    linked += 1
+        assert linked >= 2, "no cross-process parented spans in the trace"
+    finally:
+        from ray_tpu.util import tracing as _tracing
+
+        _tracing.disable_tracing()
+        _shutdown()
+
+
+# ---------------------------------------------------------------------------
+# split cluster: the CLI surface against a standalone head (slow)
+
+
+@pytest.mark.slow
+def test_split_cluster_timeline_and_metrics_via_driver(tmp_path, monkeypatch):
+    """Attached-driver legs of the plane: `ray_tpu timeline`'s request op
+    returns a merged trace spanning >=3 processes of a SPLIT cluster, and
+    the telemetry summary covers head + workers + this driver."""
+    from ray_tpu._private.head import launch_head_subprocess
+    from ray_tpu._private.worker_proc import get_worker_runtime
+
+    monkeypatch.setenv("RAY_TPU_TRACE", "1")
+    monkeypatch.setenv("RAY_TPU_METRICS_PUSH_MS", "150")
+    _config._reset_for_tests()
+    from ray_tpu.util import tracing
+
+    tracing.enable_tracing()
+    proc, head_json = launch_head_subprocess(
+        str(tmp_path), num_cpus=4, session="ttele"
+    )
+    try:
+        ray_tpu.init(address=head_json)
+
+        @ray_tpu.remote
+        def inner(x):
+            return x * 2
+
+        @ray_tpu.remote
+        def outer():
+            return ray_tpu.get([inner.remote(i) for i in range(3)], timeout=30)
+
+        assert ray_tpu.get(outer.remote(), timeout=60) == [0, 2, 4]
+        wr = get_worker_runtime()
+        assert wr is not None
+
+        deadline = time.time() + 25
+        pids = set()
+        while time.time() < deadline:
+            events = wr.request("timeline", None)
+            spans = [e for e in events if e.get("args", {}).get("span_id")]
+            pids = {e["pid"] for e in spans}
+            if len(pids) >= 3:
+                break
+            time.sleep(0.4)
+        assert len(pids) >= 3, f"split-cluster trace covers only {pids}"
+
+        tele = wr.request("telemetry", None)
+        procs = {v["proc"] for v in tele["processes"].values()}
+        assert any(p.startswith("worker:") for p in procs)
+        assert any(p.startswith("driver:") for p in procs)
+    finally:
+        tracing.disable_tracing()
+        _shutdown()
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except Exception:
+            proc.kill()
