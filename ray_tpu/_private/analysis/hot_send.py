@@ -42,6 +42,10 @@ HOT_MODULES = frozenset(
         "ray_tpu/_private/node_daemon.py",
         "ray_tpu/_private/peer.py",
         "ray_tpu/_private/driver_client.py",
+        # io-shard fabric: every owned conn and the head-ward ctl channel
+        # are coalesced streams; an unbatched send here regresses the
+        # whole slice of conns the shard owns.
+        "ray_tpu/_private/io_shard.py",
     }
 )
 

@@ -265,6 +265,27 @@ _DEFS: Dict[str, tuple] = {
         "crash, lock-watchdog report, or fault-plane kill; empty disables "
         "dumping (the ring still records)",
     ),
+    "head_io_shards": (
+        0, int,
+        "number of io-shard processes the head fans its connection fabric "
+        "across: each shard owns a slice of the worker/daemon/driver conns "
+        "(handed off by conn-hash after the auth handshake), runs its own "
+        "epoll loop + protocol-v2 decode/encode, and forwards only decoded "
+        "control messages to the head over one batched channel; 0 = the "
+        "classic in-process io loop (single-core behavior unchanged) "
+        "(ray: the gRPC server thread pools in gcs_server)",
+    ),
+    "io_shard_restart_s": (
+        0.5, float,
+        "backoff before the head respawns a dead io shard; its conns fail "
+        "over immediately (peers reconnect and hash onto live shards)",
+    ),
+    "io_shard_pending_send_s": (
+        30.0, float,
+        "how long an io shard buffers head->conn sends for a conn whose "
+        "fd handoff has not arrived yet (the two ride different channels "
+        "and may reorder) before dropping them as dead-conn traffic",
+    ),
     "zygote_fork_grace_s": (
         20.0, float,
         "how long a zygote-forked worker handle with no pid attribution "

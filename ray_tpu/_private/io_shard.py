@@ -1,0 +1,483 @@
+"""Head io-shard fabric: multi-process accept/decode shards feeding the
+single-writer GCS.
+
+ray: src/ray/gcs/gcs_server/gcs_server.cc runs its gRPC services on a
+thread pool — connection fan-in, HTTP/2 framing, and protobuf decode
+happen on io threads while table mutations serialize onto the main
+io_context.  PROFILE_r5.md measured the same boundary as this build's
+scaling wall: the head's single Python io loop is only ~2% compute on one
+core, so throughput scales exactly until the GIL saturates.  This module
+moves the per-connection work OFF the head process:
+
+  * the head keeps ONE listener + auth/handshake path (unchanged wire
+    protocol — peers notice nothing); after the handshake it hands the
+    live socket fd to an io-shard process chosen by conn-hash
+    (SCM_RIGHTS over an AF_UNIX channel, netutil.send_conn_fd);
+  * each shard runs its own epoll loop over its slice of the
+    worker/daemon/driver conns and performs the expensive per-conn work
+    there — protocol-v2 batch frame decode/encode, pickle, wire-stat
+    counting — then forwards only DECODED control messages to the head
+    as `("shard_fwd", conn_id, [msgs])` over one batched channel per
+    shard, riding the same BatchingConn flush discipline as every other
+    hot stream;
+  * ALL state mutation stays in the head process: a shard never touches
+    `state.*` (the gcs-mutation lint enforces forwarding-only — the
+    journaled single-writer seam PR 4 centralized is exactly what makes
+    this sharding safe); head replies/pubsub fan-out route back through
+    the owning shard as `("shard_send", conn_id, msg)`.
+
+Ordering invariant: a conn's frames are decoded by exactly one shard in
+arrival order, appended to `shard_fwd` lists in that order, and the ctl
+channel is one FIFO stream — so a conn's messages can never interleave
+out of order across the shard boundary (tier-1 asserted in
+tests/test_io_shard.py).
+
+Failure model: a shard death closes its conns' fds, so every peer sees a
+plain conn EOF and reconnects through the normal window — the fresh
+handshake hashes onto a surviving (or head-respawned) shard.  The head
+treats the shard's ctl EOF as an EOF of every conn it owned, which is
+exactly what the sockets did.  `shard.accept` / `shard.forward` fault
+points make both windows chaos-testable.
+
+RAY_TPU_HEAD_IO_SHARDS=0 (default) keeps the classic in-process io loop:
+single-core behavior is byte-for-byte unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import faults
+from ray_tpu._private import lock_watchdog
+
+
+def _kind(obj: Any) -> Optional[str]:
+    if isinstance(obj, tuple) and obj and isinstance(obj[0], str):
+        return obj[0]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# head-side: the stand-in the head's conn maps hold for a sharded conn
+
+
+class ShardConnProxy:
+    """What the head's conn maps (`_conn_to_worker`/`_conn_to_daemon`/
+    `drivers`...) hold for a connection an io shard owns.  send() routes
+    the frame out through the owning shard's batched ctl channel; the
+    head's io loop never selects on a proxy (no fileno by design — a
+    registration attempt fails loudly instead of busy-polling a pipe the
+    shard owns).  A dead shard makes every proxy raise OSError at send,
+    the same contract a broken BatchingConn has."""
+
+    __slots__ = ("shard", "conn_id", "kind", "peer_id", "_closed")
+
+    def __init__(self, shard: "IoShardHandle", conn_id: str, kind: str, peer_id: str):
+        self.shard = shard
+        self.conn_id = conn_id
+        self.kind = kind
+        self.peer_id = peer_id
+        self._closed = False
+
+    def send(self, obj: Any) -> None:
+        if self._closed or not self.shard.alive:
+            raise OSError(f"io shard {self.shard.idx} no longer owns conn "
+                          f"{self.conn_id}")
+        self.shard.ctl_conn.send(("shard_send", self.conn_id, obj))
+
+    def flush(self) -> None:
+        """Push queued shard_send frames now (the ctl channel is a
+        BatchingConn, so it also rides every wire.flush_dirty sweep)."""
+        from ray_tpu._private import wire
+
+        if self.shard.alive and self.shard.ctl_conn is not None:
+            wire.flush_conn(self.shard.ctl_conn)
+
+    def close(self) -> None:
+        """Tell the owning shard to drop the real socket (best-effort:
+        a dead shard already dropped it)."""
+        if self._closed:
+            return
+        self._closed = True
+        self.shard.conns.pop(self.conn_id, None)
+        try:
+            if self.shard.alive:
+                self.shard.ctl_conn.send(("shard_close", self.conn_id))
+        except OSError:
+            pass
+
+    # Defensive surface for code paths that probe conns generically: a
+    # proxy never has locally-readable data (the shard reads the socket).
+    def poll(self, timeout: float = 0.0) -> bool:
+        return False
+
+    def pending_frames(self) -> int:
+        return 0
+
+    @property
+    def closed(self) -> bool:
+        return self._closed or not self.shard.alive
+
+    def __repr__(self) -> str:
+        return (f"ShardConnProxy(shard={self.shard.idx}, "
+                f"conn={self.conn_id}, kind={self.kind})")
+
+
+class IoShardHandle:
+    """Head-side record of one io-shard process: its Popen, the two
+    channels (batched ctl for messages, raw fd channel for SCM_RIGHTS
+    handoffs), and the proxies for every conn it currently owns."""
+
+    def __init__(self, idx: int, proc):
+        self.idx = idx
+        self.proc = proc
+        self.pid: Optional[int] = None
+        self.ctl_conn = None   # wire.BatchingConn once the hello lands
+        self.fd_conn = None    # raw AF_UNIX Connection (handoff channel)
+        self.alive = False
+        self.respawn_at = 0.0
+        # conn_id -> ShardConnProxy for EOF fan-out on shard death.
+        self.conns: Dict[str, ShardConnProxy] = {}
+        # Serializes (meta, fd) pairs on the handoff channel: interleaved
+        # writers would split a meta from its SCM_RIGHTS payload.
+        self.fd_lock = lock_watchdog.make_lock("IoShardHandle.fd_lock")
+
+    def adopt(self, conn_id: str, kind: str, peer_id: str, fd: int) -> None:
+        """Ship one conn's fd to the shard (meta first, then the fd — the
+        shard reads them as a pair).  Raises OSError if the shard died;
+        the caller falls back through the shard-death path."""
+        from ray_tpu._private import netutil
+
+        with self.fd_lock:
+            self.fd_conn.send(("handoff", conn_id, kind, peer_id))
+            netutil.send_conn_fd(self.fd_conn, fd, self.pid)
+
+    def __repr__(self) -> str:
+        return (f"IoShardHandle(idx={self.idx}, pid={self.pid}, "
+                f"alive={self.alive}, conns={len(self.conns)})")
+
+
+def spawn_shard_process(idx: int, ctl_addr: str, authkey: bytes,
+                        session: str) -> "IoShardHandle":
+    """Launch one io-shard subprocess pointed at the head's AF_UNIX shard
+    listener.  The handle starts not-alive; the head's shard accept loop
+    flips it when the hello pair lands."""
+    import subprocess
+
+    env = os.environ.copy()
+    env["RAY_TPU_IO_SHARD_CONFIG"] = json.dumps(
+        {"index": idx, "ctl_addr": ctl_addr, "authkey": authkey.hex(),
+         "session": session}
+    )
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    paths = [pkg_root] + [p for p in sys.path if p]
+    env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(paths))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.io_shard"],
+        env=env,
+        close_fds=True,
+    )
+    return IoShardHandle(idx, proc)
+
+
+# ---------------------------------------------------------------------------
+# shard-side: the process entry + io loop
+
+_DRAIN_CAP = 256  # physical reads per conn per round (decoded tails drain too)
+
+
+class _ShardServer:
+    """One io shard's event loop: epoll over the ctl/fd channels and every
+    owned conn; decode inbound frames and forward them head-ward; apply
+    head-routed sends; never touch any state table (forwarding only —
+    lint-enforced)."""
+
+    def __init__(self, idx: int, ctl_conn, fd_conn):
+        import selectors
+
+        from ray_tpu.util import metrics as _metrics
+
+        self.idx = idx
+        self.ctl_conn = ctl_conn    # BatchingConn to the head
+        self.fd_conn = fd_conn      # raw handoff channel
+        self._read_event = selectors.EVENT_READ
+        self.sel = selectors.DefaultSelector()
+        self.sel.register(ctl_conn, selectors.EVENT_READ)
+        self.sel.register(fd_conn, selectors.EVENT_READ)
+        self.owned: Dict[str, Any] = {}      # conn_id -> BatchingConn
+        self.conn_ids: Dict[Any, str] = {}   # BatchingConn -> conn_id
+        # Sends that raced ahead of their conn's fd handoff (ctl and fd
+        # ride different channels, so cross-channel order is unguaranteed):
+        # buffered until the handoff lands, dropped after a deadline.
+        self.pending_sends: Dict[str, tuple] = {}  # conn_id -> (deadline, [msgs])
+        self._last_push = time.monotonic()
+        tag = {"shard": str(idx)}
+        self.g_conns = _metrics.Gauge(
+            "io_shard_conns",
+            "connections this io shard currently owns",
+            tag_keys=("shard",),
+        ).set_default_tags(tag)
+        self.c_forwarded = _metrics.Counter(
+            "io_shard_forwarded_frames",
+            "decoded control frames forwarded head-ward by this io shard",
+            tag_keys=("shard",),
+        ).set_default_tags(tag)
+        self.c_fwd_batches = _metrics.Counter(
+            "io_shard_forward_batches",
+            "shard_fwd messages sent head-ward (frames/batches = per-conn "
+            "coalescing on the forward channel)",
+            tag_keys=("shard",),
+        ).set_default_tags(tag)
+        self.c_accepts = _metrics.Counter(
+            "io_shard_accepts",
+            "conn handoffs this io shard adopted from the head",
+            tag_keys=("shard",),
+        ).set_default_tags(tag)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def serve_forever(self) -> None:
+        from ray_tpu._private import wire
+
+        while True:
+            try:
+                events = self.sel.select(timeout=0.05)
+            except OSError:
+                continue
+            for key, _ in events:
+                obj = key.fileobj
+                if obj is self.fd_conn:
+                    self._accept_handoff()
+                elif obj is self.ctl_conn:
+                    self._drain_ctl()
+                else:
+                    self._drain_conn(obj)
+            self._expire_pending()
+            self._maybe_push_metrics()
+            # Round end: every forwarded batch + routed send queued this
+            # round goes out as one physical write per channel (the
+            # flush-before-blocking-wait rule — select() is this loop's
+            # blocking wait).
+            wire.flush_dirty()
+
+    def _head_gone(self) -> None:
+        # The ctl channel died: the head bounced (or shut down).  Owned
+        # conns are useless without it — exit and let every peer's conn
+        # EOF drive its normal reconnect to the (restarted) head.
+        raise SystemExit(0)
+
+    # -- handoff path ------------------------------------------------------
+
+    def _accept_handoff(self) -> None:
+        from ray_tpu._private import netutil, wire
+
+        try:
+            meta = self.fd_conn.recv()
+        except (EOFError, OSError):
+            self._head_gone()
+            return
+        if meta[0] == "shutdown":
+            raise SystemExit(0)
+        _tag, conn_id, kind, _peer_id = meta
+        try:
+            raw = netutil.recv_conn_fd(self.fd_conn)
+        except (EOFError, OSError):
+            self._head_gone()
+            return
+        if faults.ENABLED:
+            # crash = die with the fd adopted but unregistered (the
+            # mid-handshake window: the peer sees a clean conn EOF and
+            # must reconnect, never wedge); error/drop = refuse the
+            # handoff (same peer-visible outcome, shard survives).
+            try:
+                if faults.point("shard.accept", key=kind) == "drop":
+                    raw.close()
+                    return
+            except faults.InjectedFault:
+                try:
+                    raw.close()
+                except OSError:
+                    pass
+                return
+        conn = wire.batching(wire.wrap(raw))
+        self.owned[conn_id] = conn
+        self.conn_ids[conn] = conn_id
+        self.sel.register(conn, self._read_event)
+        self.c_accepts.inc()
+        self.g_conns.set(float(len(self.owned)))
+        queued = self.pending_sends.pop(conn_id, None)
+        if queued is not None:
+            for msg in queued[1]:
+                self._deliver(conn_id, msg)
+
+    # -- inbound: conn -> head --------------------------------------------
+
+    def _drain_conn(self, conn) -> None:
+        conn_id = self.conn_ids.get(conn)
+        if conn_id is None:
+            return
+        eof = False
+        msgs: List[Any] = []
+        try:
+            msgs.append(conn.recv())
+            while len(msgs) < _DRAIN_CAP and conn.poll(0):
+                msgs.append(conn.recv())
+            while conn.pending_frames():
+                msgs.append(conn.recv())
+        except (EOFError, OSError):
+            # ProtocolError subclasses ConnectionError: a garbage-speaking
+            # peer drops like a dead one, after its decoded prefix lands.
+            eof = True
+        if msgs:
+            self._forward(conn_id, msgs)
+        if eof:
+            self._close_conn(conn_id, report=True)
+
+    def _forward(self, conn_id: str, msgs: List[Any]) -> None:
+        if faults.ENABLED:
+            # drop = the forwarded batch is lost shard-side (peers'
+            # retry/reconnect budgets must absorb it, like a wire drop);
+            # crash = the soak's shard-kill: die with decoded frames in
+            # hand — the conn fds die with us, peers reconnect.
+            if faults.point("shard.forward", key=_kind(msgs[0])) == "drop":
+                return
+        try:
+            self.ctl_conn.send(("shard_fwd", conn_id, msgs))
+        except OSError:
+            self._head_gone()
+            return
+        self.c_forwarded.inc(float(len(msgs)))
+        self.c_fwd_batches.inc()
+
+    # -- outbound: head -> conn -------------------------------------------
+
+    def _drain_ctl(self) -> None:
+        msgs: List[Any] = []
+        try:
+            msgs.append(self.ctl_conn.recv())
+            while len(msgs) < _DRAIN_CAP and self.ctl_conn.poll(0):
+                msgs.append(self.ctl_conn.recv())
+            while self.ctl_conn.pending_frames():
+                msgs.append(self.ctl_conn.recv())
+        except (EOFError, OSError):
+            self._head_gone()
+            return
+        for msg in msgs:
+            if msg[0] == "shard_send":
+                self._deliver(msg[1], msg[2])
+            elif msg[0] == "shard_close":
+                self._close_conn(msg[1], report=False)
+            elif msg[0] == "shutdown":
+                raise SystemExit(0)
+
+    def _deliver(self, conn_id: str, msg: Any) -> None:
+        from ray_tpu._private import config as _config
+
+        conn = self.owned.get(conn_id)
+        if conn is None:
+            deadline, queued = self.pending_sends.setdefault(
+                conn_id,
+                (time.monotonic() + _config.get("io_shard_pending_send_s"), []),
+            )
+            queued.append(msg)
+            return
+        try:
+            conn.send(msg)
+        except OSError:
+            # Dead socket discovered at send: same as an EOF on read.
+            self._close_conn(conn_id, report=True)
+
+    def _close_conn(self, conn_id: str, report: bool) -> None:
+        conn = self.owned.pop(conn_id, None)
+        self.pending_sends.pop(conn_id, None)
+        if conn is not None:
+            self.conn_ids.pop(conn, None)
+            try:
+                self.sel.unregister(conn)
+            except (KeyError, ValueError, OSError):
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self.g_conns.set(float(len(self.owned)))
+        if report:
+            try:
+                self.ctl_conn.send(("shard_eof", conn_id))
+            except OSError:
+                self._head_gone()
+
+    # -- housekeeping ------------------------------------------------------
+
+    def _expire_pending(self) -> None:
+        if not self.pending_sends:
+            return
+        now = time.monotonic()
+        for conn_id in [
+            c for c, (dl, _q) in self.pending_sends.items() if now > dl
+        ]:
+            self.pending_sends.pop(conn_id, None)
+
+    def _maybe_push_metrics(self) -> None:
+        from ray_tpu._private import config as _config
+        from ray_tpu._private import telemetry as _telemetry
+
+        period_ms = _config.get("metrics_push_ms")
+        if period_ms <= 0:
+            return
+        now = time.monotonic()
+        if now - self._last_push < period_ms / 1000.0:
+            return
+        self._last_push = now
+        try:
+            snap = _telemetry.snapshot_process(
+                extra={
+                    "io_shard_conns": float(len(self.owned)),
+                    "io_shard_pending_handoff_sends": float(
+                        len(self.pending_sends)
+                    ),
+                }
+            )
+            self.ctl_conn.send(("metrics_push", snap))
+        except OSError:
+            self._head_gone()
+        except Exception:
+            pass  # telemetry must never take the fabric down
+
+
+def main() -> None:
+    cfg = json.loads(os.environ["RAY_TPU_IO_SHARD_CONFIG"])
+    idx = int(cfg["index"])
+    tag = f"io_shard:{idx}"
+    faults.set_process_tag(tag)
+
+    from ray_tpu._private import telemetry as _telemetry
+    from ray_tpu._private import wire
+
+    _telemetry.install(tag)
+
+    from multiprocessing.connection import Client
+
+    authkey = bytes.fromhex(cfg["authkey"])
+    # Hellos ride the raw channels (plain pickled tuples, pre-framing) so
+    # the head's shard accept loop can tell ctl from fd channel apart with
+    # one recv; wire framing starts with the first post-hello message on
+    # the ctl channel, symmetric on both sides.
+    raw_ctl = Client(cfg["ctl_addr"], authkey=authkey)
+    raw_ctl.send(("io_shard", idx, os.getpid()))
+    raw_fd = Client(cfg["ctl_addr"], authkey=authkey)
+    raw_fd.send(("io_shard_fd", idx, os.getpid()))
+    ctl_conn = wire.batching(wire.wrap(raw_ctl))
+    server = _ShardServer(idx, ctl_conn, raw_fd)
+    try:
+        server.serve_forever()
+    except (KeyboardInterrupt, SystemExit):
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -425,6 +425,99 @@ def telemetry_ab(out_path=None, rounds: int = 3, budget_pct: float = 3.0):
     return report
 
 
+# ---------------------------------------------------------------------------
+# io-shard sweep: the head-fabric scaling acceptance artifact
+
+
+def shard_sweep(out_path=None, shard_counts=(0, 1, 2, 4), rounds: int = 3):
+    """multi_client_tasks_async across RAY_TPU_HEAD_IO_SHARDS values:
+    fresh cluster per point, median-of-N (the honesty rule), per-shard
+    wire counters captured from the telemetry sink — the deterministic
+    proof that decode work actually runs on shard pids.
+
+        python -m ray_tpu._private.ray_perf --shard-sweep \
+            [--json BENCH_shard_r1.json]
+
+    Honesty note baked into the artifact: on a 1-vCPU host every shard
+    process shares one core with the head, so throughput gains are
+    bounded by core count — the sweep's job THERE is to show sharding
+    costs ~nothing and moves the decode work out; scaling shows up on
+    multi-core hosts (the reference envelope is 64 cores)."""
+    import os as _os
+    import statistics
+
+    from ray_tpu._private import config as _config
+
+    saved = _os.environ.get("RAY_TPU_HEAD_IO_SHARDS")
+    sweep = []
+    try:
+        for n in shard_counts:
+            _os.environ["RAY_TPU_HEAD_IO_SHARDS"] = str(n)
+            _config._reset_for_tests()
+            ray_tpu.init(num_cpus=max(_os.cpu_count() or 1, 16))
+            runs = []
+            shard_stats = {}
+            try:
+                for _ in range(rounds):
+                    runs.append(_multi_client_once())
+                from ray_tpu._private.runtime import get_runtime
+
+                rt = get_runtime()
+                time.sleep(1.3)  # let a final metrics push land
+                for key, snap in sorted(rt.telemetry.processes.items()):
+                    if not key.startswith("io_shard"):
+                        continue
+                    w = snap.get("wire") or {}
+                    shard_stats[key] = {
+                        "pid": snap.get("pid"),
+                        "logical_frames": w.get("logical_frames", 0),
+                        "physical_writes": w.get("physical_writes", 0),
+                        "bytes_written": w.get("bytes_written", 0),
+                        "conns": int(
+                            (snap.get("internal") or {}).get("io_shard_conns", 0)
+                        ),
+                    }
+            finally:
+                ray_tpu.shutdown()
+            rec = {
+                "io_shards": n,
+                "ops_per_s": round(statistics.median(runs), 1),
+                "runs": runs,
+                "shard_wire_stats": shard_stats,
+            }
+            sweep.append(rec)
+            print(json.dumps(rec), flush=True)
+    finally:
+        if saved is None:
+            _os.environ.pop("RAY_TPU_HEAD_IO_SHARDS", None)
+        else:
+            _os.environ["RAY_TPU_HEAD_IO_SHARDS"] = saved
+        _config._reset_for_tests()
+    report = {
+        "name": "multi_client_tasks_async_shard_sweep",
+        "host_nproc": _os.cpu_count(),
+        "note": (
+            "median-of-%d per point, fresh cluster per point.  HONESTY: "
+            "on a %s-vCPU host every io shard shares cores with the head "
+            "process, so ops/s gains are bounded by core count — the "
+            "meaningful claims here are (a) sharding at 0 extra cores "
+            "costs within host noise and (b) shard_wire_stats proves the "
+            "per-conn decode work (logical_frames/physical_writes) runs "
+            "on shard pids, off the head's loop.  Throughput SCALING with "
+            "shard count is a multi-core-host claim (reference envelope: "
+            "32k tasks/s on 64 cores, SURVEY.md §6)."
+            % (rounds, _os.cpu_count())
+        ),
+        "sweep": sweep,
+    }
+    print(json.dumps(report, indent=1), flush=True)
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=1)
+            f.write("\n")
+    return report
+
+
 def main(argv=None):
     argv = argv if argv is not None else sys.argv[1:]
     out_path = None
@@ -432,6 +525,19 @@ def main(argv=None):
         out_path = argv[argv.index("--json") + 1]
     if "--telemetry-ab" in argv:
         return telemetry_ab(out_path)
+    if "--shard-sweep" in argv:
+        return shard_sweep(out_path)
+    if "--io-shards" in argv:
+        # Whole-suite override: run every bench with a sharded head
+        # fabric (the env form reaches the Runtime this process boots).
+        import os as _os2
+
+        _os2.environ["RAY_TPU_HEAD_IO_SHARDS"] = argv[
+            argv.index("--io-shards") + 1
+        ]
+        from ray_tpu._private import config as _config2
+
+        _config2._reset_for_tests()
     import os as _os
 
     # Logical-CPU headroom: the benches measure control-plane throughput,

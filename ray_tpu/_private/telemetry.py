@@ -369,6 +369,14 @@ class TelemetrySink:
                     "proc": s.get("proc"),
                     "age_s": round(time.time() - s.get("t", 0.0), 3),
                     "metrics": len(s.get("metrics") or ()),
+                    # Per-process internal gauges ride along (io-shard conn
+                    # counts, head queue depths): `ray_tpu status` reads
+                    # them per process, not just as cluster sums.
+                    **(
+                        {"internal": dict(s["internal"])}
+                        if isinstance(s.get("internal"), dict)
+                        else {}
+                    ),
                 }
                 for key, s in self.processes.items()
             }

@@ -97,8 +97,11 @@ SCHEMAS: Dict[str, Tuple[int, Optional[int], tuple]] = {
     # reconnect-time actor announcement (reconciliation handshake); the
     # optional 6th is the sender's time.time() at send — the head's
     # clock-offset estimate for merging this process's spans/task events
-    # into one cluster timeline.
-    "ready": (3, 6, (str, int)),
+    # into one cluster timeline; the optional 7th is the executor's
+    # relayed-work announcement (task ids still held) — the head
+    # re-drives in-flight work missing from it, the conn-death recovery
+    # the io-shard fabric leans on.
+    "ready": (3, 7, (str, int)),
     "actor_announce": (1, 1, (list,)),
     "env_failed": (2, 2, (str, str)),
     "done": (3, 3, (str,)),
@@ -120,6 +123,17 @@ SCHEMAS: Dict[str, Tuple[int, Optional[int], tuple]] = {
     # wire counters + internal gauges) — droppable oneway, aggregated
     # into the head's TelemetrySink (telemetry.py).
     "metrics_push": (1, 1, (dict,)),
+    # head io-shard fabric (io_shard.py): the internal channel between the
+    # head process and its io-shard processes.  shard_fwd carries a conn's
+    # decoded control messages IN ORDER (the list is the order they came
+    # off the wire — the per-conn ordering invariant across the shard
+    # boundary); shard_send is the reverse path (head reply/pub/fence
+    # frames routed out through the owning shard); shard_eof reports a
+    # handed-off conn's death.
+    "shard_fwd": (2, 2, (str, list)),
+    "shard_eof": (1, 2, (str,)),
+    "shard_send": (2, 2, (str,)),
+    "shard_close": (1, 1, (str,)),
     # cross-process pubsub (pubsub.py remote delivery)
     "subscribe": (2, 3, (str,)),
     "unsubscribe": (2, 2, (str,)),

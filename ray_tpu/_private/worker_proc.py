@@ -82,6 +82,13 @@ class WorkerRuntime:
         # Batched task-event reporter (installed by worker_main): the
         # direct transport records lease-dispatch RUNNING events here.
         self.task_event_sink = None
+        # Relayed tasks received but not yet replied (queued + executing):
+        # the reconnect hello announces these so the head can re-drive
+        # exactly what the dead conn lost — a task push that never
+        # arrived, or a done frame that died in the socket (an io-shard
+        # death loses both shapes while this process lives on).  Dict ops
+        # are GIL-atomic; insertion order mirrors arrival order.
+        self.relayed_pending: Dict[str, None] = {}
         # Oneways that failed during a head bounce, flushed on reconnect.
         self._oneway_backlog: list = []
         self._backlog_lock = lock_watchdog.make_lock("WorkerRuntime._backlog_lock")
@@ -996,9 +1003,15 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
 
         return rt.reconnect_recover(
             newconn,
+            # The trailing list is the relayed-work announcement: tasks
+            # this executor still holds (queued or running).  The head
+            # re-drives exactly the in-flight work NOT in this list — it
+            # was lost with the dead conn (reconciliation handshake,
+            # executor leg; the shard fabric's conn-death recovery).
             lambda c: c.send(
                 ("ready", worker_id, os.getpid(), node_id, peer_endpoint,
-                 rt.actor_announcement(), _time.time())
+                 rt.actor_announcement(), _time.time(),
+                 list(rt.relayed_pending))
             ),
         )
 
@@ -1016,6 +1029,12 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
             elif kind == "pub":
                 rt._on_pub(msg[1], msg[2], msg[3])
             elif kind in ("task", "create_actor"):
+                # Track BEFORE enqueueing: a reconnect hello built between
+                # receipt and execution must still announce this task.
+                try:
+                    rt.relayed_pending[msg[1].task_id] = None
+                except AttributeError:
+                    pass
                 route_task(msg, None)
             elif kind == "fence":
                 # Transport-switch barrier: acking from the recv thread
@@ -1063,6 +1082,10 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
                     rt.conn.send(done)
             except OSError:
                 pass  # head restarting: this result is lost; recv_loop reconnects
+            # Replied (or the send failed — then the result is lost either
+            # way): no longer pending, so a reconnect hello will NOT claim
+            # it and the head re-drives it if the done never landed.
+            rt.relayed_pending.pop(spec.task_id, None)
             return
         # Direct-call completion: registration oneways go to the head first
         # (FIFO behind the guard borrows _store_results already sent), then

@@ -36,6 +36,25 @@ def _init_maybe_attached(args):
     return get_worker_runtime()
 
 
+def _io_shard_rows(procs) -> dict:
+    """Head io-shard fabric as `status` rows: one entry per shard process
+    with its pushed conn-count gauge (io_shard.py metrics push)."""
+    rows = {}
+    for key, rec in (procs or {}).items():
+        if not str(rec.get("proc", "")).startswith("io_shard"):
+            continue
+        internal = rec.get("internal") or {}
+        rows[key] = {
+            "pid": rec.get("pid"),
+            "conns": int(internal.get("io_shard_conns", 0)),
+            "pending_handoff_sends": int(
+                internal.get("io_shard_pending_handoff_sends", 0)
+            ),
+            "age_s": rec.get("age_s"),
+        }
+    return rows
+
+
 def cmd_status(args) -> int:
     import ray_tpu
     from ray_tpu.util import state as state_api
@@ -48,6 +67,7 @@ def cmd_status(args) -> int:
             "available": ray_tpu.available_resources(),
             "telemetry_processes": tele.get("processes", {}),
             "telemetry": tele.get("internal", {}),
+            "io_shards": _io_shard_rows(tele.get("processes")),
         }
     else:
         tele = state_api.telemetry_summary()
@@ -57,6 +77,7 @@ def cmd_status(args) -> int:
             "available": ray_tpu.available_resources(),
             "metrics": state_api.cluster_metrics(),
             "telemetry_processes": tele.get("processes", {}),
+            "io_shards": _io_shard_rows(tele.get("processes")),
         }
     print(json.dumps(out, indent=1, default=str))
     return 0

@@ -85,6 +85,12 @@ import ray_tpu  # noqa: E402
 #     sees a torn stream — EOF or a truncated batch decode_frames rejects
 #     whole, never a partial dispatch), and a small probabilistic delay
 #     stretches flush windows to keep batch/ordering races warm.
+#   * the head runs with RAY_TPU_HEAD_IO_SHARDS=2 (ISSUE 8): one io
+#     shard is crash-killed mid-forward at its t=12 (each incarnation —
+#     a respawned shard under a still-armed spec dies again), so the
+#     soak exercises BOTH fabric hazards: conns failing over to the
+#     surviving shard and the head's shard respawn path, all while the
+#     head itself bounces.  Zero lost results still required.
 DEFAULT_SPEC = (
     "wire.send:crash@proc=worker,match=^done,after=40,every=53,times=2;"
     "wire.send:delay=0.002@prob=0.02;"
@@ -93,6 +99,7 @@ DEFAULT_SPEC = (
     "wire.send:crash@proc=daemon:soak-d1,at=18,times=1;"
     "wire.send:crash@proc=actor:AnonSoak,at=29,times=1;"
     "wire.send:crash@proc=actor:Replica,at=29,times=1;"
+    "shard.forward:crash@proc=io_shard:1,at=12,times=1;"
     "gcs.journal_append:crash@proc=head,at=24,times=1;"
     "gcs.save:crash@proc=head,at=30,times=1"
 )
@@ -435,11 +442,17 @@ def run_soak(
             "RAY_TPU_TRACE",
             "RAY_TPU_FLIGHT_DIR",
             "RAY_TPU_METRICS_PUSH_MS",
+            "RAY_TPU_HEAD_IO_SHARDS",
         )
     }
     os.environ["RAY_TPU_FAULT_SPEC"] = spec
     os.environ["RAY_TPU_FAULT_SEED"] = str(seed)
     os.environ["RAY_TPU_RECONNECT_WINDOW_S"] = "45"
+    # ISSUE 8: the soak runs the SHARDED head fabric — every head
+    # incarnation fans its conns across 2 io shards, and the spec kills
+    # one shard mid-forward (its conns must fail over with zero lost
+    # results while head kills overlap).
+    os.environ.setdefault("RAY_TPU_HEAD_IO_SHARDS", "2")
     # FULL telemetry plane on across every process of the soak cluster
     # (ISSUE 6 acceptance: the soak passes with push + spans + flight
     # recorder enabled, and every fault-plane kill leaves a flight dump
@@ -468,7 +481,7 @@ def run_soak(
         "seed": seed,
         "spec": spec,
         "duration_s": duration,
-        "kills": {"head": 0, "daemon": 0},
+        "kills": {"head": 0, "daemon": 0, "io_shard": 0},
         "lock_watchdog": {"enabled": watch_locks, "reports": []},
         "result": "FAIL",
     }
@@ -657,6 +670,22 @@ def run_soak(
         dumps = _collect_flight(report, flight_dir)
         assert dumps, (
             "fault-plane kills fired but produced no flight-recorder dumps"
+        )
+        # ISSUE 8 acceptance: the io-shard kill clause fired (its flight
+        # dump is attached), and the soak still drained with zero lost
+        # results — the shard's conns failed over and the head respawned
+        # the shard while the storm ran.
+        from ray_tpu._private import telemetry as _telemetry
+
+        shard_dumps = [
+            d
+            for d in _telemetry.collect_dumps(flight_dir)
+            if str(d.get("proc", "")).startswith("io_shard")
+        ]
+        report["kills"]["io_shard"] = len(shard_dumps)
+        assert shard_dumps, (
+            "shard.forward kill clause never fired — no io-shard flight "
+            "dump found (is the sharded fabric actually on?)"
         )
         report["result"] = "PASS"
         return report
