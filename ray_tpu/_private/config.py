@@ -265,6 +265,51 @@ _DEFS: Dict[str, tuple] = {
         "crash, lock-watchdog report, or fault-plane kill; empty disables "
         "dumping (the ring still records)",
     ),
+    "refs_push": (
+        1, int,
+        "1 = every worker/driver ships its live ObjectRef table (oid, "
+        "count, creation site) to the head's object ledger each telemetry "
+        "tick as a droppable refs_push oneway (requires metrics_push_ms "
+        "> 0); 0 disables the ref-table leg only (ray: the per-worker "
+        "ReferenceCounter tables `ray memory` joins, reference_count.h:61)",
+    ),
+    "ref_callsite": (
+        0, int,
+        "1 = capture the creation site (first non-ray_tpu stack frame) of "
+        "every ObjectRef into the live-ref table, enabling `ray_tpu memory "
+        "--group-by callsite`; off by default — a frame walk per ref on "
+        "the hot path (ray: RAY_record_ref_creation_sites)",
+    ),
+    "leak_reclaim_grace_s": (
+        3.0, float,
+        "how long a crashed process's outstanding ref borrows stay as "
+        "attributed LEAK SUSPECTS in the object ledger before the head "
+        "reclaims them (decref + free); the window in which `ray_tpu "
+        "memory --leaks` can attribute leaked bytes to the dead holder's "
+        "node/pid",
+    ),
+    "leak_orphan_reclaim_s": (
+        20.0, float,
+        "how long a NO-LIVE-HOLDER leak suspect (located ready bytes at "
+        "refcount 0 that no live process's ref table claims) must stay "
+        "flagged across ledger ticks before the head frees it (0 = never "
+        "auto-free).  Covers the head-bounce retention gap: a re-driven "
+        "task's result seals at refcount 0 on the restarted head, and a "
+        "driver that already dropped its ref can never free it — each "
+        "reclaim is a WARNING event, visible, not papered over",
+    ),
+    "leak_age_s": (
+        10.0, float,
+        "minimum object age before located bytes with refcount 0 and no "
+        "live holder count as a leak suspect (younger objects are in the "
+        "legitimate seal-to-first-addref window)",
+    ),
+    "object_events_max": (
+        4096, int,
+        "bound on the head's object lifecycle event ring (create/seal/"
+        "transfer/spill/restore/free records merged into the chrome "
+        "timeline)",
+    ),
     "head_io_shards": (
         0, int,
         "number of io-shard processes the head fans its connection fabric "

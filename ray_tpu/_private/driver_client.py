@@ -163,12 +163,17 @@ def _metrics_push_loop(rt) -> None:
     period = max(_config.get("metrics_push_ms"), 0) / 1000.0
     if period <= 0:
         return
+    push_refs = bool(_config.get("refs_push"))
     while _attached is rt and not getattr(rt, "_detaching", False):
         _time.sleep(period)
         spans = tracing.drain_spans()
         if spans:
             rt.oneway(("spans", spans), droppable=True)
         rt.oneway(("metrics_push", telemetry.snapshot_process()), droppable=True)
+        if push_refs:
+            # The attached driver's live-ref table is a ledger leg like
+            # any worker's — its held refs attribute to this process.
+            rt.oneway(("refs_push", rt.ref_table_snapshot()), droppable=True)
         wire.flush_dirty()
 
 

@@ -274,8 +274,47 @@ def bench_put_ops(n: int = 2000) -> Dict:
     return timeit("single_client_put_ops", run)
 
 
+def _copy_stats_delta(before: Dict, after: Dict) -> Dict:
+    """{path: {copies, bytes, bytes_per_copy}} from two copy-counter
+    snapshots (telemetry.copy_counter_snapshot) — the object plane's
+    deterministic cost metric, same role writes_per_op plays for the
+    control plane."""
+    out: Dict = {}
+    for path, rec in after.items():
+        b = before.get(path, {"copies": 0.0, "bytes": 0.0})
+        copies = rec.get("copies", 0.0) - b.get("copies", 0.0)
+        nbytes = rec.get("bytes", 0.0) - b.get("bytes", 0.0)
+        if copies > 0:
+            out[path] = {
+                "copies": int(copies),
+                "bytes": int(nbytes),
+                "bytes_per_copy": round(nbytes / copies, 1),
+            }
+    return out
+
+
+def _cluster_copy_stats() -> Dict:
+    """Cluster-wide copy counters: every process's pushed object_copies /
+    object_copy_bytes series merged by the telemetry sink (workers count
+    their own seals and pulls — the head's registry alone undercounts)."""
+    from ray_tpu._private.runtime import get_runtime
+
+    rt = get_runtime()
+    rt.telemetry.ingest("head", rt.head_telemetry_snapshot())
+    agg = rt.telemetry.aggregate()
+    out: Dict = {}
+    for name, field in (("object_copies", "copies"), ("object_copy_bytes", "bytes")):
+        rec = agg.get(name)
+        for tk, v in (rec or {}).get("data", {}).items():
+            path = dict(tk).get("path", "?")
+            out.setdefault(path, {"copies": 0.0, "bytes": 0.0})[field] = float(v)
+    return out
+
+
 def bench_put_gigabytes(total_gb: float = 1.0, chunk_mb: int = 100) -> Dict:
     import numpy as np
+
+    from ray_tpu._private import telemetry as _telemetry
 
     chunk = np.zeros(chunk_mb * 1024 * 1024, dtype=np.uint8)
     n_chunks = int(total_gb * 1024 / chunk_mb)
@@ -294,6 +333,7 @@ def bench_put_gigabytes(total_gb: float = 1.0, chunk_mb: int = 100) -> Dict:
     for _ in range(1):
         run()
     runs = []
+    c0 = _telemetry.copy_counter_snapshot()
     for _ in range(3):
         t0 = time.perf_counter()
         run()
@@ -303,6 +343,12 @@ def bench_put_gigabytes(total_gb: float = 1.0, chunk_mb: int = 100) -> Dict:
         "name": "single_client_put_gigabytes",
         "gb_per_s": round(statistics.median(runs), 2),
         "runs": runs,
+        # bytes-per-copy on the put path (this process seals every chunk):
+        # one sealed copy per put, packed size each — the one-copy
+        # create/seal claim, counted rather than asserted.
+        "copy_stats": _copy_stats_delta(
+            c0, _telemetry.copy_counter_snapshot()
+        ),
     }
 
 
@@ -337,6 +383,96 @@ def _multi_client_once(n_clients: int = 4, n_per: int = 1000) -> float:
     return round(sum(done) / dt, 1)
 
 
+def refs_ab(out_path=None, rounds: int = 3, budget_pct: float = 3.0):
+    """A/B the object-ledger leg ALONE: both sides run the full telemetry
+    plane (push + trace + flight recorder); only RAY_TPU_REFS_PUSH (the
+    live-ref table push + head-side ledger ingest) toggles.  This is the
+    ISSUE 9 acceptance measurement — the ledger's own increment on
+    multi_client_tasks_async must stay under budget.  (The whole-plane
+    on/off number lives in telemetry_ab; on a noisy shared host the
+    isolated toggle is the honest way to attribute cost to THIS leg.)
+
+        python -m ray_tpu._private.ray_perf --refs-ab \
+            [--json BENCH_refs_r1.json]
+    """
+    import os as _os
+    import statistics
+
+    from ray_tpu._private import config as _config
+    from ray_tpu.util import tracing
+
+    flight_dir = f"/tmp/raytpu-refsab-flight-{_os.getpid()}"
+    saved = {
+        k: _os.environ.get(k)
+        for k in (
+            "RAY_TPU_METRICS_PUSH_MS",
+            "RAY_TPU_TRACE",
+            "RAY_TPU_FLIGHT_DIR",
+            "RAY_TPU_REFS_PUSH",
+        )
+    }
+    runs = {"off": [], "on": []}
+    try:
+        # Full plane on BOTH sides.
+        _os.environ["RAY_TPU_METRICS_PUSH_MS"] = "1000"
+        _os.environ["RAY_TPU_TRACE"] = "1"
+        _os.environ["RAY_TPU_FLIGHT_DIR"] = flight_dir
+        tracing.enable_tracing()
+        for _r in range(rounds):
+            for mode in ("off", "on"):
+                _os.environ["RAY_TPU_REFS_PUSH"] = "0" if mode == "off" else "1"
+                _config._reset_for_tests()
+                ray_tpu.init(num_cpus=max(_os.cpu_count() or 1, 16))
+                try:
+                    ops = _multi_client_once()
+                finally:
+                    ray_tpu.shutdown()
+                runs[mode].append(ops)
+                print(
+                    json.dumps({"mode": mode, "round": _r, "ops_per_s": ops}),
+                    flush=True,
+                )
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                _os.environ.pop(k, None)
+            else:
+                _os.environ[k] = v
+        _config._reset_for_tests()
+        tracing.disable_tracing()
+    off_m = statistics.median(runs["off"])
+    on_m = statistics.median(runs["on"])
+    overhead_pct = round((off_m - on_m) / off_m * 100, 2)
+    report = {
+        "name": "refs_push_ab_multi_client_tasks_async",
+        "note": (
+            "interleaved rounds, medians compared (median-of-"
+            f"{rounds}).  BOTH sides run the full telemetry plane "
+            "(RAY_TPU_METRICS_PUSH_MS=1000, RAY_TPU_TRACE=1, flight "
+            "recorder armed); only RAY_TPU_REFS_PUSH toggles — the "
+            "object-ledger leg (per-process live-ref tables pushed each "
+            "tick + head-side ledger joins/gauges) is the only delta"
+        ),
+        "off_runs": runs["off"],
+        "on_runs": runs["on"],
+        "off_median_ops_per_s": off_m,
+        "on_median_ops_per_s": on_m,
+        "overhead_pct": overhead_pct,
+        "budget_pct": budget_pct,
+        "pass": overhead_pct < budget_pct,
+    }
+    print(json.dumps(report, indent=1), flush=True)
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=1)
+            f.write("\n")
+    assert overhead_pct < budget_pct, (
+        f"refs-push leg costs {overhead_pct}% on multi_client_tasks_async "
+        f"(budget {budget_pct}%): off={runs['off']} on={runs['on']}"
+    )
+    return report
+
+
 def telemetry_ab(out_path=None, rounds: int = 3, budget_pct: float = 3.0):
     """A/B the FULL telemetry plane (metric push + trace spans + flight
     recorder) against telemetry-off on the multi_client_tasks_async
@@ -357,7 +493,12 @@ def telemetry_ab(out_path=None, rounds: int = 3, budget_pct: float = 3.0):
     flight_dir = f"/tmp/raytpu-ab-flight-{_os.getpid()}"
     saved = {
         k: _os.environ.get(k)
-        for k in ("RAY_TPU_METRICS_PUSH_MS", "RAY_TPU_TRACE", "RAY_TPU_FLIGHT_DIR")
+        for k in (
+            "RAY_TPU_METRICS_PUSH_MS",
+            "RAY_TPU_TRACE",
+            "RAY_TPU_FLIGHT_DIR",
+            "RAY_TPU_REFS_PUSH",
+        )
     }
     runs = {"off": [], "on": []}
     try:
@@ -365,13 +506,16 @@ def telemetry_ab(out_path=None, rounds: int = 3, budget_pct: float = 3.0):
             for mode in ("off", "on"):
                 if mode == "off":
                     _os.environ["RAY_TPU_METRICS_PUSH_MS"] = "0"
+                    _os.environ["RAY_TPU_REFS_PUSH"] = "0"
                     _os.environ.pop("RAY_TPU_TRACE", None)
                     _os.environ.pop("RAY_TPU_FLIGHT_DIR", None)
                     tracing.disable_tracing()
                 else:
                     # The default push period, tracing on, flight dumps
-                    # armed — the whole plane, not a softened subset.
+                    # armed, refs-push feeding the object ledger — the
+                    # whole plane, not a softened subset.
                     _os.environ["RAY_TPU_METRICS_PUSH_MS"] = "1000"
+                    _os.environ["RAY_TPU_REFS_PUSH"] = "1"
                     _os.environ["RAY_TPU_TRACE"] = "1"
                     _os.environ["RAY_TPU_FLIGHT_DIR"] = flight_dir
                     tracing.enable_tracing()
@@ -402,8 +546,9 @@ def telemetry_ab(out_path=None, rounds: int = 3, budget_pct: float = 3.0):
         "note": (
             "interleaved OFF/ON rounds; medians compared (median-of-"
             f"{rounds}).  ON = RAY_TPU_METRICS_PUSH_MS=1000 + "
+            "RAY_TPU_REFS_PUSH=1 (object-ledger ref tables) + "
             "RAY_TPU_TRACE=1 + flight recorder armed; OFF = push "
-            "disabled, no tracing, no flight dir"
+            "disabled, no refs push, no tracing, no flight dir"
         ),
         "off_runs": runs["off"],
         "on_runs": runs["on"],
@@ -518,6 +663,39 @@ def shard_sweep(out_path=None, shard_counts=(0, 1, 2, 4), rounds: int = 3):
     return report
 
 
+def object_plane_bench(out_path=None):
+    """The measurement leg of the broadcast/arena roadmap item: put and
+    broadcast shapes with bytes-per-copy counters (median-of-3 timings,
+    counter deltas per path).
+
+        python -m ray_tpu._private.ray_perf --object-plane \
+            [--json BENCH_objmem_r1.json]
+    """
+    import os as _os
+
+    ray_tpu.init(num_cpus=max(_os.cpu_count() or 1, 8), ignore_reinit_error=True)
+    results = [bench_put_gigabytes(), bench_broadcast_cross_node()]
+    for r in results:
+        print(json.dumps(r), flush=True)
+    ray_tpu.shutdown()
+    report = {
+        "name": "object_plane_bytes_per_copy",
+        "note": (
+            "timings are median-of-3 (put) / cold+warm rounds "
+            "(broadcast); copy_stats are object_copies/object_copy_bytes "
+            "counter deltas — put counts this process's sealed copies, "
+            "broadcast counts the cluster-wide pushed aggregate (each "
+            "receiving node's pull)"
+        ),
+        "benches": results,
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=1)
+            f.write("\n")
+    return report
+
+
 def main(argv=None):
     argv = argv if argv is not None else sys.argv[1:]
     out_path = None
@@ -525,8 +703,12 @@ def main(argv=None):
         out_path = argv[argv.index("--json") + 1]
     if "--telemetry-ab" in argv:
         return telemetry_ab(out_path)
+    if "--refs-ab" in argv:
+        return refs_ab(out_path)
     if "--shard-sweep" in argv:
         return shard_sweep(out_path)
+    if "--object-plane" in argv:
+        return object_plane_bench(out_path)
     if "--io-shards" in argv:
         # Whole-suite override: run every bench with a sharded head
         # fabric (the env form reaches the Runtime this process boots).
@@ -572,8 +754,6 @@ def main(argv=None):
     return results
 
 
-if __name__ == "__main__":
-    main()
 
 
 def bench_broadcast_cross_node(n_nodes: int = 3, mb: int = 100) -> Dict:
@@ -629,7 +809,14 @@ def bench_broadcast_cross_node(n_nodes: int = 3, mb: int = 100) -> Dict:
         assert all(o == expect for o in outs)
         return time.perf_counter() - t0
 
+    # Copy counters are cluster-wide (each node's worker counts its own
+    # pull): snapshot the pushed aggregate around the cold round, with a
+    # settle sleep so the final worker ticks land.
+    time.sleep(1.5)
+    c0 = _cluster_copy_stats()
     cold = run()  # every node pulls over the wire
+    time.sleep(1.5)
+    c1 = _cluster_copy_stats()
     warm = run()  # all copies local: pure read path
     for nid in nids:
         rt.remove_node(nid)
@@ -639,4 +826,11 @@ def bench_broadcast_cross_node(n_nodes: int = 3, mb: int = 100) -> Dict:
         "cold_s": round(cold, 3),
         "cold_gb_per_s": round(total_gb / cold, 2),
         "warm_s": round(warm, 3),
+        # the bytes-per-copy ledger of the cold broadcast: n_nodes pull
+        # copies of the packed payload, and nothing else should move
+        "copy_stats": _copy_stats_delta(c0, c1),
     }
+
+
+if __name__ == "__main__":
+    main()

@@ -35,6 +35,103 @@ _release_event = threading.Event()
 _drainer_lock = threading.Lock()
 _drainer_pid: Optional[int] = None
 
+# ---------------------------------------------------------------------------
+# Live-ref table: this process's leg of the cluster object ledger
+# (telemetry.py ObjectLedger; ray: reference_count.h:61 keeps exactly this
+# per-worker table and `ray memory` joins them).  Every ObjectRef
+# construction registers {oid: count} here (plus, when RAY_TPU_REF_CALLSITE
+# is on, the first non-ray_tpu creation site); __del__ queues a GIL-atomic
+# decrement (same no-locks-in-GC rule as the release queue above).  The
+# worker/driver telemetry tick snapshots the table and ships it head-ward
+# as a droppable refs_push oneway.
+
+_table_lock = threading.Lock()
+_table_pid: Optional[int] = None
+_live_table: dict = {}  # oid -> live ObjectRef count in this process
+_ref_sites: dict = {}  # oid -> "file.py:line" creation site (knob-gated)
+_table_dels: "collections.deque[str]" = collections.deque()
+
+
+def _callsite() -> Optional[str]:
+    """First stack frame outside the ray_tpu package — the user line that
+    created the ref.  Only called when the ref_callsite knob is on."""
+    import sys as _sys
+
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    f = _sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not fn.startswith(pkg):
+            return f"{os.path.basename(fn)}:{f.f_lineno}"
+        f = f.f_back
+    return None
+
+
+def _table_check_pid_locked() -> None:
+    # After a fork the inherited table describes the PARENT's refs.
+    global _table_pid
+    if _table_pid != os.getpid():
+        _table_pid = os.getpid()
+        _live_table.clear()
+        _ref_sites.clear()
+        _table_dels.clear()
+
+
+def _drain_table_dels_locked() -> None:
+    while True:
+        try:
+            oid = _table_dels.popleft()
+        except IndexError:
+            return
+        c = _live_table.get(oid, 0) - 1
+        if c > 0:
+            _live_table[oid] = c
+        else:
+            _live_table.pop(oid, None)
+            _ref_sites.pop(oid, None)
+
+
+def _table_note_new(oid: str) -> None:
+    site = None
+    try:
+        from ray_tpu._private import config as _config
+
+        if _config.get("ref_callsite"):
+            site = _callsite()
+    except Exception:
+        pass
+    with _table_lock:
+        _table_check_pid_locked()
+        if len(_table_dels) > 512:  # keep the GC queue bounded
+            _drain_table_dels_locked()
+        _live_table[oid] = _live_table.get(oid, 0) + 1
+        if site is not None and oid not in _ref_sites:
+            _ref_sites[oid] = site
+
+
+def snapshot_refs(limit: int = 4096) -> dict:
+    """{oid: [count, site|None]} for every live ObjectRef here, plus a
+    truncation marker — the refs_push payload body."""
+    with _table_lock:
+        _table_check_pid_locked()
+        _drain_table_dels_locked()
+        refs = {}
+        for oid, n in _live_table.items():
+            if len(refs) >= limit:
+                break
+            refs[oid] = [n, _ref_sites.get(oid)]
+        truncated = len(_live_table) > len(refs)
+    return {"refs": refs, "truncated": truncated}
+
+
+def _reset_table_for_tests() -> None:
+    global _table_pid
+    with _table_lock:
+        _table_pid = None
+        _live_table.clear()
+        _ref_sites.clear()
+        _table_dels.clear()
+
 
 def _drain_releases() -> None:
     import time as _time
@@ -58,6 +155,13 @@ def _drain_releases() -> None:
                 hook(oid)
             except Exception:
                 pass
+        # Fold queued __del__ decrements into the live-ref table on the
+        # same cadence (normal thread context: locks are safe here).
+        try:
+            with _table_lock:
+                _drain_table_dels_locked()
+        except Exception:
+            pass
 
 
 def _ensure_drainer() -> None:
@@ -88,6 +192,7 @@ class ObjectRef:
     def __init__(self, id: str, owner: str | None = None, *, _count: bool = True):
         self._id = id
         self._owner = owner
+        _table_note_new(id)
         if _count and _addref_hook is not None:
             _addref_hook(id)
 
@@ -112,9 +217,14 @@ class ObjectRef:
         return f"ObjectRef({self._id})"
 
     def __del__(self):
-        # Never call the hook here: __del__ runs at arbitrary GC points,
-        # possibly while THIS thread holds the very locks the hook takes.
-        # Queue the release for the drainer thread instead.
+        # Never call the hook (or take the table lock) here: __del__ runs
+        # at arbitrary GC points, possibly while THIS thread holds the very
+        # locks the hook takes.  Queue everything for the drainer thread —
+        # deque appends are GIL-atomic.
+        try:
+            _table_dels.append(self._id)
+        except Exception:
+            pass
         if _release_hook is not None:
             try:
                 _pending_releases.append(self._id)

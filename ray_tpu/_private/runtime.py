@@ -416,6 +416,7 @@ class Runtime:
         self.namespace = namespace
         self.state = GlobalState()
         self.store = OwnerStore(self.session_name, spill_dir=f"/tmp/raytpu-spill-{self.session_name}")
+        self.store.on_lifecycle = self._on_store_lifecycle
         self.lock = lock_watchdog.make_lock("Runtime.lock", rlock=True)
         self.head_node_id = ids.node_id()
         if num_cpus is None:
@@ -469,6 +470,26 @@ class Runtime:
 
         self.telemetry = _telemetry.TelemetrySink(
             ring_samples=_tcfg.get("telemetry_ring_samples")
+        )
+        # Object ledger (memory introspection plane): latest pushed live-
+        # ref table per process (refs_push oneways), joined with the owner
+        # tables below by memory_summary (ray: reference_count.h:61 tables
+        # feeding `ray memory`).
+        self.ledger = _telemetry.ObjectLedger()
+        # Conn-tracked outstanding ref borrows per WORKER (the driver twin
+        # is driver_refs): every refop add/del updates this, so a worker
+        # crash mid-hold leaves exactly the refs it still held — flagged
+        # as dead-holder leak suspects, then reclaimed after
+        # leak_reclaim_grace_s by reclaim_dead_refs.
+        self.worker_refs: Dict[str, Dict[str, int]] = {}
+        self._dead_refs: Dict[str, Dict[str, Any]] = {}
+        # Object metadata the store doesn't keep: creation time + creator
+        # process label per live object (ledger age/owner attribution).
+        self.object_meta: Dict[str, tuple] = {}
+        # Object lifecycle event ring (create/seal/transfer/spill/restore/
+        # free), merged into the chrome timeline by dashboard.timeline().
+        self.object_events: deque = deque(
+            maxlen=max(_tcfg.get("object_events_max"), 16)
         )
         self.pubsub = Publisher()
         import queue as _queue
@@ -889,6 +910,7 @@ class Runtime:
                 return
             try:
                 self.telemetry.ingest("head", self.head_telemetry_snapshot())
+                self._ledger_tick()
                 self.telemetry.sample()
             except Exception:
                 pass  # telemetry must never take the control plane down
@@ -1261,6 +1283,7 @@ class Runtime:
                     self.lineage_bytes -= self._lineage_cost(entry)
                 self._inline_lineage.discard(oid)
                 self.object_sizes.pop(oid, None)
+                self.object_meta.pop(oid, None)
                 # Remote copies die with the ownership release (ray: the
                 # owner's directory drives eviction on every holder node).
                 locs = self.object_locations.pop(oid, None)
@@ -1280,6 +1303,226 @@ class Runtime:
             self.store.add_ref(c)
 
     # ------------------------------------------------------------------
+    # object ledger (memory introspection plane)
+
+    def _obj_event(self, oid: str, event: str, nbytes=None, node=None) -> None:
+        """Append one object lifecycle event (bounded ring; deque append
+        is GIL-atomic — callable from under the store lock)."""
+        try:
+            self.object_events.append(
+                {
+                    "t": time.time(),
+                    "oid": oid,
+                    "event": event,
+                    "bytes": nbytes,
+                    "node": node or self.head_node_id,
+                }
+            )
+        except Exception:
+            pass  # observability never takes the control plane down
+
+    def _on_store_lifecycle(self, oid: str, event: str, nbytes) -> None:
+        # OwnerStore hook: spill/restore/free transitions (may fire under
+        # store._lock — keep this append-only).
+        self._obj_event(oid, event, nbytes)
+
+    def _note_object(self, oid: str, creator: str) -> None:
+        """First sighting of a sealed object: creation time + creator for
+        the ledger's age/owner attribution (GIL-atomic dict write)."""
+        if oid not in self.object_meta:
+            self.object_meta[oid] = (time.time(), creator)
+
+    def reclaim_dead_refs(self, force: bool = False) -> int:
+        """Drop the outstanding ref borrows of crashed processes whose
+        reclaim grace lapsed (the dead-holder leak suspects): each borrow
+        decrefs like the lost refop del would have, freeing the bytes the
+        dead holder pinned.  Returns the number of holders reclaimed.
+        Runs on the io-loop reap tick; force=True (tests, shutdown paths)
+        ignores the grace."""
+        now = time.monotonic()
+        with self.lock:
+            doomed = [
+                (wid, rec)
+                for wid, rec in self._dead_refs.items()
+                if force or now >= rec["reclaim_at"]
+            ]
+            for wid, _rec in doomed:
+                self._dead_refs.pop(wid, None)
+        for wid, rec in doomed:
+            refs = rec.get("refs") or {}
+            self.events.emit(
+                "INFO", "object", "dead holder refs reclaimed",
+                worker_id=wid, objects=len(refs),
+                node_id=rec.get("node"),
+            )
+            for oid, n in refs.items():
+                for _ in range(max(int(n), 0)):
+                    self._decref_local(oid)
+        return len(doomed)
+
+    def _ledger_conn_refs(self):
+        """Holder-side inputs of the ledger join: conn-tracked borrow
+        tables (workers + attached drivers), this head process's own
+        live-ref table, the pushed refs_push snapshots (sites/owned
+        enrichment), and node/pid attribution per holder."""
+        from ray_tpu._private import refs as refs_mod
+
+        with self.lock:
+            conn_refs: Dict[str, Dict[str, int]] = {
+                w: dict(m) for w, m in self.worker_refs.items() if m
+            }
+            for did, m in self.driver_refs.items():
+                if m:
+                    conn_refs[did] = dict(m)
+            proc_info: Dict[str, tuple] = {}
+            for wid, h in self.workers.items():
+                if h.state != "dead":
+                    proc_info[wid] = (h.node_id, h.pid)
+            for did in self.drivers:
+                proc_info[did] = (self.driver_nodes.get(did), None)
+        head_snap = refs_mod.snapshot_refs()
+        conn_refs["head"] = {
+            oid: rec[0] for oid, rec in head_snap["refs"].items()
+        }
+        proc_info["head"] = (self.head_node_id, os.getpid())
+        pushed = self.ledger.snapshot()
+        pushed["head"] = head_snap
+        return conn_refs, pushed, proc_info
+
+    def memory_records(self, limit: Optional[int] = None) -> List[dict]:
+        """Per-object ledger records: the owner tables (store, directory,
+        sizes, meta) joined with every holder-side ref table — the
+        `ray memory` data model (SURVEY §2.1)."""
+        from ray_tpu._private import config as _config
+        from ray_tpu._private import telemetry as _telemetry
+
+        store_table, rc, ready = self.store.snapshot_table()
+        with self.lock:
+            locations = {
+                o: sorted(s) for o, s in self.object_locations.items()
+            }
+            sizes = dict(self.object_sizes)
+            meta = dict(self.object_meta)
+            dead = {w: dict(r) for w, r in self._dead_refs.items()}
+        conn_refs, pushed, proc_info = self._ledger_conn_refs()
+        recs = _telemetry.build_memory_records(
+            store_table, rc, ready, locations, sizes, meta,
+            conn_refs, pushed, dead, proc_info,
+            now=time.time(), leak_age_s=_config.get("leak_age_s"),
+        )
+        return recs[:limit] if limit else recs
+
+    def memory_summary(
+        self,
+        group_by: Optional[str] = None,
+        top: int = 20,
+        include_events: bool = False,
+    ) -> dict:
+        from ray_tpu._private import telemetry as _telemetry
+
+        out = _telemetry.summarize_memory_records(
+            self.memory_records(), group_by=group_by, top=top
+        )
+        if include_events:
+            out["events"] = list(self.object_events)[-200:]
+        return out
+
+    def get_logs_all(self, n: Optional[int] = None) -> dict:
+        """Aggregate log tail across every worker that produced output,
+        with node/pid attribution (`ray_tpu logs --all`)."""
+        with self.lock:
+            wids = list(self.worker_logs)
+            info = {
+                wid: (h.node_id, h.pid) for wid, h in self.workers.items()
+            }
+        out = {}
+        for wid in wids:
+            node, pid = info.get(wid, (None, None))
+            out[wid] = {
+                "node": node,
+                "pid": pid,
+                "lines": self.get_logs(wid, n),
+            }
+        return out
+
+    def _ledger_tick(self) -> None:
+        """Refresh the Prometheus-facing ledger gauges (per-node store/
+        spilled bytes, per-node leak-suspect bytes) from a fresh join,
+        and run the orphan reclaim sweep.  Runs on the head telemetry
+        thread each push tick."""
+        from ray_tpu._private import config as _config
+        from ray_tpu._private import telemetry as _telemetry
+
+        records = self.memory_records()
+        summary = _telemetry.summarize_memory_records(records, top=0)
+        # Orphan reclaim: a NO-LIVE-HOLDER suspect that stays flagged
+        # across leak_orphan_reclaim_s of consecutive ticks has no path
+        # back to a positive refcount (any process that could still send
+        # the missing add would list the oid in its pushed ref table and
+        # un-flag it) — free it, LOUDLY.  The shape this closes: after a
+        # head bounce the restored store has no refcounts, a re-driven
+        # task re-seals its result at rc 0, and the owner's already-sent
+        # release sits buffered forever (the chaos soak's ledger
+        # convergence assertion found exactly this).
+        grace = _config.get("leak_orphan_reclaim_s")
+        if grace > 0 and _config.get("refs_push"):
+            now = time.monotonic()
+            flagged = getattr(self, "_orphan_flagged", None)
+            if flagged is None:
+                flagged = self._orphan_flagged = {}
+            current = {
+                r["object_id"]: r
+                for r in records
+                if r["leak"] == "no-live-holder"
+            }
+            for oid in list(flagged):
+                if oid not in current:
+                    flagged.pop(oid, None)
+            for oid, r in current.items():
+                first = flagged.setdefault(oid, now)
+                if now - first < grace:
+                    continue
+                flagged.pop(oid, None)
+                self.events.emit(
+                    "WARNING", "object",
+                    "orphaned object reclaimed (no live holder)",
+                    object_id=oid, size_bytes=r["size_bytes"],
+                    age_s=r["age_s"],
+                )
+                self._decref_local(oid)  # rc 0 + known -> frees the bytes
+        g_bytes, g_leak = _telemetry.ledger_gauges()
+        leak_by_node: Dict[str, float] = {}
+        for r in summary["leaks"]:
+            node = next(
+                (
+                    h["node"]
+                    for h in r["holders"]
+                    if h.get("dead") and h.get("node")
+                ),
+                None,
+            ) or "head"
+            leak_by_node[node] = leak_by_node.get(node, 0.0) + float(
+                r["size_bytes"] or 0
+            )
+        nodes = set(summary["nodes"]) | set(leak_by_node)
+        stale = getattr(self, "_ledger_gauge_nodes", set()) - nodes
+        for node, rec in summary["nodes"].items():
+            g_bytes.set(
+                rec["store_bytes"], tags={"node": str(node), "tier": "store"}
+            )
+            g_bytes.set(
+                rec["spilled_bytes"],
+                tags={"node": str(node), "tier": "spilled"},
+            )
+        for node in nodes:
+            g_leak.set(leak_by_node.get(node, 0.0), tags={"node": str(node)})
+        for node in stale:  # removed nodes zero out instead of lingering
+            g_bytes.set(0.0, tags={"node": str(node), "tier": "store"})
+            g_bytes.set(0.0, tags={"node": str(node), "tier": "spilled"})
+            g_leak.set(0.0, tags={"node": str(node)})
+        self._ledger_gauge_nodes = nodes
+
+    # ------------------------------------------------------------------
     # worker pool (ray: src/ray/raylet/worker_pool.h:156)
 
     def _daemon_send(self, node_id: str, msg: tuple) -> None:
@@ -1297,6 +1540,7 @@ class Runtime:
         actors; lifetime="detached" actors keep serving
         (ray: gcs_actor_manager OnJobFinished + gcs_job_manager)."""
         self.telemetry.forget(did)
+        self.ledger.forget(did)
         with self.lock:
             self.drivers.pop(did, None)
             self.driver_nodes.pop(did, None)
@@ -2548,6 +2792,10 @@ class Runtime:
                 # Off the runtime lock: a respawn is a subprocess spawn.
                 if self._io_shards:
                     self._supervise_io_shards(now)
+                # Dead-holder ref reclaim rides the same tick (its own
+                # lock dance inside; decrefs may fan daemon deletes).
+                if self._dead_refs:
+                    self.reclaim_dead_refs()
             if self._prestart_target > 0 and now - last_topup > 0.05:
                 # Throttled: an every-iteration lock acquire here convoys
                 # with the hot message path during drains.
@@ -2855,19 +3103,26 @@ class Runtime:
         if msg[0] == "done":
             self._on_task_done(wid, msg[1], msg[2], msg[3])
             return
+        # Every sender's outstanding borrows are conn-tracked (drivers in
+        # driver_refs, workers in worker_refs): a holder dying mid-hold
+        # leaves exactly the refs its lost dels would have released — the
+        # ledger flags them as dead-holder leak suspects and
+        # reclaim_dead_refs drops them after the grace.
         tracked = self.driver_refs.get(wid)
+        if tracked is None:
+            tracked = self.worker_refs.get(wid)
+            if tracked is None:
+                tracked = self.worker_refs.setdefault(wid, {})
         if msg[1] == "add":
             self.store.add_ref(msg[2])
-            if tracked is not None:
-                tracked[msg[2]] = tracked.get(msg[2], 0) + 1
+            tracked[msg[2]] = tracked.get(msg[2], 0) + 1
         else:
             self._decref_local(msg[2])
-            if tracked is not None:
-                c = tracked.get(msg[2], 0) - 1
-                if c > 0:
-                    tracked[msg[2]] = c
-                else:
-                    tracked.pop(msg[2], None)
+            c = tracked.get(msg[2], 0) - 1
+            if c > 0:
+                tracked[msg[2]] = c
+            else:
+                tracked.pop(msg[2], None)
 
     def _handle_msg(self, wid: str, msg: tuple) -> None:
         kind = msg[0]
@@ -2902,6 +3157,7 @@ class Runtime:
                 else:
                     self._daemon_send(node, ("delete_object", oid))
                     return
+                self._obj_event(oid, "transfer", size, node)
                 # Unpark staggered pullers: the source set just grew
                 # (deferred callbacks run after the lock drops).
                 deferred = self.pubsub.publish("object_copied", oid, oid)
@@ -2984,6 +3240,11 @@ class Runtime:
             # latest wins per sender; the head's telemetry tick folds the
             # aggregate into the time-series rings.
             self.telemetry.ingest(wid, msg[1])
+        elif kind == "refs_push":
+            # Periodic per-process live-ref table (refs.py snapshot_refs):
+            # the worker leg of the object ledger — droppable, latest wins
+            # per sender, joined with the owner tables by memory_summary.
+            self.ledger.ingest(wid, msg[1])
         elif kind == "wire_stats":
             # Per-process wire counters reported by workers/drivers when
             # RAY_TPU_WIRE_STATS=1 (keyed by sender; cluster_metrics sums
@@ -3046,6 +3307,11 @@ class Runtime:
                 if not self.store.is_ready(oid):
                     self._store_contained(oid, contained)
                     self._put_packed(oid, packed)
+                    self._note_object(oid, wid)
+                    self._obj_event(oid, "seal", len(packed))
+                    from ray_tpu._private import telemetry as _tele
+
+                    _tele.count_copy("promote", len(packed))
                     self.store.add_ref(oid)
                     self._object_ready(oid)
         elif kind == "promote_error":
@@ -3067,6 +3333,8 @@ class Runtime:
                     self._record_sealed(wid, oid, data)
                 else:
                     self._put_packed(oid, data)
+                    self._note_object(oid, wid)
+                    self._obj_event(oid, "seal", len(data))
                 self._object_ready(oid)
         elif kind == "req":
             req_id, op, payload = msg[1], msg[2], msg[3]
@@ -3283,6 +3551,37 @@ class Runtime:
             return self.telemetry.summary()
         if op == "telemetry_series":
             return self.telemetry.series_snapshot(payload)
+        if op == "memory_summary":
+            # Object-ledger join for `ray_tpu memory` / /api/memory from
+            # an attached client: same answer the head-local API gives.
+            return self.memory_summary(**(payload or {}))
+        if op == "list_object_refs":
+            return self.memory_records(limit=(payload or {}).get("limit"))
+        if op == "get_logs_all":
+            return self.get_logs_all(payload)
+        if op == "state_list":
+            # Attachable state API (util/state.py): --address clients and
+            # the dashboard route list_* verbs here and get the head's
+            # answers instead of requiring an in-process runtime.
+            verb, kwargs = payload
+            from ray_tpu.util import state as _state_api
+
+            fns = {
+                "tasks": _state_api.list_tasks,
+                "actors": _state_api.list_actors,
+                "objects": _state_api.list_objects,
+                "nodes": _state_api.list_nodes,
+                "workers": _state_api.list_workers,
+                "placement_groups": _state_api.list_placement_groups,
+                "cluster_events": _state_api.list_cluster_events,
+                "summarize_tasks": _state_api.summarize_tasks,
+                "cluster_metrics": _state_api.cluster_metrics,
+                "spans": _state_api.list_spans,
+            }
+            fn = fns.get(verb)
+            if fn is None:
+                raise ValueError(f"unknown state verb {verb!r}")
+            return fn(**(kwargs or {}))
         if op == "timeline":
             # Merged chrome-trace timeline (`ray_tpu timeline` from an
             # attached driver): task rows + clock-corrected spans from
@@ -3682,6 +3981,8 @@ class Runtime:
         node = self._worker_node(wid)
         with self.lock:
             self.object_sizes[oid] = size
+        self._note_object(oid, wid)
+        self._obj_event(oid, "seal", size, node)
         if node == self.head_node_id:
             self.store.mark_shm_sealed(oid, size)
             return
@@ -4135,6 +4436,15 @@ class Runtime:
                 self._return_worker(h)
         for oid in ready_ids:
             self._object_ready(oid)
+        if spec.is_actor_creation:
+            # The creation return (always None, or the creation error) has
+            # no ObjectRef holder anywhere — create_actor hands back the
+            # actor ID, not a ref — so the stored bytes were orphaned at
+            # refcount 0 forever.  Surfaced by the object ledger (every
+            # actor left a no-live-holder suspect); freed here at the
+            # source instead of exempted in the report.
+            for oid in spec.return_ids():
+                self.store.remove_ref(oid)
         self._dispatch()
 
     def _retry_task(self, rec: TaskRecord, h: Optional[WorkerHandle]) -> None:
@@ -4295,6 +4605,25 @@ class Runtime:
         # Telemetry: a dead process's gauges (queue depths) must not keep
         # contributing to the cluster aggregate (its own lock; no I/O).
         self.telemetry.forget(wid)
+        self.ledger.forget(wid)
+        # Ref borrows the dead process still held: park them as DEAD-
+        # HOLDER leak suspects (attributed to this worker's node/pid by
+        # `ray_tpu memory --leaks`), reclaimed after the grace so the
+        # bytes don't stay pinned forever (ray: the owner releases a dead
+        # borrower's references the same way).
+        dead_refs = self.worker_refs.pop(wid, None)
+        if dead_refs:
+            from ray_tpu._private import config as _cfg_leak
+
+            hh = self.workers.get(wid)
+            self._dead_refs[wid] = {
+                "refs": dead_refs,
+                "node": hh.node_id if hh is not None else None,
+                "pid": hh.pid if hh is not None else None,
+                "t": time.time(),
+                "reclaim_at": time.monotonic()
+                + _cfg_leak.get("leak_reclaim_grace_s"),
+            }
         self.clock_offsets.pop(wid, None)
         # Lease-dispatched tasks running ON this worker die with it; their
         # executors can never send the terminal event that would clear the
@@ -4528,6 +4857,8 @@ class Runtime:
         size = self.store._in_shm.get(oid)
         if size:
             self.object_sizes[oid] = size  # locality scoring weight
+        self._note_object(oid, "driver")
+        self._obj_event(oid, "create", size)
         self._store_contained(oid, contained)
         self._object_ready(oid)
         return ObjectRef(oid)

@@ -402,7 +402,20 @@ class OwnerStore:
             target=self._reclaim_loop, daemon=True, name="raytpu-spill"
         )
         self._destroyed = False
+        # Object lifecycle observer (runtime._on_store_lifecycle): called
+        # as hook(oid, event, nbytes) on spill/restore/free so the ledger's
+        # event ring and the chrome timeline see store transitions.  MUST
+        # stay lock-light — _free fires it under self._lock.
+        self.on_lifecycle = None
         self._reclaim_thread.start()
+
+    def _lifecycle(self, object_id: str, event: str, nbytes) -> None:
+        hook = self.on_lifecycle
+        if hook is not None:
+            try:
+                hook(object_id, event, nbytes)
+            except Exception:
+                pass
 
     # -- refcounting ---------------------------------------------------------
 
@@ -459,6 +472,7 @@ class OwnerStore:
         return self._refcount.get(object_id, 0)
 
     def _free(self, object_id: str) -> None:
+        had = object_id in self._mem or object_id in self._spilled
         self._mem.pop(object_id, None)
         size = self._in_shm.pop(object_id, None)
         if size is not None:
@@ -471,6 +485,8 @@ class OwnerStore:
         self._ready.pop(object_id, None)
         self._errors.pop(object_id, None)
         self._last_access.pop(object_id, None)
+        if had or size is not None:
+            self._lifecycle(object_id, "free", size)
 
     # -- put / seal ----------------------------------------------------------
 
@@ -589,6 +605,9 @@ class OwnerStore:
                 self._reserved -= size
                 self._account_shm(object_id, size)
                 self._touch(object_id)
+            from ray_tpu._private import telemetry as _telemetry
+
+            _telemetry.count_copy("put", size)
         else:
             obj = SealedObject(payload, [b.raw() for b in buffers])
             with self._lock:
@@ -728,6 +747,9 @@ class OwnerStore:
         with self._lock:
             self._account_shm(object_id, total)
             self._touch(object_id)
+        from ray_tpu._private import telemetry as _telemetry
+
+        _telemetry.count_copy("pull", total)
         self._mark_ready(object_id)
 
     def ingest_stream(self, object_id: str, total: int, fill) -> None:
@@ -737,6 +759,9 @@ class OwnerStore:
         with self._lock:
             self._account_shm(object_id, total)
             self._touch(object_id)
+        from ray_tpu._private import telemetry as _telemetry
+
+        _telemetry.count_copy("pull", total)
         self._mark_ready(object_id)
 
     def has_local(self, object_id: str) -> bool:
@@ -779,6 +804,10 @@ class OwnerStore:
             self._spilled[object_id] = locator
             self._shm_bytes -= size
             self.shm.delete(object_id)
+        from ray_tpu._private import telemetry as _telemetry
+
+        _telemetry.count_copy("spill", size)
+        self._lifecycle(object_id, "spill", size)
         return locator
 
     def _restore(self, object_id: str, path: str) -> None:
@@ -794,10 +823,31 @@ class OwnerStore:
             self._spilled.pop(object_id, None)
             self._touch(object_id)
         self._spill_storage.delete(path)
+        from ray_tpu._private import telemetry as _telemetry
+
+        _telemetry.count_copy("restore", len(data))
+        self._lifecycle(object_id, "restore", len(data))
 
     def shm_usage(self) -> int:
         with self._lock:
             return self._shm_bytes
+
+    def snapshot_table(self):
+        """One consistent read of the owner tables for the object ledger:
+        ({oid: (location, size|None)}, {oid: refcount}, {oid: ready}).
+        Spilled sizes are None here — the runtime's object_sizes map
+        retains the packed size across the spill."""
+        with self._lock:
+            table: Dict[str, Tuple[str, Optional[int]]] = {}
+            for oid, obj in self._mem.items():
+                table[oid] = ("memory", obj.size)
+            for oid, size in self._in_shm.items():
+                table[oid] = ("shm", size)
+            for oid in self._spilled:
+                table[oid] = ("spilled", None)
+            for oid in self._errors:
+                table.setdefault(oid, ("error", None))
+            return table, dict(self._refcount), dict(self._ready)
 
     def destroy(self) -> None:
         self._destroyed = True

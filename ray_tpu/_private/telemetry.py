@@ -433,6 +433,352 @@ def prometheus_cluster_text(
     return "\n".join(lines) + "\n"
 
 
+# ---------------------------------------------------------------------------
+# object/memory introspection plane: bytes-per-copy counters + the ledger
+
+def _copy_counters():
+    """Process-wide bytes-per-copy counters, created lazily (module import
+    runs before config/metric setup in some entrypoints).  Every byte-
+    moving path of the object plane increments these: put/seal (create a
+    sealed copy), pull (a transfer-plane copy), spill/restore (disk round
+    trips), promote (inline bytes uploaded to the head).  ray_perf's
+    put/broadcast shapes report bytes-per-copy off the deltas; the cluster
+    aggregate sums every process's counts via the metrics push."""
+    global _OBJ_COPIES, _OBJ_COPY_BYTES
+    if _OBJ_COPIES is None:
+        from ray_tpu.util.metrics import Counter
+
+        _OBJ_COPIES = Counter(
+            "object_copies",
+            "sealed-copy operations by object-plane path",
+            tag_keys=("path",),
+        )
+        _OBJ_COPY_BYTES = Counter(
+            "object_copy_bytes",
+            "bytes moved per object-plane copy path",
+            tag_keys=("path",),
+        )
+    return _OBJ_COPIES, _OBJ_COPY_BYTES
+
+
+_OBJ_COPIES = None
+_OBJ_COPY_BYTES = None
+
+
+def count_copy(path: str, nbytes: int) -> None:
+    """Record one object-plane copy of nbytes via `path` (put/seal/pull/
+    spill/restore/promote).  Never raises — called from store/transfer hot
+    paths, sometimes under their locks."""
+    try:
+        copies, by = _copy_counters()
+        copies.inc(tags={"path": path})
+        if nbytes:
+            by.inc(nbytes, tags={"path": path})
+    except Exception:
+        pass
+
+
+def copy_counter_snapshot() -> Dict[str, Dict[str, float]]:
+    """{path: {copies, bytes}} from this process's counters (ray_perf
+    reads deltas of this around a timed shape)."""
+    out: Dict[str, Dict[str, float]] = {}
+    try:
+        copies, by = _copy_counters()
+        for k, v in copies.snapshot().items():
+            path = dict(k).get("path", "?")
+            out.setdefault(path, {"copies": 0.0, "bytes": 0.0})["copies"] = v
+        for k, v in by.snapshot().items():
+            path = dict(k).get("path", "?")
+            out.setdefault(path, {"copies": 0.0, "bytes": 0.0})["bytes"] = v
+    except Exception:
+        pass
+    return out
+
+
+_LEDGER_GAUGES = None
+
+
+def ledger_gauges():
+    """Prometheus-facing gauges the head sets from its ledger tick:
+    per-node store/spilled bytes and per-node leak-suspect bytes.  Lazy —
+    only the process that sets them registers them."""
+    global _LEDGER_GAUGES
+    if _LEDGER_GAUGES is None:
+        from ray_tpu.util.metrics import Gauge
+
+        _LEDGER_GAUGES = (
+            Gauge(
+                "object_ledger_node_bytes",
+                "sealed object bytes per node and tier (store/spilled), "
+                "from the head's object-ledger join",
+                tag_keys=("node", "tier"),
+            ),
+            Gauge(
+                "object_ledger_leak_suspect_bytes",
+                "bytes attributed to object-ledger leak suspects, by the "
+                "holding (or owning) node",
+                tag_keys=("node",),
+            ),
+        )
+    return _LEDGER_GAUGES
+
+
+class ObjectLedger:
+    """Head-side sink for pushed per-process live-ref tables (refs_push),
+    the worker leg of cluster memory introspection.  Mirrors TelemetrySink:
+    latest snapshot per sender, forgotten when the process dies.  The
+    authoritative owner-side join (store tables + object directory + conn-
+    tracked borrows) happens in build_memory_records — this class only
+    carries what remote processes report about themselves (in-process
+    counts, owned flags, creation sites)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.tables: Dict[str, Dict] = {}
+
+    def ingest(self, key: str, snap: Dict) -> None:
+        if not isinstance(snap, dict):
+            return
+        with self._lock:
+            while len(self.tables) >= 4096:
+                self.tables.pop(next(iter(self.tables)))
+            self.tables[key] = snap
+
+    def forget(self, key: str) -> None:
+        with self._lock:
+            self.tables.pop(key, None)
+
+    def snapshot(self) -> Dict[str, Dict]:
+        with self._lock:
+            return dict(self.tables)
+
+
+def build_memory_records(
+    store_table: Dict[str, Tuple[str, Optional[int]]],
+    refcounts: Dict[str, int],
+    ready: Dict[str, bool],
+    locations: Dict[str, List[str]],
+    sizes: Dict[str, int],
+    meta: Dict[str, Tuple[float, str]],
+    conn_refs: Dict[str, Dict[str, int]],
+    pushed_tables: Dict[str, Dict],
+    dead_refs: Dict[str, Dict],
+    proc_info: Dict[str, Tuple[Optional[str], Optional[int]]],
+    now: float,
+    leak_age_s: float,
+) -> List[Dict[str, Any]]:
+    """Join the owner's view of every object with the holder-side ref
+    tables into per-object ledger records (pure — unit-testable without a
+    cluster).
+
+      store_table    oid -> (location, size|None) from OwnerStore (head
+                     bytes: memory/shm/spilled/error)
+      refcounts      owner-side refcount per oid
+      locations      oid -> [node ids] holding sealed remote copies
+      sizes          oid -> packed size (survives spill)
+      meta           oid -> (created_ts, creator proc label)
+      conn_refs      holder key -> {oid: outstanding conn-tracked borrows}
+                     (workers via refop tracking, drivers via driver_refs,
+                     "head" for the head process's own live-ref table)
+      pushed_tables  holder key -> refs_push snapshot ({"refs": {oid:
+                     [count, site]}, ...}) — enrichment (sites, owned)
+      dead_refs      crashed holder key -> {"refs", "node", "pid", ...}:
+                     borrows awaiting reclaim — their objects are the
+                     DEAD-HOLDER leak suspects
+      proc_info      holder key -> (node, pid) for live holders
+
+    Leak rules (SURVEY §2.1's debugging story):
+      * dead-holder — bytes still held by a crashed process's unreclaimed
+        borrows (clears when the reclaim sweep drops them);
+      * no-live-holder — located bytes, refcount 0, no holder anywhere,
+        older than leak_age_s (outside the seal-to-first-addref window).
+    """
+    oids = set(store_table) | set(locations) | set(refcounts)
+    pushed_refs: Dict[str, Dict] = {}
+    for key, snap in pushed_tables.items():
+        refs = snap.get("refs") if isinstance(snap, dict) else None
+        if refs:
+            pushed_refs[key] = refs
+            oids.update(refs)
+    for rec in dead_refs.values():
+        oids.update(rec.get("refs", ()))
+
+    records: List[Dict[str, Any]] = []
+    for oid in oids:
+        loc, size = store_table.get(oid, (None, None))
+        if size is None:
+            size = sizes.get(oid)
+        copies = list(locations.get(oid, ()))
+        if loc in ("memory", "shm", "spilled"):
+            copies = ["head"] + copies
+        if loc is None:
+            loc = "remote" if locations.get(oid) else "worker-local"
+        holders: List[Dict[str, Any]] = []
+        for key, table in conn_refs.items():
+            n = table.get(oid)
+            if not n:
+                continue
+            node, pid = proc_info.get(key, (None, None))
+            pushed = pushed_refs.get(key, {}).get(oid)
+            holders.append(
+                {
+                    "holder": key,
+                    "node": node,
+                    "pid": pid,
+                    "count": n,
+                    "site": pushed[1] if pushed else None,
+                    "owned": bool(pushed[2]) if pushed and len(pushed) > 2 else False,
+                    "pinned": bool(pushed[3]) if pushed and len(pushed) > 3 else False,
+                    "dead": False,
+                }
+            )
+        seen = {h["holder"] for h in holders}
+        for key, refs in pushed_refs.items():
+            # Processes whose borrows are not conn-tracked (e.g. owned
+            # direct-call results that never escaped) still show as
+            # holders via their pushed table.
+            if key in seen or oid not in refs:
+                continue
+            node, pid = proc_info.get(key, (None, None))
+            rec = refs[oid]
+            holders.append(
+                {
+                    "holder": key,
+                    "node": node,
+                    "pid": pid,
+                    "count": rec[0],
+                    "site": rec[1],
+                    "owned": bool(rec[2]) if len(rec) > 2 else False,
+                    "pinned": bool(rec[3]) if len(rec) > 3 else False,
+                    "dead": False,
+                }
+            )
+        leak = None
+        for key, rec in dead_refs.items():
+            n = rec.get("refs", {}).get(oid)
+            if n:
+                holders.append(
+                    {
+                        "holder": key,
+                        "node": rec.get("node"),
+                        "pid": rec.get("pid"),
+                        "count": n,
+                        "site": None,
+                        "owned": False,
+                        "pinned": False,
+                        "dead": True,
+                    }
+                )
+                # Only a suspect while the owner still accounts the
+                # object (bytes or count) — a freed oid lingering in the
+                # dead set until the sweep is not a leak.
+                if (
+                    refcounts.get(oid, 0) > 0
+                    or oid in store_table
+                    or locations.get(oid)
+                ):
+                    leak = "dead-holder"
+        created, creator = meta.get(oid, (None, None))
+        age = round(now - created, 3) if created else None
+        has_bytes = loc in ("memory", "shm", "spilled") or bool(
+            locations.get(oid)
+        )
+        if (
+            leak is None
+            and has_bytes
+            and refcounts.get(oid, 0) == 0
+            and not holders
+            and ready.get(oid, False)
+            and (age is None or age > leak_age_s)
+        ):
+            leak = "no-live-holder"
+        records.append(
+            {
+                "object_id": oid,
+                "location": loc,
+                "size_bytes": size,
+                "copies": copies,
+                "refcount": refcounts.get(oid, 0),
+                "ready": bool(ready.get(oid, False)),
+                "holders": holders,
+                "holder_count": sum(h["count"] for h in holders),
+                "age_s": age,
+                "creator": creator,
+                "site": next(
+                    (h["site"] for h in holders if h["site"]), None
+                ),
+                "leak": leak,
+            }
+        )
+    records.sort(key=lambda r: -(r["size_bytes"] or 0))
+    return records
+
+
+def summarize_memory_records(
+    records: List[Dict[str, Any]],
+    group_by: Optional[str] = None,
+    top: int = 20,
+) -> Dict[str, Any]:
+    """Aggregations over ledger records: per-node bytes, top-N objects,
+    leak suspects, optional group-by (node|owner|callsite) — the body of
+    `ray_tpu memory`, util/state.memory_summary and /api/memory."""
+    nodes: Dict[str, Dict[str, float]] = {}
+    total = 0
+    spilled = 0
+    for r in records:
+        size = r["size_bytes"] or 0
+        total += size
+        for node in r["copies"] or (
+            [h["node"] or "?" for h in r["holders"]][:1] or ["?"]
+        ):
+            rec = nodes.setdefault(
+                node, {"store_bytes": 0, "spilled_bytes": 0, "objects": 0}
+            )
+            rec["objects"] += 1
+            if r["location"] == "spilled" and node == "head":
+                rec["spilled_bytes"] += size
+                spilled += size
+            else:
+                rec["store_bytes"] += size
+    leaks = [r for r in records if r["leak"]]
+    out: Dict[str, Any] = {
+        "objects": len(records),
+        "bytes_total": total,
+        "spilled_bytes": spilled,
+        "nodes": nodes,
+        "top": records[: max(top, 0)],
+        "leak_suspects": len(leaks),
+        "leak_suspect_bytes": sum(r["size_bytes"] or 0 for r in leaks),
+        "leaks": leaks,
+    }
+    if group_by:
+        groups: Dict[str, Dict[str, float]] = {}
+
+        def keys_for(r) -> List[str]:
+            if group_by == "node":
+                return [str(k) for k in (r["copies"] or ["?"])]
+            if group_by == "owner":
+                return [str(r["creator"] or "?")]
+            if group_by == "callsite":
+                sites = {h["site"] for h in r["holders"] if h["site"]}
+                if r["site"]:
+                    sites.add(r["site"])
+                return [str(s) for s in (sites or {"?"})]
+            raise ValueError(
+                f"unknown group_by {group_by!r} (node|owner|callsite)"
+            )
+
+        for r in records:
+            for k in keys_for(r):
+                g = groups.setdefault(k, {"objects": 0, "bytes": 0})
+                g["objects"] += 1
+                g["bytes"] += r["size_bytes"] or 0
+        out["groups"] = dict(
+            sorted(groups.items(), key=lambda kv: -kv[1]["bytes"])
+        )
+    return out
+
+
 def _reset_for_tests() -> None:
     global _ring, _last_push_wire
     with _ring_lock:

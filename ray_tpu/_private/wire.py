@@ -123,6 +123,10 @@ SCHEMAS: Dict[str, Tuple[int, Optional[int], tuple]] = {
     # wire counters + internal gauges) — droppable oneway, aggregated
     # into the head's TelemetrySink (telemetry.py).
     "metrics_push": (1, 1, (dict,)),
+    # Periodic per-process live-ref table (refs.py snapshot + transport
+    # ownership) — the worker leg of the object ledger (`ray_tpu memory`),
+    # droppable like metrics_push.
+    "refs_push": (1, 1, (dict,)),
     # head io-shard fabric (io_shard.py): the internal channel between the
     # head process and its io-shard processes.  shard_fwd carries a conn's
     # decoded control messages IN ORDER (the list is the order they came

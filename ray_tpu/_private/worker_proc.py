@@ -382,6 +382,38 @@ class WorkerRuntime:
             return
         self.oneway(("refop", "del", oid))
 
+    def ref_table_snapshot(self) -> dict:
+        """This process's live-ref table (refs.py) with direct-transport
+        ownership folded in — the refs_push payload (the worker leg of the
+        cluster object ledger, telemetry.py ObjectLedger)."""
+        import time as _time
+
+        from ray_tpu._private import refs as refs_mod
+
+        snap = refs_mod.snapshot_refs()
+        owned: set = set()
+        pinned: set = set()
+        if self.direct is not None:
+            with self.direct.lock:
+                owned = set(self.direct.counts)
+                pinned = {
+                    oid
+                    for oid, dr in self.direct.results.items()
+                    if dr.event.is_set()
+                }
+        refs = {}
+        for oid, rec in snap["refs"].items():
+            refs[oid] = [rec[0], rec[1], oid in owned, oid in pinned]
+        for oid in owned - set(refs):
+            # Owned results whose caller-side ObjectRef is pre-counted
+            # (constructed with _count=False before the table existed, or
+            # held only by the transport cache) still belong in the table.
+            refs[oid] = [1, None, True, oid in pinned]
+        snap["refs"] = refs
+        snap["pid"] = os.getpid()
+        snap["t"] = _time.time()
+        return snap
+
     def note_escaped(self, contained) -> None:
         """Serialize-time hook: any locally-owned direct result leaving this
         process must become visible to the head (promotion) so remote
@@ -495,6 +527,9 @@ class WorkerRuntime:
             )
             if n is None:
                 return None
+            from ray_tpu._private import telemetry as _telemetry
+
+            _telemetry.count_copy("pull", n)
             # Report the new copy (with its packed size) so the directory
             # serves this node locally from now on, deletes the copy when
             # the object is freed, and — for head-node workers — enters it
@@ -517,6 +552,9 @@ class WorkerRuntime:
         oid = _ids.object_id()
         if size >= inline_threshold() and not self.force_inline_puts:
             packed = self.shm.create(oid, payload, buffers)
+            from ray_tpu._private import telemetry as _telemetry
+
+            _telemetry.count_copy("seal", packed)
             self.oneway(("seal_ow", oid, packed, contained))
         else:
             self.oneway(("put_ow", oid, bytes(ser.pack(payload, buffers)), contained))
@@ -616,6 +654,9 @@ def _store_results(rt: WorkerRuntime, spec: TaskSpec, out) -> list:
             size = len(payload) + sum(len(b.raw()) for b in buffers)
             if size >= inline_threshold():
                 packed = rt.shm.create(oid, payload, buffers)
+                from ray_tpu._private import telemetry as _telemetry
+
+                _telemetry.count_copy("seal", packed)
                 results.append((oid, "shm", packed, contained))
             else:
                 results.append(
@@ -914,6 +955,7 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
 
         report_wire = bool(_cfg2.get("wire_stats"))
         push_s = max(_cfg2.get("metrics_push_ms"), 0) / 1000.0
+        push_refs = bool(_cfg2.get("refs_push"))
         last_push = 0.0
         while True:
             _time.sleep(0.5)
@@ -936,6 +978,14 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
                     ("metrics_push", _telemetry.snapshot_process()),
                     droppable=True,
                 )
+                if push_refs:
+                    # Live-ref table push (the worker leg of the object
+                    # ledger): same tick, same droppable contract — it
+                    # never competes with seals/refops for the backlog.
+                    rt.oneway(
+                        ("refs_push", rt.ref_table_snapshot()),
+                        droppable=True,
+                    )
             # Telemetry rides the next linger/idle flush; nudge it here so
             # a fully-busy executor still reports within a beat.
             wire.flush_dirty()

@@ -54,6 +54,25 @@ def timeline() -> list:
     from ray_tpu.util.tracing import spans_to_chrome_trace
 
     out.extend(spans_to_chrome_trace(list_spans()))
+    # Object lifecycle events (create/seal/transfer/spill/restore/free)
+    # merge as instant events on a per-node "objects" row, so byte
+    # movement lines up with the task rows that caused it.
+    for ev in list(getattr(rt, "object_events", ())):
+        out.append(
+            {
+                "name": f"obj:{ev['event']}",
+                "cat": "object",
+                "ph": "i",
+                "s": "p",
+                "ts": int(ev["t"] * 1e6),
+                "pid": ev.get("node") or "head",
+                "tid": "objects",
+                "args": {
+                    "object_id": ev["oid"],
+                    "bytes": ev.get("bytes"),
+                },
+            }
+        )
     return out
 
 
@@ -83,6 +102,31 @@ def _telemetry_endpoint(query=None):
     if series is not None:
         return state_api.telemetry_series(series)
     return state_api.telemetry_summary()
+
+
+def _memory_endpoint(query=None):
+    """Object-ledger join (util/state.memory_summary): ?group_by=node|
+    owner|callsite, ?leaks=1 trims to the suspects, ?top=N, ?events=1
+    appends the lifecycle ring."""
+    from ray_tpu.util import state as state_api
+
+    q = query or {}
+    try:
+        top = int((q.get("top") or [20])[0])
+    except ValueError:
+        top = 20
+    out = state_api.memory_summary(
+        group_by=(q.get("group_by") or [None])[0],
+        top=top,
+        include_events=(q.get("events") or ["0"])[0] not in ("0", ""),
+    )
+    if (q.get("leaks") or ["0"])[0] not in ("0", ""):
+        out = {
+            "leak_suspects": out["leak_suspects"],
+            "leak_suspect_bytes": out["leak_suspect_bytes"],
+            "leaks": out["leaks"],
+        }
+    return out
 
 
 def _logs_endpoint(worker=None, tail: int = 0, query=None):
@@ -118,6 +162,7 @@ class Dashboard:
             "/api/logs": _logs_endpoint,
             "/api/events": _events_endpoint,
             "/api/telemetry": _telemetry_endpoint,
+            "/api/memory": _memory_endpoint,
         }
 
         def _prometheus() -> str:
@@ -243,7 +288,8 @@ _INDEX_HTML = """<!doctype html>
 <code>/api/actors</code> <code>/api/objects</code> <code>/api/workers</code>
 <code>/api/placement_groups</code> <code>/api/metrics</code>
 <code>/api/summary</code> <code>/api/timeline</code> <code>/api/logs</code>
-<code>/api/telemetry</code> <code>/metrics</code> (Prometheus)</p>
+<code>/api/telemetry</code> <code>/api/memory</code>
+<code>/metrics</code> (Prometheus)</p>
 <script>
 function row(cells, tag){const tr=document.createElement('tr');
  for(const c of cells){const td=document.createElement(tag||'td');

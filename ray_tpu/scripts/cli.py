@@ -103,6 +103,46 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def cmd_memory(args) -> int:
+    """`ray_tpu memory`: the cluster object ledger — per-node bytes, top
+    objects with holder attribution, leak suspects (`--leaks`), group-by
+    node|owner|callsite (ray: `ray memory`).  Attachable: --address gets
+    the head's join over the request plane."""
+    from ray_tpu.util import state as state_api
+
+    _init_maybe_attached(args)
+    out = state_api.memory_summary(
+        group_by=args.group_by, top=args.top, include_events=args.events
+    )
+    if args.leaks:
+        out = {
+            "leak_suspects": out["leak_suspects"],
+            "leak_suspect_bytes": out["leak_suspect_bytes"],
+            "leaks": [
+                {
+                    "object_id": r["object_id"],
+                    "size_bytes": r["size_bytes"],
+                    "location": r["location"],
+                    "reason": r["leak"],
+                    "holders": [
+                        {
+                            "holder": h["holder"],
+                            "node": h["node"],
+                            "pid": h["pid"],
+                            "count": h["count"],
+                            "dead": h["dead"],
+                        }
+                        for h in r["holders"]
+                    ],
+                    "age_s": r["age_s"],
+                }
+                for r in out["leaks"]
+            ],
+        }
+    print(json.dumps(out, indent=1, default=str))
+    return 0
+
+
 def cmd_timeline(args) -> int:
     from ray_tpu.dashboard import timeline
 
@@ -132,7 +172,9 @@ def cmd_job_submit(args) -> int:
 
 def cmd_logs(args) -> int:
     """Dump a worker's captured stdout/stderr lines (ray: `ray logs`).
-    With --actor, resolve the named actor's current worker first."""
+    With --actor, resolve the named actor's current worker first; with
+    --all, aggregate the tail across EVERY worker with node/pid line
+    prefixes (attachable — reuses the head request plane)."""
     import ray_tpu
     from ray_tpu._private.worker_proc import get_worker_runtime
 
@@ -140,6 +182,20 @@ def cmd_logs(args) -> int:
         ignore_reinit_error=True,
         address=args.address if getattr(args, "address", None) else None,
     )
+    if args.all:
+        wr = get_worker_runtime()
+        if wr is not None:  # attached driver: ask the head
+            per_worker = wr.request("get_logs_all", args.tail or None)
+        else:
+            from ray_tpu._private.runtime import get_runtime
+
+            per_worker = get_runtime().get_logs_all(args.tail or None)
+        for wid in sorted(per_worker):
+            rec = per_worker[wid]
+            prefix = f"[{rec.get('node') or '?'}/{rec.get('pid') or wid}]"
+            for line in rec["lines"]:
+                sys.stdout.write(f"{prefix} {line}\n")
+        return 0
     wid = args.worker
     if args.actor:
         from ray_tpu._private.runtime import get_runtime
@@ -300,6 +356,24 @@ def main(argv=None) -> int:
     me.add_argument("--address", help="head.json path or ray:// URL (attached mode)")
     me.set_defaults(fn=cmd_metrics)
 
+    mm = sub.add_parser(
+        "memory", help="cluster object ledger: bytes, holders, leak suspects"
+    )
+    mm.add_argument(
+        "--group-by", choices=("node", "owner", "callsite"), default=None
+    )
+    mm.add_argument(
+        "--leaks", action="store_true",
+        help="only leak suspects, with holder node/pid attribution",
+    )
+    mm.add_argument("--top", type=int, default=20, help="top-N objects by size")
+    mm.add_argument(
+        "--events", action="store_true",
+        help="include the recent object lifecycle event ring",
+    )
+    mm.add_argument("--address", help="head.json path or ray:// URL (attached mode)")
+    mm.set_defaults(fn=cmd_memory)
+
     tl = sub.add_parser(
         "timeline", help="export the merged chrome-trace cluster timeline"
     )
@@ -315,6 +389,10 @@ def main(argv=None) -> int:
     lg = sub.add_parser("logs", help="dump a worker's captured output")
     lg.add_argument("worker", nargs="?", help="worker id")
     lg.add_argument("--actor", help="named actor: dump its worker's logs")
+    lg.add_argument(
+        "--all", action="store_true",
+        help="aggregate tail across every worker, node/pid-prefixed",
+    )
     lg.add_argument("--tail", type=int, default=0, help="last N lines only")
     lg.add_argument("--address", help="head.json path (attached mode)")
     lg.set_defaults(fn=cmd_logs)

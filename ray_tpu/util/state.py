@@ -18,8 +18,26 @@ def _rt():
     return get_runtime()
 
 
+def _attached_request(verb: str, kwargs: Optional[Dict[str, Any]] = None):
+    """Route a state verb through the head's `state_list` request op when
+    this process is an attached client (worker / --address driver), so
+    every list_* answer matches what the head itself would say.  Returns
+    (result, True) when routed, (None, False) when head-local."""
+    from ray_tpu._private.worker_proc import get_worker_runtime
+
+    wr = get_worker_runtime()
+    if wr is None:
+        return None, False
+    return wr.request("state_list", (verb, kwargs or {})), True
+
+
 def list_tasks(*, include_finished: bool = True, limit: int = 1000) -> List[Dict[str, Any]]:
     """Live tasks (PENDING/READY/RUNNING) + bounded finished history."""
+    out, routed = _attached_request(
+        "tasks", {"include_finished": include_finished, "limit": limit}
+    )
+    if routed:
+        return out
     rt = _rt()
     out: List[Dict[str, Any]] = []
     with rt.lock:
@@ -47,6 +65,9 @@ def list_tasks(*, include_finished: bool = True, limit: int = 1000) -> List[Dict
 def list_spans(limit: int = 1000) -> List[Dict[str, Any]]:
     """Trace spans (util/tracing.py): worker spans arrive via the batched
     flush; the driver/head process's own buffer is folded in here."""
+    out, routed = _attached_request("spans", {"limit": limit})
+    if routed:
+        return out
     from ray_tpu.util import tracing
 
     rt = _rt()
@@ -57,6 +78,9 @@ def list_spans(limit: int = 1000) -> List[Dict[str, Any]]:
 
 
 def list_actors(limit: int = 1000) -> List[Dict[str, Any]]:
+    res, routed = _attached_request("actors", {"limit": limit})
+    if routed:
+        return res
     rt = _rt()
     out = []
     with rt.lock:
@@ -78,6 +102,9 @@ def list_actors(limit: int = 1000) -> List[Dict[str, Any]]:
 
 def list_objects(limit: int = 1000) -> List[Dict[str, Any]]:
     """Owner-store view: every live object with location + refcount."""
+    res, routed = _attached_request("objects", {"limit": limit})
+    if routed:
+        return res
     rt = _rt()
     store = rt.store
     out = []
@@ -102,6 +129,9 @@ def list_objects(limit: int = 1000) -> List[Dict[str, Any]]:
 
 
 def list_nodes() -> List[Dict[str, Any]]:
+    res, routed = _attached_request("nodes")
+    if routed:
+        return res
     rt = _rt()
     with rt.state.lock:
         return [
@@ -119,6 +149,9 @@ def list_nodes() -> List[Dict[str, Any]]:
 
 
 def list_workers() -> List[Dict[str, Any]]:
+    res, routed = _attached_request("workers")
+    if routed:
+        return res
     rt = _rt()
     with rt.lock:
         return [
@@ -135,6 +168,9 @@ def list_workers() -> List[Dict[str, Any]]:
 
 
 def list_placement_groups() -> List[Dict[str, Any]]:
+    res, routed = _attached_request("placement_groups")
+    if routed:
+        return res
     rt = _rt()
     with rt.state.lock:
         return [
@@ -151,6 +187,9 @@ def list_placement_groups() -> List[Dict[str, Any]]:
 
 def summarize_tasks() -> Dict[str, int]:
     """Count by state (ray: `ray summary tasks`)."""
+    res, routed = _attached_request("summarize_tasks")
+    if routed:
+        return res
     counts: Dict[str, int] = {}
     for t in list_tasks():
         counts[t["state"]] = counts.get(t["state"], 0) + 1
@@ -160,6 +199,9 @@ def summarize_tasks() -> Dict[str, int]:
 def cluster_metrics() -> Dict[str, float]:
     """Runtime counters + store gauges (ray: src/ray/stats/metric_defs.cc
     reduced to the load-bearing set)."""
+    res, routed = _attached_request("cluster_metrics")
+    if routed:
+        return res
     rt = _rt()
     with rt.lock:
         m = dict(rt.metrics)
@@ -201,6 +243,12 @@ def list_cluster_events(
     """Structured control-plane events — node/worker/actor transitions with
     severity + source (ray: `ray list cluster-events` over the event files,
     src/ray/util/event.h:102)."""
+    out, routed = _attached_request(
+        "cluster_events",
+        {"limit": limit, "severity": severity, "source": source},
+    )
+    if routed:
+        return out
     return _rt().events.recent(limit=limit, severity=severity, source=source)
 
 
@@ -210,6 +258,11 @@ def telemetry_summary() -> Dict[str, Any]:
     internal gauges (queue depths, journal counters, wire totals).
     Workers/daemons/drivers push on RAY_TPU_METRICS_PUSH_MS; the head
     folds its own registry in on the same tick (telemetry.py)."""
+    from ray_tpu._private.worker_proc import get_worker_runtime
+
+    wr = get_worker_runtime()
+    if wr is not None:
+        return wr.request("telemetry", None)
     rt = _rt()
     # Fold a fresh head snapshot in first: a CLI/driver read right after a
     # local metric record must see it without waiting out the tick.
@@ -221,4 +274,46 @@ def telemetry_series(name: Optional[str] = None) -> Dict[str, List]:
     """Bounded time series of the cluster aggregate, one ring per metric
     (the GcsTaskManager ring-storage idiom applied to metrics): [(t,
     value), ...] per name, RAY_TPU_TELEMETRY_RING_SAMPLES samples deep."""
+    from ray_tpu._private.worker_proc import get_worker_runtime
+
+    wr = get_worker_runtime()
+    if wr is not None:
+        return wr.request("telemetry_series", name)
     return _rt().telemetry.series_snapshot(name)
+
+
+def memory_summary(
+    group_by: Optional[str] = None,
+    top: int = 20,
+    include_events: bool = False,
+) -> Dict[str, Any]:
+    """Cluster memory introspection: the head's object ledger — per-node
+    store/spilled bytes, top-N objects by size, holder attribution (which
+    node/pid pins which bytes), leak suspects, and optional group-by
+    node|owner|callsite (callsites require RAY_TPU_REF_CALLSITE=1 in the
+    creating processes).  `ray_tpu memory` and /api/memory are thin
+    wrappers over this (ray: `ray memory` over the ReferenceCounter
+    tables, SURVEY §2.1)."""
+    from ray_tpu._private.worker_proc import get_worker_runtime
+
+    wr = get_worker_runtime()
+    payload = {
+        "group_by": group_by,
+        "top": top,
+        "include_events": include_events,
+    }
+    if wr is not None:
+        return wr.request("memory_summary", payload)
+    return _rt().memory_summary(**payload)
+
+
+def list_object_refs(limit: int = 1000) -> List[Dict[str, Any]]:
+    """Per-object ledger records: size, location, copies, owner refcount,
+    holders (process/node/pid/count/creation site), age, leak verdict —
+    the raw rows memory_summary aggregates."""
+    from ray_tpu._private.worker_proc import get_worker_runtime
+
+    wr = get_worker_runtime()
+    if wr is not None:
+        return wr.request("list_object_refs", {"limit": limit})
+    return _rt().memory_records(limit=limit)

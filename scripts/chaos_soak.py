@@ -607,6 +607,40 @@ def run_soak(
                 "post-storm cluster did not converge to correct results"
             )
 
+        # ---- memory introspection: the object ledger must CONVERGE to
+        # zero leak suspects after every kill the storm threw (worker
+        # crashes mid-hold leave dead-holder suspects; the reclaim sweep
+        # must clear them and free the bytes).  Polled: reclaim grace +
+        # final refs_push ticks need a beat to land.
+        from ray_tpu.util import state as state_api
+
+        mem = None
+        # Budget: worst-case orphan path is leak_age (10s) + orphan grace
+        # (20s) + push/tick lag before a drain-era orphan is reclaimed.
+        mem_deadline = time.monotonic() + 90
+        while time.monotonic() < mem_deadline:
+            try:
+                mem = state_api.memory_summary(top=0)
+            except Exception:
+                time.sleep(1.0)
+                continue
+            if mem["leak_suspects"] == 0:
+                break
+            time.sleep(1.0)
+        report["memory"] = {
+            "leak_suspects": mem["leak_suspects"] if mem else None,
+            "leak_suspect_bytes": mem["leak_suspect_bytes"] if mem else None,
+            "objects": mem["objects"] if mem else None,
+            "bytes_total": mem["bytes_total"] if mem else None,
+            "nodes": mem["nodes"] if mem else None,
+        }
+        assert mem is not None, "memory_summary unreachable after the storm"
+        assert mem["leak_suspects"] == 0, (
+            f"object ledger did not converge: {mem['leak_suspects']} leak "
+            f"suspects holding {mem['leak_suspect_bytes']} bytes after "
+            f"drain: {[r['object_id'] for r in mem['leaks']][:10]}"
+        )
+
         # ---- the ledger: executions within retry budgets, kills fired.
         counts = _count_log(log_path)
         head_kills = report["kills"]["head"]

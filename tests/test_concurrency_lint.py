@@ -523,6 +523,34 @@ def test_metric_names_flags_undeclared_tags(tmp_path):
     assert "'rout'" in msgs and "'replica'" in msgs and "'b'" in msgs
 
 
+def test_metric_names_flags_undeclared_ledger_tag(tmp_path):
+    """The object-ledger gauges declare ("node", "tier") / ("path",): a
+    record call inventing a new tag (the easy typo when wiring a new
+    ledger surface) fails tier-1 statically instead of raising on the
+    telemetry tick in production."""
+    p = _write(
+        tmp_path,
+        "ledger.py",
+        """
+        from ray_tpu.util.metrics import Counter, Gauge
+
+        LEDGER_BYTES = Gauge(
+            "fixture_object_ledger_node_bytes", "b", tag_keys=("node", "tier")
+        )
+        COPIES = Counter("fixture_object_copies", "c", tag_keys=("path",))
+
+        def tick(node):
+            LEDGER_BYTES.set(1.0, tags={"node": node, "tier": "store"})  # ok
+            LEDGER_BYTES.set(1.0, tags={"node": node, "teir": "spilled"})  # seeded
+            COPIES.inc(tags={"paths": "put"})  # seeded
+        """,
+    )
+    found = metric_names.scan_file(p, "ledger.py")
+    msgs = " | ".join(v.message for v in found)
+    assert len(found) == 2, [v.key for v in found]
+    assert "'teir'" in msgs and "'paths'" in msgs
+
+
 def test_metric_names_catalog_staleness_and_regen(tmp_path):
     p = _write(
         tmp_path, "m3.py",

@@ -86,7 +86,14 @@ def test_dashboard_endpoints_and_timeline(rt):
         assert metrics["tasks_finished"] >= 4
         tl = fetch("/api/timeline")
         assert len(tl) >= 4
-        assert all(ev["ph"] == "X" and ev["dur"] >= 1 for ev in tl)
+        # Task/span rows are complete ("X") events; object lifecycle
+        # markers (create/seal/free) ride along as instants ("i").
+        assert all(
+            (ev["ph"] == "X" and ev["dur"] >= 1)
+            or (ev["ph"] == "i" and ev["cat"] == "object")
+            for ev in tl
+        )
+        assert any(ev["ph"] == "X" and ev["dur"] >= 1 for ev in tl)
         assert fetch("/api/summary").get("FINISHED", 0) >= 4
         # unknown route -> 404 with route listing
         try:

@@ -277,6 +277,463 @@ def test_prometheus_endpoint(rt):
     assert "ray_tpu_object_store_capacity_bytes" in body
 
 
+def test_live_ref_table_counts_and_sites():
+    """refs.py live-ref table: constructions count up, GC'd refs count
+    down (drained off __del__ queues), creation sites captured under the
+    knob — the worker leg of the object ledger."""
+    import gc
+    import os
+
+    from ray_tpu._private import config as _config
+    from ray_tpu._private import refs as refs_mod
+
+    os.environ["RAY_TPU_REF_CALLSITE"] = "1"
+    _config._reset_for_tests()
+    refs_mod._reset_table_for_tests()
+    try:
+        r1 = refs_mod.ObjectRef("ledger-oid-1")
+        r2 = refs_mod.ObjectRef("ledger-oid-1")
+        r3 = refs_mod.ObjectRef("ledger-oid-2")
+        snap = refs_mod.snapshot_refs()
+        assert snap["refs"]["ledger-oid-1"][0] == 2
+        assert snap["refs"]["ledger-oid-2"][0] == 1
+        # The creation site is THIS test file, not a ray_tpu frame.
+        assert "test_observability.py" in (snap["refs"]["ledger-oid-1"][1] or "")
+        del r1, r2
+        gc.collect()
+        snap = refs_mod.snapshot_refs()
+        assert "ledger-oid-1" not in snap["refs"]
+        assert snap["refs"]["ledger-oid-2"][0] == 1
+        del r3
+    finally:
+        os.environ.pop("RAY_TPU_REF_CALLSITE", None)
+        _config._reset_for_tests()
+        refs_mod._reset_table_for_tests()
+
+
+def test_build_memory_records_leak_rules():
+    """Pure-join unit test of the ledger's two leak rules (telemetry.py):
+    dead-holder (crashed process's unreclaimed borrows) and
+    no-live-holder (aged located bytes at refcount 0)."""
+    from ray_tpu._private.telemetry import (
+        build_memory_records,
+        summarize_memory_records,
+    )
+
+    now = 1000.0
+    records = build_memory_records(
+        store_table={
+            "o-live": ("shm", 100),
+            "o-crashheld": ("shm", 5000),
+            "o-orphan": ("shm", 900),
+            "o-young": ("shm", 50),
+        },
+        refcounts={"o-live": 1, "o-crashheld": 1},
+        ready={"o-live": True, "o-crashheld": True, "o-orphan": True, "o-young": True},
+        locations={"o-remote": ["nodeB"]},
+        sizes={"o-remote": 777},
+        meta={
+            "o-live": (now - 60, "driver"),
+            "o-orphan": (now - 60, "driver"),
+            "o-young": (now - 1, "driver"),
+            "o-remote": (now - 60, "w-1"),
+        },
+        conn_refs={"head": {"o-live": 1}, "w-2": {"o-remote": 1}},
+        pushed_tables={"head": {"refs": {"o-live": [1, "app.py:7"]}}},
+        dead_refs={
+            "w-dead": {"refs": {"o-crashheld": 1}, "node": "nodeA", "pid": 4242}
+        },
+        proc_info={"head": ("head", 1), "w-2": ("nodeB", 9)},
+        now=now,
+        leak_age_s=10.0,
+    )
+    by_id = {r["object_id"]: r for r in records}
+    assert by_id["o-live"]["leak"] is None
+    assert by_id["o-live"]["site"] == "app.py:7"
+    assert by_id["o-crashheld"]["leak"] == "dead-holder"
+    dead_holder = [h for h in by_id["o-crashheld"]["holders"] if h["dead"]][0]
+    assert (dead_holder["node"], dead_holder["pid"]) == ("nodeA", 4242)
+    assert by_id["o-orphan"]["leak"] == "no-live-holder"
+    assert by_id["o-young"]["leak"] is None  # inside the seal window
+    assert by_id["o-remote"]["leak"] is None  # held by live w-2
+    assert by_id["o-remote"]["location"] == "remote"
+
+    summary = summarize_memory_records(records, group_by="node", top=2)
+    assert summary["leak_suspects"] == 2
+    assert summary["leak_suspect_bytes"] == 5900
+    assert len(summary["top"]) == 2
+    assert summary["top"][0]["size_bytes"] == 5000  # sorted by size
+    assert "nodeB" in summary["groups"]
+    by_owner = summarize_memory_records(records, group_by="owner")
+    assert by_owner["groups"]["driver"]["objects"] >= 2
+
+
+def test_memory_summary_spill_restore_free(monkeypatch):
+    """Ledger states across the hard transitions: shm -> spilled ->
+    restored -> freed, with the lifecycle event ring recording each."""
+    import numpy as np
+
+    monkeypatch.setenv("RAY_TPU_OBJECT_STORE_MEMORY", str(3 * 1024 * 1024))
+    from ray_tpu._private import config as _config
+
+    _config._reset_for_tests()
+    ray_tpu.init(num_cpus=2)
+    try:
+        from ray_tpu._private.runtime import get_runtime
+
+        rt = get_runtime()
+        a = ray_tpu.put(np.zeros(2 * 1024 * 1024, dtype=np.uint8))
+        b = ray_tpu.put(np.ones(2 * 1024 * 1024, dtype=np.uint8))
+        recs = {r["object_id"]: r for r in state_api.list_object_refs()}
+        assert recs[a.id]["location"] == "spilled", recs[a.id]
+        assert recs[b.id]["location"] == "shm"
+        # Spilled size survives via the runtime's size map.
+        assert recs[a.id]["size_bytes"] and recs[a.id]["size_bytes"] > 1024 * 1024
+        summary = state_api.memory_summary()
+        assert summary["nodes"]["head"]["spilled_bytes"] > 0
+
+        assert int(ray_tpu.get(a, timeout=60)[0]) == 0  # transparent restore
+        recs = {r["object_id"]: r for r in state_api.list_object_refs()}
+        assert recs[a.id]["location"] in ("shm", "spilled")  # b may spill now
+
+        aid = a.id
+        del a
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            known = {r["object_id"] for r in state_api.list_object_refs()}
+            if aid not in known:
+                break
+            time.sleep(0.2)
+        assert aid not in known, "freed object still in the ledger"
+        events = [
+            (e["oid"], e["event"]) for e in rt.object_events if e["oid"] == aid
+        ]
+        kinds = [k for _o, k in events]
+        for expected in ("create", "spill", "restore", "free"):
+            assert expected in kinds, (expected, kinds)
+        # create precedes spill precedes restore precedes free
+        assert kinds.index("spill") < kinds.index("restore") < kinds.index("free")
+        del b
+    finally:
+        ray_tpu.shutdown()
+        from ray_tpu._private import config as _c2
+
+        _c2._reset_for_tests()
+
+
+def test_worker_crash_mid_hold_flags_leak_then_reclaims(monkeypatch):
+    """A worker SIGKILLed while holding a borrowed ref leaves a DEAD-
+    HOLDER leak suspect attributed to its node/pid; reclaim_dead_refs
+    drops the borrow, frees the bytes, and the ledger converges to zero
+    suspects (the chaos-soak standing property, in miniature)."""
+    import os
+    import signal
+
+    monkeypatch.setenv("RAY_TPU_LEAK_RECLAIM_GRACE_S", "600")  # hold the flag
+    from ray_tpu._private import config as _config
+
+    _config._reset_for_tests()
+    ray_tpu.init(num_cpus=2)
+    try:
+        from ray_tpu._private.runtime import get_runtime
+
+        rt = get_runtime()
+
+        @ray_tpu.remote
+        class Holder:
+            def __init__(self):
+                self.kept = None
+
+            def hold(self, box):
+                self.kept = box  # deliberate leak: never released
+                return "held"
+
+            def pid(self):
+                return os.getpid()
+
+        h = Holder.remote()
+        big = ray_tpu.put(b"z" * 700_000)
+        # Inside a list so the actor receives the REF (a borrow), not the value.
+        assert ray_tpu.get(h.hold.remote([big]), timeout=60) == "held"
+        pid = ray_tpu.get(h.pid.remote(), timeout=60)
+        oid = big.id
+        del big  # the driver's own ref drops; the actor's borrow remains
+        os.kill(pid, signal.SIGKILL)
+
+        leak = None
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            s = state_api.memory_summary(top=0)
+            match = [r for r in s["leaks"] if r["object_id"] == oid]
+            if match:
+                leak = match[0]
+                break
+            time.sleep(0.3)
+        assert leak is not None, "crashed holder's object never flagged"
+        assert leak["leak"] == "dead-holder"
+        dead = [x for x in leak["holders"] if x["dead"]]
+        assert dead and dead[0]["pid"] == pid and dead[0]["node"], (
+            "leak not attributed to the dead holder's node/pid"
+        )
+
+        assert rt.reclaim_dead_refs(force=True) >= 1
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            s = state_api.memory_summary(top=0)
+            known = {r["object_id"] for r in state_api.list_object_refs()}
+            if s["leak_suspects"] == 0 and oid not in known:
+                break
+            time.sleep(0.3)
+        assert s["leak_suspects"] == 0, s["leaks"]
+        assert oid not in known, "reclaimed object still holds bytes"
+    finally:
+        ray_tpu.shutdown()
+        from ray_tpu._private import config as _c2
+
+        _c2._reset_for_tests()
+
+
+def test_orphan_no_live_holder_reclaimed_by_ledger_tick(monkeypatch):
+    """Bytes at refcount 0 that no live process claims (the head-bounce
+    retention shape) are flagged no-live-holder, then FREED by the ledger
+    tick's orphan sweep after the grace — with a WARNING event, so the
+    reclaim is visible, not papered over."""
+    monkeypatch.setenv("RAY_TPU_LEAK_AGE_S", "1")
+    monkeypatch.setenv("RAY_TPU_LEAK_ORPHAN_RECLAIM_S", "2")
+    monkeypatch.setenv("RAY_TPU_METRICS_PUSH_MS", "300")
+    from ray_tpu._private import config as _config
+
+    _config._reset_for_tests()
+    ray_tpu.init(num_cpus=1)
+    try:
+        from ray_tpu._private.runtime import get_runtime
+
+        rt = get_runtime()
+        import pickle as _pickle
+
+        oid = "orphan-test-oid"
+        # Seal bytes straight into the store with NO ObjectRef anywhere —
+        # the rc-0 orphan a lost refop add leaves behind.
+        rt.store.put_serialized(oid, _pickle.dumps(b"x" * 400_000), [])
+        rt._note_object(oid, "driver")
+        deadline = time.time() + 5
+        flagged = False
+        while time.time() < deadline and not flagged:
+            recs = {r["object_id"]: r for r in state_api.list_object_refs()}
+            flagged = recs.get(oid, {}).get("leak") == "no-live-holder"
+            time.sleep(0.2)
+        assert flagged, "orphan never flagged"
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if not rt.store.has_local(oid):
+                break
+            time.sleep(0.3)
+        assert not rt.store.has_local(oid), "orphan never reclaimed"
+        evs = state_api.list_cluster_events(limit=100, severity="WARNING")
+        assert any(
+            e["message"] == "orphaned object reclaimed (no live holder)"
+            for e in evs
+        ), "reclaim left no WARNING event"
+    finally:
+        ray_tpu.shutdown()
+        from ray_tpu._private import config as _c2
+
+        _c2._reset_for_tests()
+
+
+def test_memory_groupby_callsite(monkeypatch):
+    """RAY_TPU_REF_CALLSITE=1: ledger records carry creation sites and
+    --group-by callsite buckets bytes by the user line that made them."""
+    monkeypatch.setenv("RAY_TPU_REF_CALLSITE", "1")
+    from ray_tpu._private import config as _config
+    from ray_tpu._private import refs as refs_mod
+
+    _config._reset_for_tests()
+    refs_mod._reset_table_for_tests()
+    ray_tpu.init(num_cpus=2)
+    try:
+        keep = [ray_tpu.put(b"c" * 300_000) for _ in range(3)]  # one callsite
+        summary = state_api.memory_summary(group_by="callsite")
+        sites = [s for s in summary["groups"] if "test_observability.py" in s]
+        assert sites, summary["groups"]
+        assert summary["groups"][sites[0]]["objects"] >= 3
+        del keep
+    finally:
+        ray_tpu.shutdown()
+        _config._reset_for_tests()
+        refs_mod._reset_table_for_tests()
+
+
+def test_logs_all_aggregates_with_prefixes(rt, capsys):
+    """`ray_tpu logs --all`: one aggregate tail across every worker with
+    node/pid line prefixes (the old verb reached exactly one worker)."""
+    @ray_tpu.remote
+    def shout(i):
+        print(f"LOGSALL-{i}")
+        return i
+
+    assert sorted(ray_tpu.get([shout.remote(i) for i in range(2)], timeout=60)) == [0, 1]
+    from ray_tpu._private.runtime import get_runtime
+
+    rt_ = get_runtime()
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        alllogs = rt_.get_logs_all()
+        lines = [l for rec in alllogs.values() for l in rec["lines"]]
+        if sum(1 for l in lines if l.startswith("LOGSALL-")) >= 2:
+            break
+        time.sleep(0.3)
+    assert sum(1 for l in lines if l.startswith("LOGSALL-")) >= 2, alllogs
+    for rec in alllogs.values():
+        assert "node" in rec and "pid" in rec
+
+    from ray_tpu.scripts import cli as cli_mod
+
+    class _Args:
+        all = True
+        tail = 0
+        address = None
+        worker = None
+        actor = None
+
+    assert cli_mod.cmd_logs(_Args()) == 0
+    out = capsys.readouterr().out
+    # log_to_driver echoes "(w-...) line" copies into stdout too — the
+    # aggregate verb's own lines are the node/pid-prefixed ones.
+    hits = [
+        l for l in out.splitlines()
+        if "LOGSALL-" in l and l.startswith("[") and "/" in l.split("]")[0]
+    ]
+    assert len(hits) >= 2, out.splitlines()[:10]
+
+
+def test_dashboard_memory_endpoint(rt):
+    """/api/memory serves the ledger summary; ?leaks=1 trims to suspects."""
+    import json as _json
+    import urllib.request
+
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    keep = ray_tpu.put(b"d" * 400_000)
+    dash = start_dashboard()
+    try:
+        body = _json.loads(
+            urllib.request.urlopen(f"{dash.url}/api/memory", timeout=10).read()
+        )
+        assert body["objects"] >= 1
+        assert body["nodes"]["head"]["store_bytes"] >= 400_000
+        assert any(r["object_id"] == keep.id for r in body["top"])
+        leaks = _json.loads(
+            urllib.request.urlopen(
+                f"{dash.url}/api/memory?leaks=1", timeout=10
+            ).read()
+        )
+        assert set(leaks) == {"leak_suspects", "leak_suspect_bytes", "leaks"}
+        assert leaks["leak_suspects"] == 0
+    finally:
+        stop_dashboard()
+        del keep
+
+
+def test_attached_state_verbs_and_memory_leaks_cli(tmp_path, capsys):
+    """The attachable introspection plane against a REAL standalone head:
+    util/state list_* verbs route through the head's state_list op (the
+    old in-process-runtime requirement is gone), and `ray_tpu memory
+    --leaks --address ...` flags a deliberately leaked object, attributing
+    its bytes to the holding node/pid (the ISSUE 9 acceptance line)."""
+    import json as _json
+    import os
+    import signal
+    import subprocess
+
+    from ray_tpu._private.head import launch_head_subprocess
+
+    # The head inherits the env: hold dead-holder suspects long enough to
+    # observe them over the CLI before the reclaim sweep clears them.
+    os.environ["RAY_TPU_LEAK_RECLAIM_GRACE_S"] = "600"
+    proc = None
+    try:
+        proc, head_json = launch_head_subprocess(
+            str(tmp_path), num_cpus=4, session="memcli"
+        )
+        ray_tpu.init(address=head_json)
+
+        @ray_tpu.remote
+        class Holder:
+            def __init__(self):
+                self.kept = None
+
+            def hold(self, box):
+                self.kept = box
+                return "held"
+
+        h = Holder.remote()
+        big = ray_tpu.put(b"L" * 800_000)
+        assert ray_tpu.get(h.hold.remote([big]), timeout=90) == "held"
+
+        # Attachable state verbs (satellite): answers come from the head.
+        nodes = state_api.list_nodes()
+        assert any(n["is_head"] for n in nodes)
+        workers = state_api.list_workers()
+        actor_workers = [w for w in workers if w["actor_id"]]
+        assert actor_workers and actor_workers[0]["pid"]
+        objs = state_api.list_objects()
+        assert any(o["object_id"] == big.id for o in objs)
+        assert state_api.summarize_tasks().get("FINISHED", 0) >= 1
+        assert state_api.cluster_metrics()["object_store_capacity_bytes"] > 0
+
+        # Deliberate leak: kill the holding worker, keep nothing else.
+        pid = actor_workers[0]["pid"]
+        oid = big.id
+        del big
+        os.kill(pid, signal.SIGKILL)
+
+        from ray_tpu.scripts import cli as cli_mod
+
+        class _Args:
+            address = head_json
+            group_by = None
+            leaks = True
+            top = 20
+            events = False
+
+        leak = None
+        deadline = time.time() + 45
+        while time.time() < deadline:
+            assert cli_mod.cmd_memory(_Args()) == 0
+            out = _json.loads(capsys.readouterr().out)
+            match = [r for r in out["leaks"] if r["object_id"] == oid]
+            if match:
+                leak = match[0]
+                break
+            time.sleep(0.5)
+        assert leak is not None, "attached --leaks never flagged the kill"
+        assert leak["reason"] == "dead-holder"
+        assert leak["size_bytes"] >= 800_000
+        dead = [x for x in leak["holders"] if x["dead"]]
+        assert dead and dead[0]["pid"] == pid and dead[0]["node"], leak
+
+        # logs --all rides the same attachable path.
+        from ray_tpu._private.worker_proc import get_worker_runtime
+
+        wr = get_worker_runtime()
+        assert wr is not None
+        alllogs = wr.request("get_logs_all", None)
+        assert isinstance(alllogs, dict)
+    finally:
+        os.environ.pop("RAY_TPU_LEAK_RECLAIM_GRACE_S", None)
+        from ray_tpu._private import config as _c2
+
+        ray_tpu.shutdown()
+        _c2._reset_for_tests()
+        if proc is not None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
 def test_hung_daemon_declared_dead_by_heartbeat_timeout():
     """A daemon that stops heartbeating (SIGSTOP: conn open, process
     frozen) must be declared dead within the timeout so its tasks retry
