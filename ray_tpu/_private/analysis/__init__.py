@@ -48,6 +48,7 @@ from ray_tpu._private.analysis import (
     hot_send,
     lock_order,
     metric_names,
+    span_names,
 )
 from ray_tpu._private.analysis import allowlist as allowlist_mod
 
@@ -58,6 +59,7 @@ PASSES = (
     "hot-send",
     "gcs-mutation",
     "metric-names",
+    "span-names",
 )
 
 
@@ -83,12 +85,14 @@ def run_analysis(
     allowlist_path: Optional[str] = None,
     catalog_path: Optional[str] = None,
     metric_catalog_path: Optional[str] = None,
+    span_catalog_path: Optional[str] = None,
 ) -> AnalysisResult:
     """Run every pass over `roots` (package dirs or files).
 
     spec_roots: where fault-spec literals are validated (tests/scripts);
-    catalog_path / metric_catalog_path: committed generated catalogs to
-    check for staleness (None = skip, e.g. on fixture trees)."""
+    catalog_path / metric_catalog_path / span_catalog_path: committed
+    generated catalogs to check for staleness (None = skip, e.g. on
+    fixture trees)."""
     files = []
     for root in roots:
         files.extend(iter_py_files(root))
@@ -108,6 +112,10 @@ def run_analysis(
         violations.extend(
             metric_names.check_catalog(metrics, metric_catalog_path)
         )
+    spans = span_names.collect_spans(files)
+    violations.extend(span_names.check_duplicates(spans))
+    if span_catalog_path is not None:
+        violations.extend(span_names.check_catalog(spans, span_catalog_path))
     spec_files = []
     for root in spec_roots or ():
         spec_files.extend(iter_py_files(root))

@@ -343,6 +343,22 @@ _DEFS: Dict[str, tuple] = {
         "wait for their live worker to reconnect (state preserved) before "
         "being respawned from their creation spec (state reset)",
     ),
+    "prof_hz": (
+        0.0, float,
+        "sampling-profiler autostart rate: every process starts its "
+        "sys._current_frames() sampler at this many Hz at entry "
+        "(profiler.py; the chaos soak's always-hot mode).  0 = off — the "
+        "zero-overhead default; `ray_tpu profile` still starts sampling "
+        "cluster-wide on demand via a pubsub broadcast "
+        "(ray: the dashboard's py-spy attach plays this role)",
+    ),
+    "timeline_last_s": (
+        0.0, float,
+        "default window for the chrome-trace timeline export: only "
+        "events/spans newer than this many seconds are emitted (0 = "
+        "everything the rings hold); `ray_tpu timeline --last/--since` "
+        "override per call",
+    ),
 }
 
 # Back-compat env names from before the knob table existed.

@@ -1,0 +1,446 @@
+"""Cluster-wide sampling profiler: collapsed-stack flamegraphs on demand.
+
+ray: the dashboard's py-spy integration (`ray stack` / the "CPU flame
+graph" button — dashboard/modules/reporter attaches py-spy to a live pid
+and renders speedscope output).  Spawning an external tracer per process
+doesn't fit a many-process control plane under test, so this build
+samples IN-PROCESS instead: a daemon thread wakes at RAY_TPU_PROF_HZ and
+walks `sys._current_frames()`, folding every thread's stack into the
+classic collapsed form (`thread;mod:fn;mod:fn... count`).  Per-process
+tables ship to the head as DROPPABLE `prof_push` oneways riding the v2
+batch frames (the metrics_push discipline: a dead conn loses a tick,
+never wedges the ownership backlog), where ProfileSink merges them into
+per-node and cluster-wide flamegraphs (`ray_tpu profile`, /api/profile).
+
+Cost model (the faults.ENABLED discipline):
+
+  * OFF (default) — `ENABLED` is a module bool nothing checks on any hot
+    path; there is no thread, no timer, no allocation.  Steady-state cost
+    is exactly zero.
+  * ON — one thread per process; each tick costs one _current_frames()
+    walk (microseconds at typical stack depths).  Started either by the
+    RAY_TPU_PROF_HZ env knob (autostart at process entry — the chaos
+    soak's always-hot mode) or cluster-wide at runtime by a pubsub
+    broadcast on the "profiler" channel (`ray_tpu profile` / the
+    profile_start head op), so a steady-state cluster pays nothing until
+    an operator asks a question.
+
+Tables are CUMULATIVE since start(): pushes are idempotent latest-wins
+snapshots, so a dropped push (head bounce, shard death) costs freshness,
+never correctness.  The flight recorder folds the top stacks into every
+crash dump (telemetry.flight_dump) — a chaos-killed process leaves
+behind not just what it did, but where it was spending time.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+# Module-bool fast path (the faults.ENABLED idiom): False means no
+# sampler thread exists and nothing else in this module runs.
+ENABLED: bool = False
+
+# Sampling rate used when a start request doesn't name one (the
+# "default HZ" of the acceptance bar; RAY_TPU_PROF_HZ overrides at
+# autostart, the profile verb's --hz overrides per run).  Continuous
+# CLUSTER-WIDE profiling pays the rate in EVERY process — on a 1-vCPU CI
+# host that is ~20 samplers sharing one core — so the default follows
+# the continuous-profiler convention (~10Hz, the Cloud Profiler /
+# conservative py-spy regime) rather than py-spy's single-process 100Hz;
+# `ray_tpu profile --hz` raises it for short interactive windows.
+DEFAULT_HZ = 10.0
+
+_lock = threading.Lock()
+_thread: Optional[threading.Thread] = None
+_stop = threading.Event()
+_pid = os.getpid()
+_hz = 0.0
+_t0 = 0.0
+_n_samples = 0
+_n_dropped_stacks = 0
+_samples: Dict[str, int] = {}
+
+_MAX_DEPTH = 48          # frames kept per stack (deepest dropped first)
+_MAX_STACKS = 4096       # distinct stacks kept in-process before pruning
+_PUSH_STACKS = 512       # top-N stacks per prof_push payload
+
+
+# Per-code-object label cache: the live-stack set of a program is small
+# and stable, so the f_globals lookup + string build happen once per code
+# object, not once per frame per sample (the sampler's hot-path budget).
+# Keyed by the code object itself — module-level code is alive anyway;
+# bounded clear on pathological churn (exec-heavy workloads).
+_label_cache: Dict[Any, str] = {}
+
+
+def _frame_label(frame) -> str:
+    """`module:function` for one frame — cached per code object."""
+    code = frame.f_code
+    label = _label_cache.get(code)
+    if label is None:
+        mod = frame.f_globals.get("__name__")
+        if not mod:
+            mod = os.path.splitext(os.path.basename(code.co_filename))[0]
+        if len(_label_cache) > 8192:
+            _label_cache.clear()
+        label = _label_cache[code] = f"{mod}:{code.co_name}"
+    return label
+
+
+def collapse_frame(frame, thread_name: str = "") -> str:
+    """Fold one thread's live frame chain into a collapsed stack string,
+    root-first (the flamegraph.pl / py-spy `--format collapsed` shape),
+    prefixed with the thread name so per-thread time stays attributable
+    after the cluster merge."""
+    parts: List[str] = []
+    f = frame
+    while f is not None and len(parts) < _MAX_DEPTH:
+        parts.append(_frame_label(f))
+        f = f.f_back
+    parts.reverse()
+    if thread_name:
+        parts.insert(0, thread_name)
+    return ";".join(parts)
+
+
+def _prune_locked() -> int:
+    """Keep the top half of stacks by count when the table overflows
+    (rare: a stable program has a bounded live-stack set).  Returns how
+    many stacks were dropped; their sample counts are gone from the
+    table but remain in _n_samples, so `other` time stays visible as the
+    gap between total and per-stack sums."""
+    global _samples
+    ranked = sorted(_samples.items(), key=lambda kv: -kv[1])
+    keep = ranked[: _MAX_STACKS // 2]
+    dropped = len(ranked) - len(keep)
+    _samples = dict(keep)
+    return dropped
+
+
+# Thread-name map, refreshed lazily (threading.enumerate() walks a lock
+# + list per call — too hot to pay per sample; names change rarely).
+_thread_names: Dict[int, str] = {}
+_names_refresh_due = 0
+
+
+def _sample_once(own_ident: int) -> None:
+    global _n_samples, _n_dropped_stacks, _names_refresh_due
+    try:
+        frames = sys._current_frames()
+    except Exception:
+        return
+    names = _thread_names
+    if _n_samples >= _names_refresh_due or any(
+        i not in names for i in frames
+    ):
+        names = {t.ident: t.name for t in threading.enumerate()}
+        _thread_names.clear()
+        _thread_names.update(names)
+        _names_refresh_due = _n_samples + 64
+    with _lock:
+        _n_samples += 1
+        for ident, frame in frames.items():
+            if ident == own_ident:
+                continue  # never profile the profiler
+            key = collapse_frame(frame, names.get(ident, f"t{ident}"))
+            _samples[key] = _samples.get(key, 0) + 1
+        if len(_samples) > _MAX_STACKS:
+            _n_dropped_stacks += _prune_locked()
+
+
+def _loop(period: float, stop: threading.Event) -> None:
+    own = threading.get_ident()
+    next_t = time.monotonic() + period
+    while not stop.is_set():
+        delay = next_t - time.monotonic()
+        if delay > 0:
+            if stop.wait(delay):
+                return
+        next_t = max(next_t + period, time.monotonic())
+        _sample_once(own)
+
+
+def running() -> bool:
+    return ENABLED and _thread is not None and _thread.is_alive()
+
+
+def start(hz: Optional[float] = None) -> float:
+    """Start (or retune) the sampler in THIS process.  Resets the table —
+    a profile run measures from its own start.  Returns the effective
+    rate.  Fork-safe: a child inherits module state but not the thread;
+    the pid check re-arms cleanly."""
+    global ENABLED, _thread, _stop, _pid, _hz, _t0, _n_samples
+    global _n_dropped_stacks, _samples
+    hz = float(hz) if hz else DEFAULT_HZ
+    hz = min(max(hz, 1.0), 1000.0)
+    with _lock:
+        if running() and _pid == os.getpid() and abs(hz - _hz) < 1e-9:
+            return _hz  # idempotent re-start at the same rate
+        _stop.set()
+        _stop = threading.Event()
+        _samples = {}
+        _n_samples = 0
+        _n_dropped_stacks = 0
+        _pid = os.getpid()
+        _hz = hz
+        _t0 = time.time()
+        ENABLED = True
+        _thread = threading.Thread(
+            target=_loop, args=(1.0 / hz, _stop), daemon=True,
+            name="raytpu-prof",
+        )
+        _thread.start()
+    try:
+        from ray_tpu._private import telemetry
+
+        telemetry.note("prof_start", hz=hz)
+    except Exception:
+        pass
+    return hz
+
+
+def stop() -> None:
+    """Stop sampling; the table is kept for a final snapshot/push."""
+    global ENABLED, _thread
+    with _lock:
+        ENABLED = False
+        _stop.set()
+        t = _thread
+        _thread = None
+    if t is not None and t.is_alive():
+        t.join(timeout=0.5)
+
+
+def maybe_autostart() -> None:
+    """Start sampling when RAY_TPU_PROF_HZ > 0 (called from
+    telemetry.install at every process entry — head, workers, daemons,
+    io shards all sample under the soak's always-hot mode).  The default
+    0 keeps this a single config read."""
+    if running():
+        return
+    try:
+        from ray_tpu._private import config as _config
+
+        hz = float(_config.get("prof_hz"))
+    except Exception:
+        return
+    if hz > 0:
+        start(hz)
+
+
+def snapshot_payload(top: int = _PUSH_STACKS) -> Dict[str, Any]:
+    """The prof_push body: this process's cumulative table (top-N stacks
+    by count), with enough metadata for the head to merge and attribute.
+    Cheap enough to build on the telemetry tick."""
+    with _lock:
+        ranked = sorted(_samples.items(), key=lambda kv: -kv[1])
+        dropped = _n_dropped_stacks + sum(n for _s, n in ranked[top:])
+        payload = {
+            "pid": os.getpid(),
+            "t": time.time(),
+            "t0": _t0,
+            "hz": _hz,
+            "n": _n_samples,
+            "running": running(),
+            "dropped_stacks": dropped,
+            "samples": dict(ranked[:top]),
+        }
+    try:
+        from ray_tpu._private import telemetry
+
+        payload["proc"] = telemetry._proc_tag
+    except Exception:
+        payload["proc"] = "?"
+    return payload
+
+
+def flight_snapshot(top: int = 20) -> Optional[List[Tuple[str, int]]]:
+    """Top stacks for a crash dump, or None when nothing was sampled —
+    telemetry.flight_dump folds this into every dump so a chaos-killed
+    process records where its time went."""
+    with _lock:
+        if not _samples:
+            return None
+        return sorted(_samples.items(), key=lambda kv: -kv[1])[:top]
+
+
+def _reset_for_tests() -> None:
+    global _samples, _n_samples, _n_dropped_stacks
+    stop()
+    with _lock:
+        _samples = {}
+        _n_samples = 0
+        _n_dropped_stacks = 0
+
+
+# ---------------------------------------------------------------------------
+# merge + rendering (pure: unit-testable without a cluster)
+
+def merge_samples(tables: List[Dict[str, int]]) -> Dict[str, int]:
+    """Sum collapsed-stack tables (per-process cumulative counts) into
+    one — the cluster/node flamegraph body."""
+    out: Dict[str, int] = {}
+    for t in tables:
+        for stack, n in (t or {}).items():
+            out[stack] = out.get(stack, 0) + int(n)
+    return out
+
+
+def folded_text(samples: Dict[str, int]) -> str:
+    """`stack count` lines, descending — the flamegraph.pl / speedscope
+    collapsed input format (`--flame out.txt`)."""
+    lines = [
+        f"{stack} {n}"
+        for stack, n in sorted(samples.items(), key=lambda kv: (-kv[1], kv[0]))
+    ]
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+class _Node:
+    __slots__ = ("name", "count", "children")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.children: Dict[str, "_Node"] = {}
+
+
+def _build_tree(samples: Dict[str, int]) -> _Node:
+    root = _Node("all")
+    for stack, n in samples.items():
+        root.count += n
+        node = root
+        for part in stack.split(";"):
+            child = node.children.get(part)
+            if child is None:
+                child = node.children[part] = _Node(part)
+            child.count += n
+            node = child
+    return root
+
+
+def _svg_escape(s: str) -> str:
+    return (
+        s.replace("&", "&amp;").replace("<", "&lt;").replace(">", "&gt;")
+        .replace('"', "&quot;")
+    )
+
+
+def flamegraph_svg(samples: Dict[str, int], title: str = "ray_tpu profile",
+                   width: int = 1200) -> str:
+    """Self-contained flamegraph SVG (`--flame out.svg`): one rect per
+    call-tree node, width proportional to samples, hover titles with
+    counts — no JS, opens anywhere."""
+    root = _build_tree(samples)
+    row_h = 16
+    rects: List[str] = []
+    max_depth = [0]
+
+    def layout(node: _Node, x: float, w: float, depth: int) -> None:
+        if w < 0.5:
+            return
+        max_depth[0] = max(max_depth[0], depth)
+        hue = (hash(node.name) % 55) + 5  # warm palette, stable per name
+        label = _svg_escape(node.name)
+        pct = 100.0 * node.count / max(root.count, 1)
+        rects.append(
+            f'<g><title>{label} ({node.count} samples, {pct:.1f}%)</title>'
+            f'<rect x="{x:.1f}" y="{depth * row_h}" width="{w:.1f}" '
+            f'height="{row_h - 1}" fill="hsl({hue},70%,62%)"/>'
+            + (
+                f'<text x="{x + 2:.1f}" y="{depth * row_h + 11}" '
+                f'font-size="10" font-family="monospace">'
+                f'{label[: max(int(w / 6.5), 0)]}</text>'
+                if w > 20
+                else ""
+            )
+            + "</g>"
+        )
+        cx = x
+        for child in sorted(node.children.values(), key=lambda c: -c.count):
+            cw = w * child.count / max(node.count, 1)
+            layout(child, cx, cw, depth + 1)
+            cx += cw
+
+    layout(root, 0.0, float(width), 0)
+    height = (max_depth[0] + 2) * row_h
+    return (
+        f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" '
+        f'height="{height}" font-family="monospace">'
+        f'<text x="4" y="{height - 4}" font-size="11">{_svg_escape(title)}'
+        f" — {root.count} samples</text>" + "".join(rects) + "</svg>"
+    )
+
+
+class ProfileSink:
+    """Head-side merge of pushed per-process tables (the TelemetrySink
+    idiom: latest snapshot per sender, forgotten on process death).
+    Payloads are cumulative-since-start, so latest-wins ingest plus a
+    sum across senders is exact regardless of dropped pushes."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.tables: Dict[str, Dict[str, Any]] = {}
+        self.nodes: Dict[str, Optional[str]] = {}
+
+    def ingest(self, key: str, payload: Dict, node: Optional[str] = None) -> None:
+        if not isinstance(payload, dict):
+            return
+        with self._lock:
+            while len(self.tables) >= 4096:
+                self.tables.pop(next(iter(self.tables)))
+            self.tables[key] = payload
+            if node is not None:
+                self.nodes[key] = node
+
+    def forget(self, key: str) -> None:
+        with self._lock:
+            self.tables.pop(key, None)
+            self.nodes.pop(key, None)
+
+    def merged(
+        self, node: Optional[str] = None, pid: Optional[int] = None
+    ) -> Dict[str, Any]:
+        """Cluster (or node-/pid-filtered) flamegraph: summed samples +
+        per-process attribution rows."""
+        with self._lock:
+            items = [
+                (key, snap, self.nodes.get(key)) for key, snap in self.tables.items()
+            ]
+        procs: List[Dict[str, Any]] = []
+        tables: List[Dict[str, int]] = []
+        now = time.time()
+        for key, snap, snap_node in items:
+            if node is not None and snap_node != node:
+                continue
+            if pid is not None and snap.get("pid") != pid:
+                continue
+            procs.append(
+                {
+                    "key": key,
+                    "proc": snap.get("proc"),
+                    "pid": snap.get("pid"),
+                    "node": snap_node,
+                    "hz": snap.get("hz"),
+                    "n_samples": snap.get("n", 0),
+                    "running": bool(snap.get("running")),
+                    "age_s": round(now - snap.get("t", now), 3),
+                }
+            )
+        tables = [
+            snap.get("samples") or {}
+            for key, snap, snap_node in items
+            if (node is None or snap_node == node)
+            and (pid is None or snap.get("pid") == pid)
+        ]
+        merged = merge_samples(tables)
+        return {
+            "samples": merged,
+            "total_samples": sum(p["n_samples"] for p in procs),
+            "processes": sorted(procs, key=lambda p: -p["n_samples"]),
+            "pids": sorted({p["pid"] for p in procs if p.get("pid")}),
+        }

@@ -473,6 +473,86 @@ def refs_ab(out_path=None, rounds: int = 3, budget_pct: float = 3.0):
     return report
 
 
+def prof_ab(out_path=None, rounds: int = 3, budget_pct: float = 5.0):
+    """A/B the sampling profiler ALONE on multi_client_tasks_async: both
+    sides run the normal plane defaults; only RAY_TPU_PROF_HZ toggles
+    between 0 (off — the zero-overhead fast path) and the default rate.
+    Interleaved rounds, medians compared — the ISSUE 10 acceptance
+    measurement (profiler on at default HZ must cost <5%).
+
+        python -m ray_tpu._private.ray_perf --prof-ab \
+            [--json BENCH_prof_r1.json]
+    """
+    import os as _os
+    import statistics
+
+    from ray_tpu._private import config as _config
+    from ray_tpu._private import profiler as _profiler
+
+    hz = _profiler.DEFAULT_HZ
+    saved = _os.environ.get("RAY_TPU_PROF_HZ")
+    runs = {"off": [], "on": []}
+    try:
+        for _r in range(rounds):
+            for mode in ("off", "on"):
+                _os.environ["RAY_TPU_PROF_HZ"] = (
+                    "0" if mode == "off" else str(hz)
+                )
+                _config._reset_for_tests()
+                _profiler._reset_for_tests()  # stop any prior sampler
+                ray_tpu.init(num_cpus=max(_os.cpu_count() or 1, 16))
+                try:
+                    ops = _multi_client_once()
+                finally:
+                    ray_tpu.shutdown()
+                    _profiler._reset_for_tests()
+                runs[mode].append(ops)
+                print(
+                    json.dumps({"mode": mode, "round": _r, "ops_per_s": ops}),
+                    flush=True,
+                )
+    finally:
+        if saved is None:
+            _os.environ.pop("RAY_TPU_PROF_HZ", None)
+        else:
+            _os.environ["RAY_TPU_PROF_HZ"] = saved
+        _config._reset_for_tests()
+        _profiler._reset_for_tests()
+    off_m = statistics.median(runs["off"])
+    on_m = statistics.median(runs["on"])
+    overhead_pct = round((off_m - on_m) / off_m * 100, 2)
+    report = {
+        "name": "prof_ab_multi_client_tasks_async",
+        "hz": hz,
+        "note": (
+            "interleaved OFF/ON rounds, medians compared (median-of-"
+            f"{rounds}).  OFF = RAY_TPU_PROF_HZ unset (the ENABLED "
+            "module-bool fast path: no thread, no per-op check beyond "
+            "the ticker's one bool); ON = every process samples "
+            f"sys._current_frames() at {hz}Hz and pushes collapsed-stack "
+            "tables each telemetry tick"
+        ),
+        "off_runs": runs["off"],
+        "on_runs": runs["on"],
+        "off_median_ops_per_s": off_m,
+        "on_median_ops_per_s": on_m,
+        "overhead_pct": overhead_pct,
+        "budget_pct": budget_pct,
+        "pass": overhead_pct < budget_pct,
+    }
+    print(json.dumps(report, indent=1), flush=True)
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=1)
+            f.write("\n")
+    assert overhead_pct < budget_pct, (
+        f"profiler at {hz}Hz costs {overhead_pct}% on "
+        f"multi_client_tasks_async (budget {budget_pct}%): "
+        f"off={runs['off']} on={runs['on']}"
+    )
+    return report
+
+
 def telemetry_ab(out_path=None, rounds: int = 3, budget_pct: float = 3.0):
     """A/B the FULL telemetry plane (metric push + trace spans + flight
     recorder) against telemetry-off on the multi_client_tasks_async
@@ -705,6 +785,8 @@ def main(argv=None):
         return telemetry_ab(out_path)
     if "--refs-ab" in argv:
         return refs_ab(out_path)
+    if "--prof-ab" in argv:
+        return prof_ab(out_path)
     if "--shard-sweep" in argv:
         return shard_sweep(out_path)
     if "--object-plane" in argv:
@@ -743,10 +825,46 @@ def main(argv=None):
             ),
         }
     ]
+    # --profile: the whole suite runs with the cluster profiler hot; the
+    # output gains a merged flamegraph (top stacks) + the stage-attributed
+    # task summary, so any bench shape ships with "where the time went"
+    # evidence instead of a bare ops/s number (ISSUE 10).
+    profiling = "--profile" in argv
+    if profiling:
+        from ray_tpu.util import state as _state_api
+
+        _state_api.profile_start()
     for bench in ALL:
         r = bench()
         results.append(r)
         print(json.dumps(r), flush=True)
+    if profiling:
+        import time as _t
+
+        from ray_tpu.util import state as _state_api
+
+        _state_api.profile_stop()
+        _t.sleep(1.2)  # final worker prof_push beats land
+        rep = _state_api.profile_report()
+        top = sorted(
+            (rep.get("samples") or {}).items(), key=lambda kv: -kv[1]
+        )[:25]
+        prof_result = {
+            "name": "profile_attachment",
+            "pids": rep.get("pids"),
+            "total_samples": rep.get("total_samples"),
+            "top_stacks": [{"stack": s, "samples": n} for s, n in top],
+            "task_summary": {
+                k: v
+                for k, v in _state_api.task_summary(slow=5).items()
+                if k in (
+                    "tasks", "states", "stages", "accounted_fraction",
+                    "slow",
+                )
+            },
+        }
+        results.append(prof_result)
+        print(json.dumps(prof_result), flush=True)
     ray_tpu.shutdown()
     if out_path:
         with open(out_path, "w") as f:

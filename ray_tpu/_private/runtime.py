@@ -349,7 +349,7 @@ class _ReadyQueue:
 class TaskRecord:
     __slots__ = (
         "spec", "state", "node_id", "worker_id", "unmet_deps", "cancelled",
-        "pg", "start_time", "allow_pending",
+        "pg", "start_time", "allow_pending", "stages",
     )
 
     def __init__(self, spec):
@@ -365,6 +365,14 @@ class TaskRecord:
         # the cluster's daemon nodes rejoin seconds after restore, and
         # failing fast there would defeat the re-drive.
         self.allow_pending = False
+        # Lifecycle stage stamps (telemetry.STAGE_ORDER): wall-clock time
+        # each stage was entered, on the head clock (executor stamps land
+        # via the done message, offset-corrected).  A retried attempt
+        # re-stamps, so the record attributes the attempt that finished.
+        self.stages: Dict[str, float] = {"submit": time.time()}
+
+    def stamp(self, stage: str) -> None:
+        self.stages[stage] = time.time()
 
 
 class ActorRuntime:
@@ -476,6 +484,12 @@ class Runtime:
         # tables below by memory_summary (ray: reference_count.h:61 tables
         # feeding `ray memory`).
         self.ledger = _telemetry.ObjectLedger()
+        # Profile sink: latest pushed collapsed-stack table per process
+        # (prof_push oneways), merged into the cluster flamegraph by
+        # `ray_tpu profile` / /api/profile (profiler.py).
+        from ray_tpu._private import profiler as _profiler
+
+        self.profiles = _profiler.ProfileSink()
         # Conn-tracked outstanding ref borrows per WORKER (the driver twin
         # is driver_refs): every refop add/del updates this, so a worker
         # crash mid-hold leaves exactly the refs it still held — flagged
@@ -1427,6 +1441,106 @@ class Runtime:
             out["events"] = list(self.object_events)[-200:]
         return out
 
+    # ------------------------------------------------------------------
+    # profiling plane (profiler.py): cluster-wide sampling control + merge
+
+    def profile_start(self, hz: Optional[float] = None) -> dict:
+        """Start the sampler cluster-wide: locally in this process, and by
+        pubsub broadcast in every subscribed worker ("profiler" channel,
+        key "ctl").  Idempotent; returns the effective rate."""
+        from ray_tpu._private import profiler as _profiler
+
+        eff = _profiler.start(hz)
+        self.pubsub.publish("profiler", "ctl", "start", eff)
+        self.events.emit(
+            "INFO", "profiler", "cluster-wide sampling started", hz=eff
+        )
+        return {"hz": eff}
+
+    def profile_stop(self) -> dict:
+        """Stop sampling cluster-wide.  Workers push a final table on the
+        stop broadcast; tables already pushed stay in the sink for
+        profile_report (cumulative payloads make this race-free)."""
+        from ray_tpu._private import profiler as _profiler
+
+        self.pubsub.publish("profiler", "ctl", "stop")
+        _profiler.stop()
+        return {"stopped": True}
+
+    def profile_report(
+        self, node: Optional[str] = None, pid: Optional[int] = None
+    ) -> dict:
+        """Merged flamegraph: every pushed per-process table plus a fresh
+        local snapshot, optionally filtered to one node or pid."""
+        from ray_tpu._private import profiler as _profiler
+
+        snap = _profiler.snapshot_payload()
+        if snap.get("n"):
+            self.profiles.ingest("head", snap, node=self.head_node_id)
+        return self.profiles.merged(node=node, pid=pid)
+
+    def task_summary_local(self, slow: int = 10) -> dict:
+        """Stage-attributed task summary over the finished-task ring +
+        live tasks (the `ray_tpu tasks` body; pure fold in telemetry.py)."""
+        from ray_tpu._private import telemetry as _telemetry
+
+        now = time.time()
+        with self.lock:
+            events = [dict(e) for e in self.task_events]
+            live = []
+            for tid, rec in self.tasks.items():
+                stages = dict(rec.stages)
+                last = max(stages.values()) if stages else now
+                live.append(
+                    {
+                        "task_id": tid,
+                        "name": rec.spec.name,
+                        "state": rec.state,
+                        "stages": stages,
+                        "age_s": round(now - stages.get("submit", last), 6),
+                        "stuck_s": round(now - last, 6),
+                    }
+                )
+        out = _telemetry.summarize_task_events(events, live, slow=slow)
+        out["live"] = sorted(live, key=lambda t: -t["stuck_s"])[: max(slow, 0)]
+        return out
+
+    def _blocked_get_detail(self, oids) -> str:
+        """Critical-path hint for a timed-out get(): which lifecycle stage
+        each still-pending producing task is stuck in, and for how long —
+        the one-line diagnosis a p99 hunt needs (never raises)."""
+        try:
+            from ray_tpu._private import telemetry as _telemetry
+
+            now = time.time()
+            parts = []
+            with self.lock:
+                for oid in list(oids)[:4]:
+                    tid = oid.split(":")[1] if oid.startswith("o:") else None
+                    rec = self.tasks.get(tid) if tid else None
+                    if rec is None:
+                        continue
+                    present = [
+                        s for s in _telemetry.STAGE_ORDER
+                        if isinstance(rec.stages.get(s), (int, float))
+                    ]
+                    if not present:
+                        continue
+                    last = present[-1]
+                    label = _telemetry.STAGE_LABELS.get(last, last)
+                    durs = _telemetry.stage_durations(rec.stages)
+                    hist = " ".join(
+                        f"{k}={v:.3f}s" for k, v in durs.items()
+                    )
+                    parts.append(
+                        f"task {tid} ({rec.spec.name}) stuck in stage "
+                        f"'{label}' for {now - rec.stages[last]:.3f}s"
+                        + (f" after [{hist}]" if hist else "")
+                    )
+            return "; ".join(parts)
+        except Exception:
+            return ""
+
     def get_logs_all(self, n: Optional[int] = None) -> dict:
         """Aggregate log tail across every worker that produced output,
         with node/pid attribution (`ray_tpu logs --all`)."""
@@ -1541,6 +1655,7 @@ class Runtime:
         (ray: gcs_actor_manager OnJobFinished + gcs_job_manager)."""
         self.telemetry.forget(did)
         self.ledger.forget(did)
+        self.profiles.forget(did)
         with self.lock:
             self.drivers.pop(did, None)
             self.driver_nodes.pop(did, None)
@@ -2484,6 +2599,15 @@ class Runtime:
                 self._pending_send_flushes = (
                     getattr(self, "_pending_send_flushes", 0) + len(pending)
                 )
+                # Task frames queued while the worker booted go out NOW:
+                # stamp their "pushed" stage (still under the lock — the
+                # record may be concurrently finished by another conn).
+                push_t = time.time()
+                for msg in pending:
+                    if msg[0] in ("task", "create_actor"):
+                        prec = self.tasks.get(msg[1].task_id)
+                        if prec is not None:
+                            prec.stages.setdefault("pushed", push_t)
             for msg in pending:
                 try:
                     conn.send(msg)
@@ -3101,7 +3225,10 @@ class Runtime:
     def _handle_hot_locked(self, wid: str, msg: tuple) -> None:
         # caller holds self.lock
         if msg[0] == "done":
-            self._on_task_done(wid, msg[1], msg[2], msg[3])
+            self._on_task_done(
+                wid, msg[1], msg[2], msg[3],
+                timing=msg[4] if len(msg) > 4 else None,
+            )
             return
         # Every sender's outstanding borrows are conn-tracked (drivers in
         # driver_refs, workers in worker_refs): a holder dying mid-hold
@@ -3200,6 +3327,9 @@ class Runtime:
                         # Land the sender's timestamps on the head clock so
                         # the merged timeline orders across processes.
                         e["end_time"] += off
+                        for s, v in list((e.get("stages") or {}).items()):
+                            if isinstance(v, (int, float)):
+                                e["stages"][s] = v + off
                     tid = e.get("task_id")
                     if e.get("state") == "RUNNING":
                         if tid not in self._direct_done_recent:
@@ -3225,6 +3355,11 @@ class Runtime:
                         else "tasks_failed"
                     ] += 1
                     self.task_events.append(e)
+                    # Direct-task events carry executor-side stage
+                    # durations (exec_queue/running): same histograms as
+                    # head-dispatched tasks, so `ray_tpu tasks --summary`
+                    # spans both transports.
+                    self._observe_stage_durations(e.get("durations"))
         elif kind == "spans":
             # Worker-side trace spans (util/tracing.py), batched off the
             # latency path like task events.  Corrected onto the head
@@ -3245,6 +3380,11 @@ class Runtime:
             # the worker leg of the object ledger — droppable, latest wins
             # per sender, joined with the owner tables by memory_summary.
             self.ledger.ingest(wid, msg[1])
+        elif kind == "prof_push":
+            # Periodic per-process collapsed-stack table (profiler.py):
+            # cumulative since start, so latest-wins ingest + a sum across
+            # senders is exact even when droppable pushes are lost.
+            self.profiles.ingest(wid, msg[1], node=self._worker_node(wid))
         elif kind == "wire_stats":
             # Per-process wire counters reported by workers/drivers when
             # RAY_TPU_WIRE_STATS=1 (keyed by sender; cluster_metrics sums
@@ -3559,6 +3699,22 @@ class Runtime:
             return self.memory_records(limit=(payload or {}).get("limit"))
         if op == "get_logs_all":
             return self.get_logs_all(payload)
+        if op == "profile":
+            # Cluster-wide sampling profiler (profiler.py): ("start", hz),
+            # ("stop",), or ("report", {node,pid}).  start/stop broadcast
+            # over pubsub to every subscribed worker; report merges the
+            # pushed tables plus a fresh local snapshot.  None of these
+            # block — the CLI does the sampling-window sleep client-side.
+            action = payload[0]
+            if action == "start":
+                return self.profile_start(payload[1] if len(payload) > 1 else None)
+            if action == "stop":
+                return self.profile_stop()
+            if action == "report":
+                return self.profile_report(
+                    **(payload[1] if len(payload) > 1 and payload[1] else {})
+                )
+            raise ValueError(f"unknown profile action {action!r}")
         if op == "state_list":
             # Attachable state API (util/state.py): --address clients and
             # the dashboard route list_* verbs here and get the head's
@@ -3577,6 +3733,7 @@ class Runtime:
                 "summarize_tasks": _state_api.summarize_tasks,
                 "cluster_metrics": _state_api.cluster_metrics,
                 "spans": _state_api.list_spans,
+                "task_summary": _state_api.task_summary,
             }
             fn = fns.get(verb)
             if fn is None:
@@ -3585,10 +3742,15 @@ class Runtime:
         if op == "timeline":
             # Merged chrome-trace timeline (`ray_tpu timeline` from an
             # attached driver): task rows + clock-corrected spans from
-            # every process of the cluster.
+            # every process of the cluster.  The optional payload is a
+            # window ({"last": seconds} / {"since": epoch-seconds}) so
+            # the export is bounded by the span ring, not a full dump.
             from ray_tpu.dashboard import timeline as _timeline
 
-            return _timeline()
+            window = payload if isinstance(payload, dict) else {}
+            return _timeline(
+                last=window.get("last"), since=window.get("since")
+            )
         raise ValueError(f"unknown op {op}")
 
     def _req_resolve_actor(self, wid: str, req_id: int, actor_id: str,
@@ -4073,6 +4235,7 @@ class Runtime:
         rec.unmet_deps -= 1
         if rec.unmet_deps <= 0 and rec.state == "PENDING":
             rec.state = "READY"
+            rec.stamp("queued")
             self.ready_queue.append(tid)
 
     def _object_ready(self, oid: str) -> None:
@@ -4166,6 +4329,7 @@ class Runtime:
             rec.unmet_deps = unmet
             if unmet == 0:
                 rec.state = "READY"
+                rec.stamp("queued")
                 self.ready_queue.append(spec.task_id)
             self._dispatch()
         return return_ids
@@ -4223,6 +4387,7 @@ class Runtime:
             return
         rec.state = "RUNNING"
         rec.start_time = time.time()
+        rec.stages["leased"] = rec.start_time
         rec.worker_id = h.worker_id
         rec.node_id = h.node_id
         ar.in_flight[rec.spec.task_id] = None
@@ -4231,6 +4396,8 @@ class Runtime:
             blob = self.state.get_function(rec.spec.fn_id)
             h.known_fns.add(rec.spec.fn_id)
         self._send(h, ("task", rec.spec, blob))
+        if h.conn is not None:
+            rec.stamp("pushed")  # else: stamped at the pending-send flush
 
     # ------------------------------------------------------------------
     # dispatch loop (ray: cluster_task_manager.h + local_task_manager.h)
@@ -4314,6 +4481,7 @@ class Runtime:
         h = self._lease_worker(node, spec)
         rec.state = "RUNNING"
         rec.start_time = time.time()
+        rec.stages["leased"] = rec.start_time
         rec.node_id = node
         rec.worker_id = h.worker_id
         h.current_task = tid
@@ -4334,6 +4502,11 @@ class Runtime:
             h.known_fns.add(spec.fn_id)
         kind = "create_actor" if spec.is_actor_creation else "task"
         self._send(h, (kind, spec, blob))
+        if h.conn is not None:
+            # A still-starting worker queues the frame in pending_sends;
+            # the handshake flush stamps "pushed" then — so the lease
+            # stage honestly carries the worker's whole boot time.
+            rec.stamp("pushed")
 
     # ------------------------------------------------------------------
     # completion / failure
@@ -4358,7 +4531,8 @@ class Runtime:
         ar.placement = None
 
     @_locked
-    def _on_task_done(self, wid: str, task_id: str, results, error_blob) -> None:
+    def _on_task_done(self, wid: str, task_id: str, results, error_blob,
+                      timing=None) -> None:
         # caller holds self.lock
         rec = self.tasks.pop(task_id, None)
         h = self.workers.get(wid)
@@ -4372,9 +4546,21 @@ class Runtime:
                         self._decref_local(c)
             return
         spec = rec.spec
-        if error_blob is None:
-            self._record_task_end(rec, wid, "FINISHED")
-        elif not (spec.retry_exceptions and spec.attempt < spec.max_retries):
+        # Executor-side stage stamps (recv/start/end wall clock) land on
+        # the head clock via the handshake-estimated per-conn offset —
+        # the same correction task_events/spans get at ingest.
+        if isinstance(timing, dict):
+            off = self.clock_offsets.get(wid, 0.0)
+            for src, dst in (
+                ("recv", "received"), ("start", "running"), ("end", "exec_done"),
+            ):
+                v = timing.get(src)
+                if isinstance(v, (int, float)):
+                    rec.stages[dst] = v + off
+        rec.stamp("done")
+        if error_blob is not None and not (
+            spec.retry_exceptions and spec.attempt < spec.max_retries
+        ):
             # Only FINAL failures count — a retried attempt is not a failed
             # task (tasks_retried tracks attempts).
             self._record_task_end(rec, wid, "FAILED")
@@ -4403,6 +4589,11 @@ class Runtime:
                         # no journal).
                         self._inline_lineage.add(oid)
                         self._journal_append(("lineage", oid, spec))
+            # Results stored + lineage recorded: the lifecycle record is
+            # complete — stamp "sealed" and fold the stage durations into
+            # the ring + histograms (the per-task state machine's fold).
+            rec.stamp("sealed")
+            self._record_task_end(rec, wid, "FINISHED")
             if spec.is_actor_creation:
                 self._on_actor_alive(spec.actor_id)
         else:
@@ -4451,6 +4642,10 @@ class Runtime:
         spec = rec.spec
         spec.attempt += 1
         self.metrics["tasks_retried"] += 1
+        # A fresh attempt restarts the stage machine (stale executor/done
+        # stamps from the failed attempt would disorder the telescoping);
+        # the original submit time is kept so total wall stays honest.
+        rec.stages = {"submit": rec.stages.get("submit", time.time())}
         if spec.actor_id is not None and not spec.is_actor_creation:
             # Relayed actor-call retry: re-push to the actor's executor
             # (the plain ready queue would lease a stateless worker and
@@ -4471,6 +4666,7 @@ class Runtime:
         if h is not None and h.state == "busy":
             self._return_worker(h)
         rec.state = "READY"
+        rec.stamp("queued")
         rec.node_id = rec.worker_id = None
         self.tasks[spec.task_id] = rec
         self.ready_queue.append(spec.task_id)
@@ -4522,9 +4718,12 @@ class Runtime:
                 self._decref_local(c)
 
     def _record_task_end(self, rec, wid, state: str) -> None:
+        from ray_tpu._private import telemetry as _telemetry
+
         spec = rec.spec
         self.metrics["tasks_finished" if state == "FINISHED" else "tasks_failed"] += 1
         end = time.time()
+        durations = _telemetry.stage_durations(rec.stages)
         self.task_events.append(
             {
                 "task_id": spec.task_id,
@@ -4537,8 +4736,27 @@ class Runtime:
                 "attempt": spec.attempt,
                 "end_time": end,
                 "duration": (end - rec.start_time) if rec.start_time else 0.0,
+                "creation": spec.is_actor_creation,
+                "stages": dict(rec.stages),
+                "durations": durations,
             }
         )
+        self._observe_stage_durations(durations)
+
+    def _observe_stage_durations(self, durations) -> None:
+        """Fold one task's per-stage seconds into the
+        task_stage_seconds{stage=...} histograms (never raises — the
+        fold must not take the completion path down)."""
+        if not durations:
+            return
+        try:
+            from ray_tpu._private import telemetry as _telemetry
+
+            hist = _telemetry.task_stage_histogram()
+            for stage, v in durations.items():
+                hist.observe(v, tags={"stage": stage})
+        except Exception:
+            pass
 
     @_locked
     def _deps_locality(self, deps) -> Dict[str, int]:
@@ -4590,6 +4808,8 @@ class Runtime:
         self.metrics["tasks_retried"] += 1
         self._release_for(rec)
         rec.state = "READY"
+        rec.stages = {"submit": rec.stages.get("submit", time.time())}
+        rec.stamp("queued")
         rec.worker_id = None
         self.ready_queue.append(rec.spec.task_id)
         self._dispatch()
@@ -4606,6 +4826,7 @@ class Runtime:
         # contributing to the cluster aggregate (its own lock; no I/O).
         self.telemetry.forget(wid)
         self.ledger.forget(wid)
+        self.profiles.forget(wid)
         # Ref borrows the dead process still held: park them as DEAD-
         # HOLDER leak suspects (attributed to this worker's node/pid by
         # `ray_tpu memory --leaks`), reclaimed after the grace so the
@@ -4774,6 +4995,7 @@ class Runtime:
             ar.worker_id = None
             rec = TaskRecord(creation)
             rec.state = "READY"
+            rec.stamp("queued")
             self.tasks[creation.task_id] = rec
             self.ready_queue.append(creation.task_id)
             self._dispatch()
@@ -4835,6 +5057,7 @@ class Runtime:
             ar.info.creation_spec = new_spec
             rec = TaskRecord(new_spec)
             rec.state = "READY"
+            rec.stamp("queued")
             self.tasks[new_spec.task_id] = rec
             self.ready_queue.append(new_spec.task_id)
             self._dispatch()
@@ -4880,7 +5103,15 @@ class Runtime:
         _wire.flush_dirty()
         ready = self.store.wait(oids, len(oids), timeout)
         if len(ready) < len(oids):
-            raise GetTimeoutError(f"get timed out after {timeout}s")
+            # Critical path: name the lifecycle stage each pending
+            # producer is stuck in (the attribution plane's one-line
+            # diagnosis for a blocked get).
+            pending = [o for o in oids if o not in set(ready)]
+            detail = self._blocked_get_detail(pending)
+            raise GetTimeoutError(
+                f"get timed out after {timeout}s"
+                + (f"; critical path: {detail}" if detail else "")
+            )
         values = [self._get_one_value(oid, deadline) for oid in oids]
         return values[0] if single else values
 

@@ -92,6 +92,17 @@ def flight_dump(reason: str) -> Optional[str]:
         os.makedirs(d, exist_ok=True)
         with _ring_lock:
             events = list(_ring or ())
+        # The profiler's top stacks ride every dump: a chaos-killed
+        # process records not just what it did but where its time went
+        # (None when nothing was sampled — dumping must never block on
+        # or require the profiler).
+        prof = None
+        try:
+            from ray_tpu._private import profiler as _profiler
+
+            prof = _profiler.flight_snapshot()
+        except Exception:
+            prof = None
         _dump_seq += 1
         path = os.path.join(d, f"flight-{os.getpid()}.jsonl")
         with open(path, "a") as f:
@@ -105,12 +116,24 @@ def flight_dump(reason: str) -> Optional[str]:
                         "t": time.time(),
                         "seq": _dump_seq,
                         "events": len(events),
+                        "prof_stacks": len(prof) if prof else 0,
                     }
                 )
                 + "\n"
             )
             for ev in events:
                 f.write(json.dumps(ev, default=str) + "\n")
+            if prof:
+                f.write(
+                    json.dumps(
+                        {
+                            "kind": "prof_snapshot",
+                            "t": time.time(),
+                            "stacks": [[s, n] for s, n in prof],
+                        }
+                    )
+                    + "\n"
+                )
         return path
     except Exception:
         return None
@@ -157,6 +180,17 @@ def install(tag: Optional[str] = None) -> None:
     global _installed, _proc_tag
     if tag:
         _proc_tag = tag
+    # Sampling-profiler autostart (RAY_TPU_PROF_HZ > 0): every process
+    # entry funnels through install(), so the always-hot mode covers
+    # head, workers, daemons, and io shards with one knob.  Re-checked
+    # per call — forked children re-install under their own tag and the
+    # parent's sampler thread did not survive the fork.
+    try:
+        from ray_tpu._private import profiler as _profiler
+
+        _profiler.maybe_autostart()
+    except Exception:
+        pass
     if _installed:
         return
     _installed = True
@@ -431,6 +465,164 @@ def prometheus_cluster_text(
         lines.append(f"# TYPE {pname} gauge")
         lines.append(f"{pname} {value}")
     return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# task lifecycle attribution: the per-task state machine's pure core
+#
+# ray: gcs_task_manager.h keeps per-task state-transition records (the
+# task_events ring here); PAPERS.md's Dapper lineage argues the useful
+# unit is the STAGE-ATTRIBUTED record, not aggregate counters.  Each
+# TaskRecord carries wall-clock stamps for the stages below (head clock;
+# executor stamps land via the done message, clock-offset-corrected);
+# stage_durations() telescopes them into per-stage seconds, so the sum
+# of durations equals last-stamp minus first-stamp by construction —
+# the ≥95%-accounted acceptance property.
+
+# Stamp order (a task flows left to right; absent stamps are skipped):
+#   submit     submit_task entry (head)
+#   queued     dependencies met, joined the ready queue (head)
+#   leased     worker handle acquired by the dispatcher (head)
+#   pushed     task frame written to a live conn (head)
+#   received   executor dequeued the frame (worker, corrected)
+#   running    executor began user code (worker, corrected)
+#   exec_done  user code returned (worker, corrected)
+#   done       done message landed on the head (head)
+#   sealed     results stored + lineage recorded (head)
+STAGE_ORDER = (
+    "submit", "queued", "leased", "pushed", "received", "running",
+    "exec_done", "done", "sealed",
+)
+
+# Duration labels: time spent BETWEEN stamp X and the next present stamp
+# is attributed to the stage named here (what the task was waiting on).
+STAGE_LABELS = {
+    "submit": "pending",        # dependency wait
+    "queued": "queued",         # scheduler queue
+    "leased": "lease",          # worker acquisition (spawn on a cold pool)
+    "pushed": "wire",           # frame flight + executor pickup
+    "received": "exec_queue",   # executor-side queue behind earlier tasks
+    "running": "running",       # user code
+    "exec_done": "return",      # result flight back (batch linger + decode)
+    "done": "seal",             # head-side store + lineage bookkeeping
+}
+
+
+def stage_durations(stages: Dict[str, float]) -> Dict[str, float]:
+    """Telescoped per-stage seconds from a stamp dict (pure).  Negative
+    gaps (clock-offset estimation error across processes) clamp to 0 —
+    the clamped time reappears in the next head-side stage, so the total
+    stays within the offset error of wall time."""
+    present = [
+        (s, stages[s])
+        for s in STAGE_ORDER
+        if isinstance(stages.get(s), (int, float))
+    ]
+    out: Dict[str, float] = {}
+    for (s0, t0), (_s1, t1) in zip(present, present[1:]):
+        out[STAGE_LABELS.get(s0, s0)] = round(max(t1 - t0, 0.0), 6)
+    return out
+
+
+def stage_wall_seconds(stages: Dict[str, float]) -> float:
+    """First-to-last stamped wall time (the denominator of the
+    accounted-fraction acceptance check)."""
+    ts = [
+        stages[s] for s in STAGE_ORDER
+        if isinstance(stages.get(s), (int, float))
+    ]
+    return max(ts[-1] - ts[0], 0.0) if len(ts) >= 2 else 0.0
+
+
+_STAGE_HIST = None
+
+
+def task_stage_histogram():
+    """`task_stage_seconds{stage=...}` — the head observes every finished
+    task's per-stage durations here; the cluster aggregate renders it on
+    /metrics.  Lazy: only the process folding task records registers it."""
+    global _STAGE_HIST
+    if _STAGE_HIST is None:
+        from ray_tpu.util.metrics import Histogram
+
+        _STAGE_HIST = Histogram(
+            "task_stage_seconds",
+            "per-task time spent in each lifecycle stage "
+            "(submit→queued→leased→pushed→running→done→sealed machine)",
+            boundaries=[0.0005, 0.002, 0.01, 0.05, 0.2, 1.0, 5.0, 30.0],
+            tag_keys=("stage",),
+        )
+    return _STAGE_HIST
+
+
+def summarize_task_events(
+    events: List[Dict[str, Any]],
+    live: Optional[List[Dict[str, Any]]] = None,
+    slow: int = 10,
+) -> Dict[str, Any]:
+    """Fold task records into the `ray_tpu tasks --summary` body (pure):
+    per-stage totals + percentiles, the accounted-vs-wall fraction, state
+    counts, and the N slowest tasks with their stage breakdowns."""
+    per_stage: Dict[str, List[float]] = {}
+    states: Dict[str, int] = {}
+    wall_total = 0.0
+    accounted_total = 0.0
+    rows: List[Dict[str, Any]] = []
+    for e in events:
+        states[e.get("state", "?")] = states.get(e.get("state", "?"), 0) + 1
+        durs = e.get("durations") or {}
+        stages = e.get("stages") or {}
+        wall = stage_wall_seconds(stages) or float(e.get("duration") or 0.0)
+        acc = sum(durs.values())
+        wall_total += wall
+        accounted_total += acc
+        for k, v in durs.items():
+            per_stage.setdefault(k, []).append(float(v))
+        rows.append(
+            {
+                "task_id": e.get("task_id"),
+                "name": e.get("name"),
+                "state": e.get("state"),
+                "wall_s": round(wall, 6),
+                "durations": durs,
+                "creation": bool(e.get("creation")),
+                "critical_stage": (
+                    max(durs, key=durs.get) if durs else None
+                ),
+            }
+        )
+    for t in live or ():
+        states[t.get("state", "?")] = states.get(t.get("state", "?"), 0) + 1
+
+    def _pct(xs: List[float], q: float) -> float:
+        if not xs:
+            return 0.0
+        xs = sorted(xs)
+        return xs[min(int(q * (len(xs) - 1) + 0.5), len(xs) - 1)]
+
+    stage_stats = {
+        k: {
+            "count": len(v),
+            "total_s": round(sum(v), 6),
+            "mean_s": round(sum(v) / len(v), 6),
+            "p50_s": round(_pct(v, 0.50), 6),
+            "p95_s": round(_pct(v, 0.95), 6),
+            "p99_s": round(_pct(v, 0.99), 6),
+        }
+        for k, v in sorted(per_stage.items())
+    }
+    rows.sort(key=lambda r: -r["wall_s"])
+    return {
+        "tasks": len(events),
+        "states": states,
+        "stages": stage_stats,
+        "wall_s_total": round(wall_total, 6),
+        "accounted_s_total": round(accounted_total, 6),
+        "accounted_fraction": (
+            round(accounted_total / wall_total, 4) if wall_total else None
+        ),
+        "slow": rows[: max(slow, 0)],
+    }
 
 
 # ---------------------------------------------------------------------------

@@ -104,7 +104,10 @@ SCHEMAS: Dict[str, Tuple[int, Optional[int], tuple]] = {
     "ready": (3, 7, (str, int)),
     "actor_announce": (1, 1, (list,)),
     "env_failed": (2, 2, (str, str)),
-    "done": (3, 3, (str,)),
+    # done's optional 4th extra field is the executor-side stage timing
+    # ({"recv","start","end"} wall-clock stamps) the head folds into the
+    # task's lifecycle record (clock-offset-corrected at ingest).
+    "done": (3, 4, (str,)),
     "refop": (2, 2, (str, str)),
     "req": (3, 3, (int, str)),
     "object_copied": (2, 2, (str, int)),
@@ -127,6 +130,10 @@ SCHEMAS: Dict[str, Tuple[int, Optional[int], tuple]] = {
     # ownership) — the worker leg of the object ledger (`ray_tpu memory`),
     # droppable like metrics_push.
     "refs_push": (1, 1, (dict,)),
+    # Periodic per-process collapsed-stack table (profiler.py snapshot,
+    # cumulative since start) — the worker leg of `ray_tpu profile`.
+    # Droppable like metrics_push: a lost push costs freshness only.
+    "prof_push": (1, 1, (dict,)),
     # head io-shard fabric (io_shard.py): the internal channel between the
     # head process and its io-shard processes.  shard_fwd carries a conn's
     # decoded control messages IN ORDER (the list is the order they came

@@ -882,6 +882,11 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
         head recv loop and every peer connection)."""
         nonlocal pool
         spec: TaskSpec = msg[1]
+        # Lifecycle stamp: when this executor dequeued the frame — the
+        # "received" stage of the task state machine (one attribute set;
+        # TaskSpec is a plain dataclass, the rider never hits the wire
+        # twice because the spec is executed, not forwarded).
+        spec._recv_t = time.time()
         if spec.max_concurrency > 1 and not spec.is_actor_creation:
             from concurrent.futures import ThreadPoolExecutor
 
@@ -917,6 +922,7 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
         rt.oneway(("task_events", batch), droppable=True)
 
     def record_peer_task_event(spec, err_blob, t0: float, t1: float) -> None:
+        recv_t = getattr(spec, "_recv_t", None) or t0
         with events_lock:
             events_buf.append(
                 {
@@ -931,6 +937,16 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
                     "end_time": t1,
                     "duration": t1 - t0,
                     "direct": True,
+                    # Executor-side stage attribution for direct tasks
+                    # (the head sees no dispatch for these, so the
+                    # exec-queue + run split is all it can know).
+                    "stages": {
+                        "received": recv_t, "running": t0, "exec_done": t1,
+                    },
+                    "durations": {
+                        "exec_queue": round(max(t0 - recv_t, 0.0), 6),
+                        "running": round(max(t1 - t0, 0.0), 6),
+                    },
                 }
             )
             full = len(events_buf) >= 64
@@ -947,16 +963,32 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
     rt.task_event_sink = _sink_event
     ready_sent = threading.Event()
 
+    def _on_prof_ctl(_key, action, *args) -> None:
+        """Cluster profiler broadcast handler ("profiler"/"ctl" pubsub):
+        start/stop the local sampler; a stop pushes the final table
+        immediately so the head's report window closes tight."""
+        from ray_tpu._private import profiler as _profiler
+
+        if action == "start":
+            _profiler.start(args[0] if args else None)
+        elif action == "stop":
+            _profiler.stop()
+            rt.oneway(
+                ("prof_push", _profiler.snapshot_payload()), droppable=True
+            )
+
     def _events_ticker() -> None:
         import time as _time
 
         from ray_tpu._private import config as _cfg2
+        from ray_tpu._private import profiler as _profiler
         from ray_tpu._private import telemetry as _telemetry
 
         report_wire = bool(_cfg2.get("wire_stats"))
         push_s = max(_cfg2.get("metrics_push_ms"), 0) / 1000.0
         push_refs = bool(_cfg2.get("refs_push"))
         last_push = 0.0
+        prof_subscribed = False
         while True:
             _time.sleep(0.5)
             if not ready_sent.is_set():
@@ -966,6 +998,15 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
                 # slow runtime-env setup would sever the very conn the
                 # env_failed report needs.
                 continue
+            if not prof_subscribed:
+                # One subscription per worker, armed only after the ready
+                # hello: profiler start/stop broadcasts now reach this
+                # process for its whole life.
+                prof_subscribed = True
+                try:
+                    rt.subscribe("profiler", "ctl", _on_prof_ctl)
+                except OSError:
+                    prof_subscribed = False  # head away: retry next beat
             flush_task_events()
             if report_wire:
                 rt.oneway(("wire_stats", wire.stats()), droppable=True)
@@ -984,6 +1025,15 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
                     # never competes with seals/refops for the backlog.
                     rt.oneway(
                         ("refs_push", rt.ref_table_snapshot()),
+                        droppable=True,
+                    )
+                if _profiler.ENABLED and _profiler.running():
+                    # Collapsed-stack push (the worker leg of the cluster
+                    # flamegraph): cumulative table, so a dropped push
+                    # costs freshness only.  Gated on the module bool —
+                    # profiler off costs exactly this one check.
+                    rt.oneway(
+                        ("prof_push", _profiler.snapshot_payload()),
                         droppable=True,
                     )
             # Telemetry rides the next linger/idle flush; nudge it here so
@@ -1127,6 +1177,17 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
             # actor_exit oneway was already sent by exit_actor()).
             os._exit(0)
         if reply is None:
+            # Executor-side stage stamps ride the done message (schema
+            # arity 4): recv = frame dequeued, start/end = user code.
+            # The head lands them on its clock via the handshake offset
+            # and folds them into the task's lifecycle record.
+            done = done + (
+                {
+                    "recv": getattr(spec, "_recv_t", None) or t0,
+                    "start": t0,
+                    "end": _time.time(),
+                },
+            )
             try:
                 with conn_lock:
                     rt.conn.send(done)

@@ -19,9 +19,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 
-def timeline() -> list:
+def timeline(last=None, since=None) -> list:
     """Chrome-trace events from the runtime's task-event sink
-    (ray: `ray timeline` exports the same catapult format)."""
+    (ray: `ray timeline` exports the same catapult format).  `last` /
+    `since` bound the export to a trailing window / an absolute start
+    (CLI --last/--since; default window via RAY_TPU_TIMELINE_LAST_S)."""
     from ray_tpu._private.runtime import get_runtime
 
     rt = get_runtime()
@@ -73,7 +75,13 @@ def timeline() -> list:
                 },
             }
         )
-    return out
+    from ray_tpu._private import config as _config
+    from ray_tpu.util.tracing import window_chrome_events
+
+    if last is None and since is None:
+        default_last = _config.get("timeline_last_s")
+        last = default_last if default_last > 0 else None
+    return window_chrome_events(out, last=last, since=since)
 
 
 def _events_endpoint(query=None):
@@ -129,6 +137,63 @@ def _memory_endpoint(query=None):
     return out
 
 
+def _timeline_endpoint(query=None):
+    """Windowed timeline: ?last=SECONDS / ?since=EPOCH bound the export
+    by the event/span rings instead of dumping everything."""
+    q = query or {}
+
+    def _num(name):
+        try:
+            v = (q.get(name) or [None])[0]
+            return float(v) if v is not None else None
+        except (TypeError, ValueError):
+            return None
+
+    return timeline(last=_num("last"), since=_num("since"))
+
+
+def _profile_endpoint(query=None):
+    """Cluster flamegraph (profiler.py).  ?seconds=N runs a sampling
+    window inline (start → sleep → stop — each HTTP request gets its own
+    thread, so blocking here is fine); without it, reports whatever the
+    sink already holds (e.g. an always-hot RAY_TPU_PROF_HZ run).
+    ?node= / ?pid= filter; ?hz= tunes the rate."""
+    import time as _time
+
+    from ray_tpu.util import state as state_api
+
+    q = query or {}
+
+    def _one(name, cast=str):
+        v = (q.get(name) or [None])[0]
+        if v is None:
+            return None
+        try:
+            return cast(v)
+        except (TypeError, ValueError):
+            return None
+
+    seconds = _one("seconds", float)
+    if seconds:
+        state_api.profile_start(hz=_one("hz", float))
+        _time.sleep(min(max(seconds, 0.1), 120.0))
+        state_api.profile_stop()
+        _time.sleep(0.7)  # one ticker beat: final worker pushes land
+    return state_api.profile_report(node=_one("node"), pid=_one("pid", int))
+
+
+def _task_summary_endpoint(query=None):
+    """Stage-attributed task summary (?slow=N bounds the slow list)."""
+    from ray_tpu.util import state as state_api
+
+    q = query or {}
+    try:
+        slow = int((q.get("slow") or [10])[0])
+    except (TypeError, ValueError):
+        slow = 10
+    return state_api.task_summary(slow=slow)
+
+
 def _logs_endpoint(worker=None, tail: int = 0, query=None):
     """Per-worker captured output (ray: dashboard log index + `ray logs`).
     Without ?worker=, lists workers that have log lines."""
@@ -158,11 +223,13 @@ class Dashboard:
             "/api/placement_groups": state_api.list_placement_groups,
             "/api/metrics": state_api.cluster_metrics,
             "/api/summary": state_api.summarize_tasks,
-            "/api/timeline": timeline,
+            "/api/timeline": _timeline_endpoint,
             "/api/logs": _logs_endpoint,
             "/api/events": _events_endpoint,
             "/api/telemetry": _telemetry_endpoint,
             "/api/memory": _memory_endpoint,
+            "/api/profile": _profile_endpoint,
+            "/api/task_summary": _task_summary_endpoint,
         }
 
         def _prometheus() -> str:
@@ -289,6 +356,7 @@ _INDEX_HTML = """<!doctype html>
 <code>/api/placement_groups</code> <code>/api/metrics</code>
 <code>/api/summary</code> <code>/api/timeline</code> <code>/api/logs</code>
 <code>/api/telemetry</code> <code>/api/memory</code>
+<code>/api/profile</code> <code>/api/task_summary</code>
 <code>/metrics</code> (Prometheus)</p>
 <script>
 function row(cells, tag){const tr=document.createElement('tr');

@@ -148,14 +148,92 @@ def cmd_timeline(args) -> int:
 
     wr = _init_maybe_attached(args)
     out = args.output or "timeline.json"
-    events = wr.request("timeline", None) if wr is not None else timeline()
+    window = {"last": args.last, "since": args.since}
+    if wr is not None:
+        events = wr.request("timeline", window)
+    else:
+        events = timeline(**window)
     with open(out, "w") as f:
         json.dump(events, f)
     pids = {e.get("pid") for e in events}
-    print(
-        f"wrote {out}: {len(events)} events across {len(pids)} processes "
-        "(open in chrome://tracing or Perfetto)"
+    bound = (
+        f" (window: --since {args.since})" if args.since
+        else f" (window: last {args.last}s)" if args.last
+        else ""
     )
+    print(
+        f"wrote {out}: {len(events)} events across {len(pids)} processes"
+        f"{bound} (open in chrome://tracing or Perfetto)"
+    )
+    return 0
+
+
+def cmd_profile(args) -> int:
+    """`ray_tpu profile`: cluster-wide sampling flamegraph — broadcast
+    start, sample for --seconds, broadcast stop, merge every process's
+    pushed collapsed-stack table (+ the head's own), write --flame
+    out.txt (collapsed) or out.svg (self-contained flamegraph)."""
+    import time as _time
+
+    from ray_tpu._private import profiler as _profiler
+    from ray_tpu.util import state as state_api
+
+    _init_maybe_attached(args)
+    started = state_api.profile_start(hz=args.hz)
+    _time.sleep(max(args.seconds, 0.1))
+    state_api.profile_stop()
+    # One ticker beat so the workers' final prof_push oneways land.
+    _time.sleep(0.7)
+    report = state_api.profile_report(node=args.node, pid=args.pid)
+    samples = report.get("samples") or {}
+    if args.flame:
+        if args.flame.endswith(".svg"):
+            body = _profiler.flamegraph_svg(
+                samples, title=f"ray_tpu profile ({args.seconds}s "
+                f"@ {started.get('hz')}Hz)"
+            )
+        else:
+            body = _profiler.folded_text(samples)
+        with open(args.flame, "w") as f:
+            f.write(body)
+        print(f"wrote {args.flame}: {len(samples)} stacks")
+    top = sorted(samples.items(), key=lambda kv: -kv[1])[: args.top]
+    print(
+        json.dumps(
+            {
+                "hz": started.get("hz"),
+                "seconds": args.seconds,
+                "total_samples": report.get("total_samples"),
+                "pids": report.get("pids"),
+                "processes": report.get("processes"),
+                "top_stacks": [{"stack": s, "samples": n} for s, n in top],
+            },
+            indent=1,
+            default=str,
+        )
+    )
+    return 0
+
+
+def cmd_tasks(args) -> int:
+    """`ray_tpu tasks`: per-task lifecycle attribution — stage-duration
+    percentiles, accounted fraction, the --slow N slowest tasks with
+    their per-stage breakdown + critical stage, and live tasks with the
+    stage each is stuck in."""
+    from ray_tpu.util import state as state_api
+
+    _init_maybe_attached(args)
+    out = state_api.task_summary(slow=args.slow)
+    if args.summary:
+        out = {
+            k: out[k]
+            for k in (
+                "tasks", "states", "stages", "wall_s_total",
+                "accounted_s_total", "accounted_fraction",
+            )
+            if k in out
+        }
+    print(json.dumps(out, indent=1, default=str))
     return 0
 
 
@@ -378,8 +456,50 @@ def main(argv=None) -> int:
         "timeline", help="export the merged chrome-trace cluster timeline"
     )
     tl.add_argument("--output", "-o")
+    tl.add_argument(
+        "--last", type=float, default=None, metavar="SECONDS",
+        help="only events from the trailing window (bounded export)",
+    )
+    tl.add_argument(
+        "--since", type=float, default=None, metavar="TS",
+        help="only events ending at/after this epoch timestamp",
+    )
     tl.add_argument("--address", help="head.json path or ray:// URL (attached mode)")
     tl.set_defaults(fn=cmd_timeline)
+
+    pf = sub.add_parser(
+        "profile", help="cluster-wide sampling flamegraph (profiler.py)"
+    )
+    pf.add_argument(
+        "--seconds", type=float, default=5.0, help="sampling window"
+    )
+    pf.add_argument(
+        "--hz", type=float, default=None,
+        help="sampling rate (default: profiler.DEFAULT_HZ)",
+    )
+    pf.add_argument("--node", help="filter the merge to one node id")
+    pf.add_argument("--pid", type=int, help="filter the merge to one pid")
+    pf.add_argument(
+        "--flame", metavar="OUT",
+        help="write the merged flamegraph: *.txt = collapsed stacks, "
+        "*.svg = self-contained flamegraph",
+    )
+    pf.add_argument("--top", type=int, default=15, help="top stacks printed")
+    pf.add_argument("--address", help="head.json path or ray:// URL (attached mode)")
+    pf.set_defaults(fn=cmd_profile)
+
+    tk = sub.add_parser(
+        "tasks", help="per-task lifecycle attribution (stage durations)"
+    )
+    tk.add_argument(
+        "--slow", type=int, default=10, help="N slowest tasks listed"
+    )
+    tk.add_argument(
+        "--summary", action="store_true",
+        help="aggregate stage stats only (no per-task rows)",
+    )
+    tk.add_argument("--address", help="head.json path or ray:// URL (attached mode)")
+    tk.set_defaults(fn=cmd_tasks)
 
     js = sub.add_parser("job", help="submit a job and stream its logs")
     js.add_argument("entrypoint", nargs="+")

@@ -77,17 +77,20 @@ async def _read_request(reader) -> Optional[Tuple[str, str, dict, bytes]]:
     return method, target, headers, body
 
 
-def _json_response(code: int, payload, keep_alive: bool) -> bytes:
+def _json_response(code: int, payload, keep_alive: bool,
+                   request_id: str = "") -> bytes:
     try:
         data = json.dumps(payload).encode()
     except TypeError:
         data = json.dumps({"result": repr(payload)}).encode()
     reason = {200: "OK", 404: "Not Found", 500: "Internal Server Error",
               503: "Service Unavailable"}.get(code, "OK")
+    rid_header = f"X-Request-Id: {request_id}\r\n" if request_id else ""
     head = (
         f"HTTP/1.1 {code} {reason}\r\n"
         f"Content-Type: application/json\r\n"
         f"Content-Length: {len(data)}\r\n"
+        f"{rid_header}"
         f"Connection: {'keep-alive' if keep_alive else 'close'}\r\n\r\n"
     ).encode("latin-1")
     return head + data
@@ -223,20 +226,45 @@ class HTTPProxy:
         loop = asyncio.get_running_loop()
         if stream:
             return await self._stream_reply(writer, loop, deployment, args)
+        # Request tracing (ROADMAP item 3's p99 debugging leg): ONE
+        # request id — the trace id — spans proxy → router → replica, so
+        # the merged timeline renders each serve request as a single
+        # parented span tree.  The span's context is passed EXPLICITLY to
+        # the executor-pool resolve (contextvars don't cross
+        # run_in_executor), and the id returns as X-Request-Id.
+        from ray_tpu.util import tracing
+
+        span_cm = ctx = None
+        if tracing.is_enabled():
+            span_cm = tracing.span(
+                "serve::request",
+                attrs={"deployment": deployment, "method": method},
+            )
+            ctx = span_cm.__enter__()
+        rid = (ctx or {}).get("trace_id", "")
         try:
             out = await loop.run_in_executor(
-                self._pool, self._resolve, deployment, args
+                self._pool, self._resolve, deployment, args, ctx
             )
         except Exception as e:  # noqa: BLE001 — HTTP boundary
-            writer.write(_json_response(500, {"error": str(e)}, keep))
+            writer.write(
+                _json_response(500, {"error": str(e)}, keep, request_id=rid)
+            )
             await writer.drain()
             return True
-        writer.write(_json_response(200, {"result": out}, keep))
+        finally:
+            if span_cm is not None:
+                span_cm.__exit__(None, None, None)
+        writer.write(
+            _json_response(200, {"result": out}, keep, request_id=rid)
+        )
         await writer.drain()
         return True
 
-    def _resolve(self, deployment: str, args: tuple):
-        ref = self._router.assign_request(deployment, "__call__", args, {})
+    def _resolve(self, deployment: str, args: tuple, trace_parent=None):
+        ref = self._router.assign_request(
+            deployment, "__call__", args, {}, trace_parent=trace_parent
+        )
         return ray_tpu.get(ref, timeout=60)
 
     async def _stream_reply(self, writer, loop, deployment: str, args: tuple) -> bool:

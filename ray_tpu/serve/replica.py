@@ -77,6 +77,8 @@ class Replica:
     def handle_request(self, method_name: str, args: tuple, kwargs: dict):
         """Execute one request.  Called concurrently from the actor's
         thread pool (one slot per in-flight query)."""
+        from ray_tpu.util import tracing
+
         t0 = time.perf_counter()
         outcome = "error"
         with self._lock:
@@ -87,11 +89,22 @@ class Replica:
                 fn = self._callable
             else:
                 fn = getattr(self._callable, method_name or "__call__")
-            out = fn(*args, **(kwargs or {}))
-            if inspect.iscoroutine(out):
-                import asyncio
+            # The request span tree's leaf: parents to the ambient
+            # run::handle_request span, which carries the proxy's trace
+            # id via the spec's trace_ctx — one parented tree per serve
+            # request in the merged timeline.
+            with tracing.span(
+                "serve::replica",
+                attrs={
+                    "deployment": self._deployment_name,
+                    "replica": self._replica_id,
+                },
+            ):
+                out = fn(*args, **(kwargs or {}))
+                if inspect.iscoroutine(out):
+                    import asyncio
 
-                out = asyncio.run(out)
+                    out = asyncio.run(out)
             outcome = "ok"
             return out
         finally:

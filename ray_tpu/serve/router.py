@@ -217,13 +217,20 @@ class Router:
 
     def assign_request(
         self, deployment: str, method_name: str, args: tuple, kwargs: dict,
-        stream: bool = False,
+        stream: bool = False, trace_parent=None,
     ):
         """Pick a replica and submit; returns the result ObjectRef (or a
         replica-sticky stream handle when stream=True).  Blocking
         backpressure happens on the replica set's OWN lock — the router
         lock is only held for map lookups, so the long-poll push can land
-        while callers wait for capacity."""
+        while callers wait for capacity.
+
+        trace_parent: the proxy's serve::request span context — the
+        routing decision records as a child span, and the remote submit
+        inside it stamps the spec's trace_ctx, so the replica's run span
+        parents into the SAME request tree (one request id end to end)."""
+        from ray_tpu.util import tracing
+
         with self._lock:
             rs = self._sets.get(deployment)
         if rs is None or not rs.has_replicas():
@@ -234,15 +241,19 @@ class Router:
                 rs = self._sets.get(deployment)
             if rs is None or not rs.has_replicas():
                 raise RuntimeError(f"deployment {deployment!r} has no replicas")
-        rid, handle = rs.assign()
-        if stream:
-            token = _StreamToken()
-            sid_ref = handle.stream_start.remote(method_name, args, kwargs)
-            rs.record(rid, token)  # live stream counts as in-flight
-            return _StreamIterator(handle, sid_ref, token=token)
-        ref = handle.handle_request.remote(method_name, args, kwargs)
-        rs.record(rid, ref)
-        return ref
+        with tracing.span(
+            "serve::route", parent=trace_parent,
+            attrs={"deployment": deployment},
+        ):
+            rid, handle = rs.assign()
+            if stream:
+                token = _StreamToken()
+                sid_ref = handle.stream_start.remote(method_name, args, kwargs)
+                rs.record(rid, token)  # live stream counts as in-flight
+                return _StreamIterator(handle, sid_ref, token=token)
+            ref = handle.handle_request.remote(method_name, args, kwargs)
+            rs.record(rid, ref)
+            return ref
 
 
 class _StreamIterator:

@@ -307,6 +307,56 @@ def memory_summary(
     return _rt().memory_summary(**payload)
 
 
+def task_summary(slow: int = 10) -> Dict[str, Any]:
+    """Per-task lifecycle attribution (`ray_tpu tasks`): stage-duration
+    stats (p50/p95/p99 per stage), the accounted-vs-wall fraction, and
+    the N slowest tasks with their stage breakdowns + critical stage —
+    plus currently-live tasks with the stage each is stuck in.  The fold
+    runs over the head's finished-task ring (runtime.task_events, the
+    gcs_task_manager ring analogue) upgraded into a per-task state
+    machine (telemetry.STAGE_ORDER)."""
+    out, routed = _attached_request("task_summary", {"slow": slow})
+    if routed:
+        return out
+    return _rt().task_summary_local(slow=slow)
+
+
+def profile_start(hz: Optional[float] = None) -> Dict[str, Any]:
+    """Start the sampling profiler CLUSTER-WIDE (head locally + a pubsub
+    broadcast to every worker).  Returns {"hz": effective}."""
+    from ray_tpu._private.worker_proc import get_worker_runtime
+
+    wr = get_worker_runtime()
+    if wr is not None:
+        return wr.request("profile", ("start", hz))
+    return _rt().profile_start(hz)
+
+
+def profile_stop() -> Dict[str, Any]:
+    """Stop cluster-wide sampling (workers push their final tables)."""
+    from ray_tpu._private.worker_proc import get_worker_runtime
+
+    wr = get_worker_runtime()
+    if wr is not None:
+        return wr.request("profile", ("stop",))
+    return _rt().profile_stop()
+
+
+def profile_report(
+    node: Optional[str] = None, pid: Optional[int] = None
+) -> Dict[str, Any]:
+    """Merged cluster flamegraph: summed collapsed-stack tables from
+    every pushed process + the head's own, with per-process attribution
+    rows ({"samples", "processes", "pids", "total_samples"})."""
+    from ray_tpu._private.worker_proc import get_worker_runtime
+
+    payload = {"node": node, "pid": pid}
+    wr = get_worker_runtime()
+    if wr is not None:
+        return wr.request("profile", ("report", payload))
+    return _rt().profile_report(**payload)
+
+
 def list_object_refs(limit: int = 1000) -> List[Dict[str, Any]]:
     """Per-object ledger records: size, location, copies, owner refcount,
     holders (process/node/pid/count/creation site), age, leak verdict —

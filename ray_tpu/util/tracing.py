@@ -176,6 +176,36 @@ def merge_process_spans(
     return out
 
 
+def window_chrome_events(
+    events: List[Dict[str, Any]],
+    last: Optional[float] = None,
+    since: Optional[float] = None,
+    now: Optional[float] = None,
+) -> List[Dict[str, Any]]:
+    """Bound a chrome-trace event list to a time window (pure core of
+    `ray_tpu timeline --last SECONDS / --since TS`).
+
+    `last` = keep events whose END falls within the trailing window of
+    that many seconds; `since` = keep events ending at/after that epoch
+    timestamp (seconds).  `since` wins when both are given; neither
+    returns the input unchanged.  Events carry `ts` (µs) and optionally
+    `dur` (µs) — an event straddling the cutoff is KEPT (its tail is in
+    the window; truncating would misrepresent a long-running span)."""
+    if since is None and not last:
+        return events
+    now = time.time() if now is None else now
+    cutoff_us = (since if since is not None else now - float(last)) * 1e6
+    out = []
+    for e in events:
+        ts = e.get("ts")
+        if not isinstance(ts, (int, float)):
+            out.append(e)  # malformed/clockless rows stay visible
+            continue
+        if ts + (e.get("dur") or 0) >= cutoff_us:
+            out.append(e)
+    return out
+
+
 def spans_to_chrome_trace(spans: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     """Chrome-trace 'X' events for `ray_tpu timeline`-style viewing."""
     return [

@@ -443,6 +443,7 @@ def run_soak(
             "RAY_TPU_FLIGHT_DIR",
             "RAY_TPU_METRICS_PUSH_MS",
             "RAY_TPU_HEAD_IO_SHARDS",
+            "RAY_TPU_PROF_HZ",
         )
     }
     os.environ["RAY_TPU_FAULT_SPEC"] = spec
@@ -462,6 +463,11 @@ def run_soak(
     os.environ["RAY_TPU_TRACE"] = "1"
     os.environ["RAY_TPU_FLIGHT_DIR"] = flight_dir
     os.environ.setdefault("RAY_TPU_METRICS_PUSH_MS", "1000")
+    # ISSUE 10: the sampling profiler runs HOT through the whole soak in
+    # every process (head, workers, daemon, io shards autostart via
+    # telemetry.install) — head/shard kills must not wedge it, and every
+    # crash dump carries the victim's last collapsed-stack snapshot.
+    os.environ.setdefault("RAY_TPU_PROF_HZ", "25")
     watchdog_dir = os.path.join(workdir, "watchdog")
     if watch_locks:
         # Lock watchdog on across EVERY process of the soak cluster
@@ -720,6 +726,21 @@ def run_soak(
         assert shard_dumps, (
             "shard.forward kill clause never fired — no io-shard flight "
             "dump found (is the sharded fabric actually on?)"
+        )
+        # ISSUE 10 acceptance: the profiler sampled through the chaos —
+        # crash dumps carry collapsed-stack snapshots (prof_stacks > 0 in
+        # the dump header), so a killed process records where its time
+        # went, not just what it did.
+        all_dumps = _telemetry.collect_dumps(flight_dir)
+        prof_dumps = [d for d in all_dumps if d.get("prof_stacks", 0) > 0]
+        report["profiler"] = {
+            "hz": float(os.environ.get("RAY_TPU_PROF_HZ", "0")),
+            "dumps_with_prof_snapshot": len(prof_dumps),
+            "dumps_total": len(all_dumps),
+        }
+        assert prof_dumps, (
+            "profiler ran hot through the soak but no flight dump carries "
+            "a collapsed-stack snapshot (prof_stacks == 0 everywhere)"
         )
         report["result"] = "PASS"
         return report

@@ -31,6 +31,7 @@ from ray_tpu._private.analysis import run_analysis  # noqa: E402
 from ray_tpu._private.analysis import allowlist as allowlist_mod  # noqa: E402
 from ray_tpu._private.analysis import fault_registry  # noqa: E402
 from ray_tpu._private.analysis import metric_names  # noqa: E402
+from ray_tpu._private.analysis import span_names  # noqa: E402
 from ray_tpu._private.analysis.common import iter_py_files  # noqa: E402
 
 DEFAULT_ALLOWLIST = os.path.join(
@@ -41,6 +42,9 @@ DEFAULT_CATALOG = os.path.join(
 )
 DEFAULT_METRIC_CATALOG = os.path.join(
     _REPO_ROOT, "ray_tpu", "_private", "analysis", "metric_names.txt"
+)
+DEFAULT_SPAN_CATALOG = os.path.join(
+    _REPO_ROOT, "ray_tpu", "_private", "analysis", "span_names.txt"
 )
 
 
@@ -58,6 +62,7 @@ def main(argv=None) -> int:
     ap.add_argument("--allowlist", default=DEFAULT_ALLOWLIST)
     ap.add_argument("--catalog", default=DEFAULT_CATALOG)
     ap.add_argument("--metric-catalog", default=DEFAULT_METRIC_CATALOG)
+    ap.add_argument("--span-catalog", default=DEFAULT_SPAN_CATALOG)
     ap.add_argument(
         "--no-catalog-check", action="store_true",
         help="skip the generated-catalog staleness checks (fixture trees)",
@@ -77,6 +82,7 @@ def main(argv=None) -> int:
         allowlist_path=args.allowlist,
         catalog_path=None if args.no_catalog_check else args.catalog,
         metric_catalog_path=None if args.no_catalog_check else args.metric_catalog,
+        span_catalog_path=None if args.no_catalog_check else args.span_catalog,
     )
 
     if args.fix_allowlist:
@@ -85,6 +91,8 @@ def main(argv=None) -> int:
         fault_registry.write_catalog(points, args.catalog)
         metrics = metric_names.collect_metrics(files)
         metric_names.write_catalog(metrics, args.metric_catalog)
+        spans = span_names.collect_spans(files)
+        span_names.write_catalog(spans, args.span_catalog)
         # Catalog staleness violations are cured by the rewrites above, so
         # they never become allowlist entries.
         keys = sorted(
@@ -93,6 +101,7 @@ def main(argv=None) -> int:
                 for v in result.violations
                 if not v.key.startswith("fault-registry:catalog:")
                 and not v.key.startswith("metric-names:catalog:")
+                and not v.key.startswith("span-names:catalog:")
             }
         )
         existing = result.allowlist
@@ -106,13 +115,15 @@ def main(argv=None) -> int:
         print(
             f"catalog: {len(metrics)} metric names -> {args.metric_catalog}"
         )
+        print(f"catalog: {len(spans)} span names -> {args.span_catalog}")
         return 0
 
     by_pass = {}
     for v in result.violations:
         by_pass.setdefault(v.pass_name, []).append(v)
     for pass_name in ("blocking-under-lock", "lock-order", "fault-registry",
-                      "hot-send", "gcs-mutation", "metric-names"):
+                      "hot-send", "gcs-mutation", "metric-names",
+                      "span-names"):
         vs = by_pass.get(pass_name, [])
         new = [v for v in vs if v.key not in result.allowlist]
         print(

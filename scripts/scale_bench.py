@@ -131,6 +131,95 @@ def bench_many_objects(k: int) -> dict:
     }
 
 
+def bench_actor_churn(
+    n_live: int, waves: int, wave_size: int, traffic_actors: int = 4
+) -> dict:
+    """ROADMAP item 2's churn scenario: create/kill waves against a live
+    actor pool WHILE background traffic keeps calling survivors — the
+    many_actors shape measures a quiet cluster, this one measures
+    creation under load.  Creation latency is attributed PER STAGE from
+    the new task-lifecycle records (`util/state.task_summary`): the
+    report says whether a slow wave spent its time queued, leasing
+    (worker spawn), or running __init__ — the evidence the actors/s hunt
+    starts from, instead of one opaque wall number."""
+    import threading
+
+    import ray_tpu
+    from ray_tpu.util import state as state_api
+
+    @ray_tpu.remote(num_cpus=0.001)
+    class Churn:
+        def ping(self):
+            return 1
+
+    # Steady pool + background traffic over it.
+    pool = [Churn.remote() for _ in range(n_live)]
+    ray_tpu.get([a.ping.remote() for a in pool], timeout=600)
+    stop = threading.Event()
+    traffic_calls = [0]
+
+    def _traffic():
+        i = 0
+        while not stop.is_set():
+            batch = [
+                pool[(i + j) % len(pool)].ping.remote()
+                for j in range(traffic_actors)
+            ]
+            try:
+                ray_tpu.get(batch, timeout=120)
+            except Exception:
+                pass  # a killed actor mid-wave: traffic keeps going
+            traffic_calls[0] += len(batch)
+            i += traffic_actors
+
+    t = threading.Thread(target=_traffic, daemon=True)
+    t.start()
+
+    wave_lat: list = []
+    t0 = time.monotonic()
+    for _w in range(waves):
+        w0 = time.monotonic()
+        fresh = [Churn.remote() for _ in range(wave_size)]
+        ray_tpu.get([a.ping.remote() for a in fresh], timeout=600)
+        wave_lat.append(time.monotonic() - w0)
+        # Kill the oldest wave-size actors; the fresh ones replace them.
+        victims, pool = pool[:wave_size], pool[wave_size:] + fresh
+    churn_dt = time.monotonic() - t0
+    stop.set()
+    t.join(timeout=30)
+
+    # Per-stage creation latency from the attribution plane: only
+    # actor-creation records (event["creation"]) from this run's window.
+    summary = state_api.task_summary(slow=2000)
+    creations = [r for r in summary["slow"] if r.get("creation")]
+    stage_tot: dict = {}
+    for r in creations:
+        for k, v in (r["durations"] or {}).items():
+            stage_tot.setdefault(k, []).append(v)
+    per_stage = {
+        k: {
+            "mean_s": round(sum(v) / len(v), 6),
+            "p95_s": round(sorted(v)[int(0.95 * (len(v) - 1))], 6),
+            "n": len(v),
+        }
+        for k, v in sorted(stage_tot.items())
+    }
+    for a in pool:
+        ray_tpu.kill(a)
+    created = waves * wave_size
+    return {
+        "live_pool": n_live,
+        "waves": waves,
+        "wave_size": wave_size,
+        "created_under_load": created,
+        "churn_creations_per_s": round(created / churn_dt, 1),
+        "wave_latency_s": [round(x, 3) for x in wave_lat],
+        "traffic_calls_during_churn": traffic_calls[0],
+        "creation_stage_latency": per_stage,
+        "creation_records_seen": len(creations),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--actors", type=int, default=1000)
@@ -141,6 +230,15 @@ def main(argv=None) -> int:
     ap.add_argument("--pgs", type=int, default=200)
     ap.add_argument("--objects", type=int, default=10000)
     ap.add_argument("--skip-broadcast", action="store_true")
+    ap.add_argument(
+        "--churn", action="store_true",
+        help="ONLY the churn scenario: create/kill waves under live "
+             "traffic, per-stage creation latency from task_summary",
+    )
+    ap.add_argument("--churn-live", type=int, default=60,
+                    help="steady actor pool size during churn")
+    ap.add_argument("--churn-waves", type=int, default=5)
+    ap.add_argument("--churn-wave-size", type=int, default=20)
     ap.add_argument("--output", default=None)
     args = ap.parse_args(argv)
 
@@ -157,6 +255,18 @@ def main(argv=None) -> int:
             "64-node clusters (release/benchmarks/README.md)"
         ),
     }
+    if args.churn:
+        out["actor_churn"] = bench_actor_churn(
+            args.churn_live, args.churn_waves, args.churn_wave_size
+        )
+        print(json.dumps({"actor_churn": out["actor_churn"]}), flush=True)
+        ray_tpu.shutdown()
+        line = json.dumps(out)
+        print(line)
+        if args.output:
+            with open(args.output, "w") as f:
+                f.write(line + "\n")
+        return 0
     out["many_tasks"] = bench_many_tasks(args.tasks)
     print(json.dumps({"many_tasks": out["many_tasks"]}), flush=True)
     out["many_objects"] = bench_many_objects(args.objects)

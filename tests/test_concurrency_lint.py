@@ -442,6 +442,7 @@ def test_cli_fails_on_seeded_violation(tmp_path):
         "--allowlist", allow,
         "--catalog", str(tmp_path / "catalog.txt"),
         "--metric-catalog", str(tmp_path / "metric_names.txt"),
+        "--span-catalog", str(tmp_path / "span_names.txt"),
         "--no-catalog-check",
     ]
     assert ray_tpu_lint.main(args) == 1
@@ -579,6 +580,89 @@ def test_committed_metric_catalog_matches_tree():
     assert actual == set(committed)
     # The serve replica telemetry metrics are registered.
     assert any(n.startswith("serve_replica_queue_depth") for n in committed)
+    # The task-attribution histogram is registered (ISSUE 10).
+    assert "task_stage_seconds Histogram" in committed
+
+
+# ---------------------------------------------------------------------------
+# pass 7: span-names (literal tracing.span registry + catalog)
+
+
+def test_span_names_collects_literals_and_skips_dynamic(tmp_path):
+    from ray_tpu._private.analysis import span_names
+
+    p = _write(
+        tmp_path,
+        "sp1.py",
+        """
+        from ray_tpu.util import tracing
+        from ray_tpu.util.tracing import span
+
+        def a(name):
+            with tracing.span("fixture::alpha", attrs={"k": 1}):
+                pass
+            with span("fixture::beta"):
+                pass
+            with tracing.span(f"run::{name}"):  # dynamic: skipped
+                pass
+        """,
+    )
+    got = span_names.collect_spans([(p, "sp1.py")])
+    assert sorted(got) == ["fixture::alpha", "fixture::beta"]
+    assert got["fixture::alpha"][0].startswith("sp1.py:")
+
+
+def test_span_names_flags_duplicates(tmp_path):
+    from ray_tpu._private.analysis import span_names
+
+    p1 = _write(
+        tmp_path, "sd1.py",
+        'from ray_tpu.util.tracing import span\n'
+        'def f():\n    with span("fixture::dup"):\n        pass\n',
+    )
+    p2 = _write(
+        tmp_path, "sd2.py",
+        'from ray_tpu.util import tracing\n'
+        'def g():\n    with tracing.span("fixture::dup"):\n        pass\n',
+    )
+    got = span_names.collect_spans([(p1, "sd1.py"), (p2, "sd2.py")])
+    found = span_names.check_duplicates(got)
+    assert len(found) == 1
+    assert found[0].key == "span-names:dup:fixture::dup"
+    assert "sd1.py" in found[0].message and "sd2.py" in found[0].message
+
+
+def test_span_names_catalog_staleness_and_regen(tmp_path):
+    from ray_tpu._private.analysis import span_names
+
+    p = _write(
+        tmp_path, "sc.py",
+        'from ray_tpu.util.tracing import span\n'
+        'def f():\n    with span("fixture::cat"):\n        pass\n',
+    )
+    got = span_names.collect_spans([(p, "sc.py")])
+    catalog = str(tmp_path / "span_names.txt")
+    assert span_names.check_catalog(got, catalog)  # missing -> stale
+    span_names.write_catalog(got, catalog)
+    assert span_names.check_catalog(got, catalog) == []
+    got["fixture::new"] = ["sc.py:99"]
+    stale = span_names.check_catalog(got, catalog)
+    assert stale and "fixture::new" in stale[0].message
+    assert stale[0].key.startswith("span-names:catalog:")
+
+
+def test_committed_span_catalog_matches_tree():
+    from ray_tpu._private.analysis import span_names
+
+    files = iter_py_files(os.path.join(REPO, "ray_tpu"))
+    got = span_names.collect_spans(files)
+    committed = span_names.load_catalog(
+        os.path.join(REPO, "ray_tpu", "_private", "analysis", "span_names.txt")
+    )
+    assert set(got) == set(committed)
+    # The serve request-tracing spans are cataloged (ISSUE 10 satellite).
+    for name in ("serve::request", "serve::route", "serve::replica"):
+        assert name in committed
 
 
 # ---------------------------------------------------------------------------

@@ -153,6 +153,119 @@ def test_worker_metrics_push_aggregates_on_head(telemetry_env):
         _shutdown()
 
 
+def _parse_prometheus_strict(body: str):
+    """Strict exposition-format checker (the satellite acceptance): every
+    line is a comment, blank, or `name{labels} value`; TYPE declared
+    before its series; histogram buckets monotone with le=+Inf == count;
+    no duplicate series lines.  Returns {series_name: [(labels, value)]}."""
+    import re
+
+    series = {}
+    typed = {}
+    seen_lines = set()
+    name_re = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? (.+)$")
+    for line in body.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            continue
+        if line.startswith("# TYPE "):
+            _hash, _t, name, mtype = line.split(" ", 3)
+            assert name not in typed, f"duplicate TYPE for {name}"
+            typed[name] = mtype
+            continue
+        assert not line.startswith("#"), f"unknown comment shape: {line!r}"
+        m = name_re.match(line)
+        assert m, f"unparseable series line: {line!r}"
+        name, labels, value = m.group(1), m.group(2) or "", m.group(3)
+        float(value)  # must parse
+        key = (name, labels)
+        assert key not in seen_lines, f"duplicate series: {line!r}"
+        seen_lines.add(key)
+        # every series belongs to a declared family (histogram series
+        # attach to their base name)
+        base = name
+        for suffix in ("_bucket", "_sum", "_count", "_total"):
+            if name.endswith(suffix):
+                base = name[: -len(suffix)]
+                break
+        assert name in typed or base in typed or f"{base}_total" in typed, (
+            f"series {name!r} has no TYPE declaration"
+        )
+        series.setdefault(name, []).append((labels, float(value)))
+    return series, typed
+
+
+def _assert_histogram_buckets_monotone(series, base_name):
+    import re
+
+    buckets = series.get(f"{base_name}_bucket", [])
+    assert buckets, f"no {base_name}_bucket series"
+    by_tags = {}
+    for labels, value in buckets:
+        le_m = re.search(r'le="([^"]+)"', labels)
+        assert le_m, f"bucket without le label: {labels}"
+        rest = re.sub(r'(,?)le="[^"]+"(,?)', "", labels)
+        by_tags.setdefault(rest, []).append((le_m.group(1), value))
+    counts = dict(series.get(f"{base_name}_count", []))
+    for rest, bl in by_tags.items():
+        ordered = sorted(
+            bl, key=lambda kv: float("inf") if kv[0] == "+Inf" else float(kv[0])
+        )
+        values = [v for _le, v in ordered]
+        assert values == sorted(values), (
+            f"{base_name} buckets not monotone for {rest}: {ordered}"
+        )
+        assert ordered[-1][0] == "+Inf", f"missing +Inf bucket for {rest}"
+
+
+def test_prometheus_output_strictly_parseable_with_task_stages(telemetry_env):
+    """Satellite acceptance: /metrics is STRICTLY parseable — HELP/TYPE
+    lines, histogram bucket monotonicity, no duplicate series — and the
+    new task_stage_seconds family appears once tasks have run."""
+    import urllib.request
+
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    try:
+        @ray_tpu.remote
+        def f(x):
+            return x + 1
+
+        assert ray_tpu.get([f.remote(i) for i in range(4)], timeout=60) == [
+            1, 2, 3, 4,
+        ]
+        ray_tpu.get(_record_metrics.remote(2), timeout=60)
+        dash = start_dashboard()
+        try:
+            deadline = time.time() + 15
+            body = ""
+            while time.time() < deadline:
+                body = (
+                    urllib.request.urlopen(f"{dash.url}/metrics", timeout=10)
+                    .read()
+                    .decode()
+                )
+                if "task_stage_seconds" in body and "telemetry_test_lat" in body:
+                    break
+                time.sleep(0.2)
+        finally:
+            stop_dashboard()
+        series, typed = _parse_prometheus_strict(body)
+        assert typed.get("task_stage_seconds") == "histogram", sorted(typed)
+        _assert_histogram_buckets_monotone(series, "task_stage_seconds")
+        _assert_histogram_buckets_monotone(series, "telemetry_test_lat")
+        # the family is stage-tagged and counted something
+        stage_counts = series.get("task_stage_seconds_count", [])
+        assert any('stage="running"' in labels for labels, _v in stage_counts), (
+            stage_counts
+        )
+        assert sum(v for _l, v in stage_counts) >= 4
+    finally:
+        _shutdown()
+
+
 def test_prometheus_endpoint_serves_pushed_worker_metrics(telemetry_env):
     """The dashboard /metrics body includes metrics recorded in WORKER
     processes — the cluster aggregate, not just the head registry."""
