@@ -46,6 +46,7 @@ from ray_tpu._private.analysis import (
     fault_registry,
     gcs_mutation,
     hot_send,
+    journal_coverage,
     lock_order,
     metric_names,
     span_names,
@@ -58,6 +59,7 @@ PASSES = (
     "fault-registry",
     "hot-send",
     "gcs-mutation",
+    "journal-coverage",
     "metric-names",
     "span-names",
 )
@@ -102,6 +104,7 @@ def run_analysis(
         violations.extend(lock_order.scan_file(path, rel))
         violations.extend(hot_send.scan_file(path, rel))
         violations.extend(gcs_mutation.scan_file(path, rel))
+        violations.extend(journal_coverage.scan_file(path, rel))
         violations.extend(metric_names.scan_file(path, rel))
     points = fault_registry.collect_points(files)
     if catalog_path is not None:

@@ -289,8 +289,21 @@ class CoreClient:
 client = CoreClient()
 
 
+_EMPTY_ARGS_BLOB = None
+
+
 def build_args_blob(args: tuple, kwargs: dict):
     """Serialize call args; returns (packed_blob, contained_ids, top_level_dep_ids)."""
+    global _EMPTY_ARGS_BLOB
+    if not args and not kwargs:
+        # No-arg calls (fan-outs of nullary tasks are a whole bench shape)
+        # share one immutable pre-packed blob instead of re-serializing
+        # ((), {}) per call.
+        blob = _EMPTY_ARGS_BLOB
+        if blob is None:
+            payload, buffers, _ = ser.serialize(((), {}))
+            blob = _EMPTY_ARGS_BLOB = bytes(ser.pack(payload, buffers))
+        return blob, [], []
     payload, buffers, contained = ser.serialize((args, kwargs))
     deps = [a.id for a in args if isinstance(a, ObjectRef)]
     deps += [v.id for v in kwargs.values() if isinstance(v, ObjectRef)]

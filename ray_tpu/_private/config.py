@@ -219,6 +219,62 @@ _DEFS: Dict[str, tuple] = {
         "fire-and-forget frames never wait longer than ~this (blocking "
         "paths flush explicitly and never wait at all)",
     ),
+    "wire_native": (
+        1, int,
+        "1 = encode the hot control-frame kinds (task push, done, refop, "
+        "metrics/refs/prof pushes, shard forwards) with the struct-framed "
+        "native codec (wire_native.py: marshal data tuples, no pickle, "
+        "~14x cheaper per TaskSpec); 0 = pickle every frame (the v2 "
+        "behavior).  Negotiated by the protocol-version fence; kinds "
+        "without a native codec fall back to pickle per frame either way",
+    ),
+    "lease_pipeline_depth": (
+        0, int,
+        "caller-side direct transport: unacked tasks one worker lease "
+        "pipelines before another worker is leased; 0 = auto "
+        "(max(4, 64/cpus) — deep pipelining onto few executors wins on "
+        "small hosts, fan-out wins on many-core; resolved at process "
+        "start)",
+    ),
+    "lease_max_per_key": (
+        0, int,
+        "caller-side direct transport: max worker leases one scheduling "
+        "key holds; 0 = auto (min(8, cpus), floor 1; resolved at process "
+        "start)",
+    ),
+    "task_lease_idle_s": (
+        2.0, float,
+        "head-side lease reuse: how long a worker leased to a scheduling "
+        "key (fn + resource shape + strategy) stays bound after its last "
+        "same-key task before the lease is revoked and the worker "
+        "returns to the shared pool (ray: "
+        "worker_lease_timeout_milliseconds + direct_task_transport.h:40 "
+        "lease reuse keyed by SchedulingKey)",
+    ),
+    "gcs_journal_flush_us": (
+        500, int,
+        "journal group-commit linger: mutation entries accumulate for up "
+        "to this many microseconds (or _BATCH_BYTES) and flush as ONE "
+        "buffered write — the BatchingConn size/linger discipline applied "
+        "to the journal file.  0 = write-per-append (the pre-batching "
+        "behavior); a SIGKILL can lose at most the unflushed window, the "
+        "same contract wire linger has",
+    ),
+    "gcs_journal_batch_bytes": (
+        64 * 1024, int,
+        "journal group-commit size trigger: pending entry bytes at which "
+        "the batch flushes immediately instead of waiting for the linger",
+    ),
+    "ready_queue_spill_after": (
+        100000, int,
+        "head ready-queue backlog (tasks) beyond which newly-submitted "
+        "dependency-free plain tasks spill their specs to a disk segment "
+        "next to the GCS snapshot instead of living in head memory; "
+        "reloaded in dispatch-order chunks as the backlog drains.  Bounds "
+        "head RSS under a 1M-task backlog (the reference absorbs the same "
+        "backlog through its distributed raylet queues); 0 disables "
+        "spilling",
+    ),
     "wire_stats": (
         0, int,
         "1 = expose per-process wire counters (logical frames, physical "
@@ -361,10 +417,14 @@ _DEFS: Dict[str, tuple] = {
     ),
 }
 
-# Back-compat env names from before the knob table existed.
+# Back-compat env names from before the knob table existed, plus the
+# short spellings the docs use for the fast-path knobs.
 _ENV_ALIASES: Dict[str, tuple] = {
     "lineage_max_entries": ("RAY_TPU_LINEAGE_MAX",),
     "lineage_max_bytes": ("RAY_TPU_LINEAGE_MAX_BYTES",),
+    "task_lease_idle_s": ("RAY_TPU_LEASE_IDLE_S",),
+    "gcs_journal_flush_us": ("RAY_TPU_JOURNAL_FLUSH_US",),
+    "gcs_journal_batch_bytes": ("RAY_TPU_JOURNAL_BATCH_BYTES",),
 }
 
 _lock = threading.Lock()

@@ -111,6 +111,10 @@ class GlobalState:
         # gcs-mutation pass enforces that these tables are only ever
         # written through this module.
         self.journal_hook: Optional[Callable[[tuple], None]] = None
+        # Fired (outside the table lock) after every function export:
+        # the runtime releases lineage re-executions parked on a pending
+        # function-export fence (see Runtime._reconstruct).
+        self.on_function_export: Optional[Callable[[str], None]] = None
         # Cluster-event channels on the SHARED pubsub abstraction
         # (ray: src/ray/pubsub/publisher.h:298 — same Publisher the
         # runtime's object-ready plane and serve's long-poll use).
@@ -164,6 +168,11 @@ class GlobalState:
                 return  # re-export of the same blob: don't re-journal it
             self.functions[fn_id] = blob
             self._journal(("function", fn_id, blob))
+        hook = self.on_function_export
+        if hook is not None:
+            # OUTSIDE the table lock: the hook takes the runtime lock and
+            # the global order is runtime.lock -> state.lock.
+            hook(fn_id)
 
     def import_functions(self, functions: Dict[str, bytes]) -> None:
         """Restore-path bulk load (snapshot merge) — NOT journaled: the

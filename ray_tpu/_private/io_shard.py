@@ -87,7 +87,15 @@ class ShardConnProxy:
         if self._closed or not self.shard.alive:
             raise OSError(f"io shard {self.shard.idx} no longer owns conn "
                           f"{self.conn_id}")
-        self.shard.ctl_conn.send(("shard_send", self.conn_id, obj))
+        from ray_tpu._private import wire
+
+        # Encode ONCE here (native codec or pickle): the shard writes the
+        # body straight onto the peer socket without decoding — the v2
+        # fabric pickled the message twice (once into shard_send, again
+        # at the shard's re-send) and unpickled it once in between.
+        self.shard.ctl_conn.send(
+            ("shard_send", self.conn_id, wire.encode_body(obj))
+        )
 
     def flush(self) -> None:
         """Push queued shard_send frames now (the ctl channel is a
@@ -317,40 +325,68 @@ class _ShardServer:
     # -- inbound: conn -> head --------------------------------------------
 
     def _drain_conn(self, conn) -> None:
+        from ray_tpu._private import wire, wire_native
+
         conn_id = self.conn_ids.get(conn)
         if conn_id is None:
             return
         eof = False
-        msgs: List[Any] = []
+        bodies: List[bytes] = []
+        kinds: List[Any] = []
+        # recv_bodies: raw sub-frame bodies, NO unpickle.  Native bodies
+        # (the hot kinds) forward head-ward untouched — the head's marshal
+        # decode is the only decode they ever get.  Pickled bodies (cold
+        # kinds, pre-v3 shapes) still decode + schema-validate HERE, on
+        # the shard pid, exactly like the v2 fabric — the expensive decode
+        # never lands on the single-writer head.
         try:
-            msgs.append(conn.recv())
-            while len(msgs) < _DRAIN_CAP and conn.poll(0):
-                msgs.append(conn.recv())
-            while conn.pending_frames():
-                msgs.append(conn.recv())
+            reads = 0
+            while True:
+                for body in conn.recv_bodies():
+                    nk = wire_native.kind_of(body)
+                    if nk is None:
+                        try:
+                            obj = wire.decode_body(body)
+                        except wire.ProtocolError:
+                            # Garbage-speaking peer: treat like a dead one
+                            # (the decoded prefix still forwards).
+                            eof = True
+                            break
+                        nk = _kind(obj)
+                        body = wire.encode_body(obj)
+                    if faults.ENABLED and faults.point(
+                        "wire.recv", key=nk
+                    ) == "drop":
+                        # Per-sub-frame drop semantics, preserved across
+                        # the raw-forward path (the head does not re-fire
+                        # wire.recv for forwarded bodies).
+                        continue
+                    bodies.append(body)
+                    kinds.append(nk)
+                reads += 1
+                if eof or reads >= _DRAIN_CAP or not conn.poll(0):
+                    break
         except (EOFError, OSError):
-            # ProtocolError subclasses ConnectionError: a garbage-speaking
-            # peer drops like a dead one, after its decoded prefix lands.
             eof = True
-        if msgs:
-            self._forward(conn_id, msgs)
+        if bodies:
+            self._forward(conn_id, bodies, kinds[0])
         if eof:
             self._close_conn(conn_id, report=True)
 
-    def _forward(self, conn_id: str, msgs: List[Any]) -> None:
+    def _forward(self, conn_id: str, bodies: List[bytes], first_kind) -> None:
         if faults.ENABLED:
             # drop = the forwarded batch is lost shard-side (peers'
             # retry/reconnect budgets must absorb it, like a wire drop);
             # crash = the soak's shard-kill: die with decoded frames in
             # hand — the conn fds die with us, peers reconnect.
-            if faults.point("shard.forward", key=_kind(msgs[0])) == "drop":
+            if faults.point("shard.forward", key=first_kind) == "drop":
                 return
         try:
-            self.ctl_conn.send(("shard_fwd", conn_id, msgs))
+            self.ctl_conn.send(("shard_fwd", conn_id, bodies))
         except OSError:
             self._head_gone()
             return
-        self.c_forwarded.inc(float(len(msgs)))
+        self.c_forwarded.inc(float(len(bodies)))
         self.c_fwd_batches.inc()
 
     # -- outbound: head -> conn -------------------------------------------
@@ -374,7 +410,10 @@ class _ShardServer:
             elif msg[0] == "shutdown":
                 raise SystemExit(0)
 
-    def _deliver(self, conn_id: str, msg: Any) -> None:
+    def _deliver(self, conn_id: str, body: bytes) -> None:
+        """Write one head-encoded BODY to the owned conn — zero decode on
+        the shard (the head already ran the codec; shard_send carries
+        bytes)."""
         from ray_tpu._private import config as _config
 
         conn = self.owned.get(conn_id)
@@ -383,10 +422,10 @@ class _ShardServer:
                 conn_id,
                 (time.monotonic() + _config.get("io_shard_pending_send_s"), []),
             )
-            queued.append(msg)
+            queued.append(body)
             return
         try:
-            conn.send(msg)
+            conn.send_body(body)
         except OSError:
             # Dead socket discovered at send: same as an EOF on read.
             self._close_conn(conn_id, report=True)

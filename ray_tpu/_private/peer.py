@@ -117,9 +117,17 @@ class PeerServer:
             return None  # bad handshake from a stranger: keep serving
 
     def _serve_conn(self, conn) -> None:
+        from ray_tpu._private import wire as _wire
+
         reply = PeerReply(conn)
         while True:
             try:
+                if not conn.pending_frames() and not conn.poll(0):
+                    # Flush-before-blocking-wait: pdone frames from
+                    # inline-executed tasks (worker_proc.peer_handler)
+                    # coalesce while more pcalls are queued and go out
+                    # the moment this conn would park.
+                    _wire.flush_dirty()
                 msg = conn.recv()
             except (OSError, EOFError):
                 try:
@@ -316,9 +324,30 @@ class Lease:
 
 # How many unacked tasks one lease pipelines before another worker is
 # leased, how many workers one key may hold, and how long an idle lease is
-# kept before being returned to the head's pool.
-_LEASE_PIPELINE = 4
-_LEASE_MAX_PER_KEY = 8
+# kept before being returned to the head's pool.  Defaults ADAPT to the
+# host's parallelism: on a many-core machine, spreading a burst across
+# workers buys real concurrency (the reference pipelines 4 deep and fans
+# out); on a 1-2 vCPU host the same fan-out only multiplies processes
+# fighting for the one core — pipelining DEEP onto few executors measured
+# ~25% faster multi-client throughput with a third the context-switch
+# churn.  RAY_TPU_LEASE_PIPELINE_DEPTH / RAY_TPU_LEASE_MAX_PER_KEY
+# override (0 = auto).
+def _lease_tuning():
+    import os as _os
+
+    from ray_tpu._private import config as _config
+
+    cpus = _os.cpu_count() or 1
+    depth = _config.get("lease_pipeline_depth")
+    per_key = _config.get("lease_max_per_key")
+    if depth <= 0:
+        depth = max(4, 64 // cpus)
+    if per_key <= 0:
+        per_key = max(1, min(8, cpus))
+    return depth, per_key
+
+
+_LEASE_PIPELINE, _LEASE_MAX_PER_KEY = _lease_tuning()
 _LEASE_IDLE_RETURN_S = 2.0
 
 

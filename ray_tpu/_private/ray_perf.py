@@ -46,9 +46,13 @@ def timeit(name: str, fn: Callable[[], int], warmup: int = 1, repeat: int = 3):
             from ray_tpu._private.runtime import get_runtime
 
             rt = get_runtime()
-            if getattr(rt, "_journal", None) is None:
+            j = getattr(rt, "_journal", None)
+            if j is None:
                 return None
-            return (rt.metrics["journal_appends"], rt.metrics["journal_fsyncs"])
+            # Flush so the physical-write count reflects the timed work
+            # (a pending group-commit batch would undercount).
+            j.flush()
+            return (j.writes, j.fsyncs, j.entries)
         except Exception:
             return None
 
@@ -78,10 +82,58 @@ def timeit(name: str, fn: Callable[[], int], warmup: int = 1, repeat: int = 3):
         out["frames_per_op"] = round(
             (w1["logical_frames"] - w0["logical_frames"]) / total_ops, 3
         )
+        # Codec split (this process): pickle bodies per op is the
+        # native-codec acceptance counter — deterministic, unlike ops/s.
+        out["pickle_codecs_per_op"] = round(
+            (
+                w1["pickle_encodes"] + w1["pickle_decodes"]
+                - w0["pickle_encodes"] - w0["pickle_decodes"]
+            ) / total_ops, 3
+        )
         if j0 is not None and j1 is not None:
+            # journal_appends = PHYSICAL writes (group-committed);
+            # journal_entries = logical mutations.  Their ratio is the
+            # group-commit factor.
             out["journal_appends_per_op"] = round((j1[0] - j0[0]) / total_ops, 3)
             out["journal_fsyncs_per_op"] = round((j1[1] - j0[1]) / total_ops, 3)
+            out["journal_entries_per_op"] = round((j1[2] - j0[2]) / total_ops, 3)
     return out
+
+
+def host_shape() -> Dict:
+    """Self-describing host header for every BENCH json: cpu count, load
+    average at the run, and the cgroup cpu quota when one applies — a
+    1-vCPU artifact must SAY it is one (BENCH_shard_r1's honesty note,
+    promoted into the data)."""
+    import os as _os
+
+    shape: Dict = {"nproc": _os.cpu_count()}
+    try:
+        shape["loadavg_1m"], shape["loadavg_5m"], shape["loadavg_15m"] = (
+            round(x, 2) for x in _os.getloadavg()
+        )
+    except OSError:
+        pass
+    # cgroup v2 then v1: quota/period -> effective cores; "max" = no cap.
+    try:
+        with open("/sys/fs/cgroup/cpu.max") as f:
+            quota, period = f.read().split()
+            if quota != "max":
+                shape["cgroup_cpus"] = round(int(quota) / int(period), 2)
+            else:
+                shape["cgroup_cpus"] = None
+    except OSError:
+        try:
+            with open("/sys/fs/cgroup/cpu/cpu.cfs_quota_us") as f:
+                quota = int(f.read())
+            with open("/sys/fs/cgroup/cpu/cpu.cfs_period_us") as f:
+                period = int(f.read())
+            shape["cgroup_cpus"] = (
+                round(quota / period, 2) if quota > 0 else None
+            )
+        except OSError:
+            pass
+    return shape
 
 
 def _enable_local_persistence() -> None:
@@ -720,6 +772,7 @@ def shard_sweep(out_path=None, shard_counts=(0, 1, 2, 4), rounds: int = 3):
         _config._reset_for_tests()
     report = {
         "name": "multi_client_tasks_async_shard_sweep",
+        "host": host_shape(),
         "host_nproc": _os.cpu_count(),
         "note": (
             "median-of-%d per point, fresh cluster per point.  HONESTY: "
@@ -813,7 +866,7 @@ def main(argv=None):
     results = [
         {
             "name": "host_note",
-            "nproc": _os.cpu_count(),
+            **host_shape(),
             "note": (
                 "ops_per_s is the MEDIAN of the 3 runs ('runs' lists all); "
                 "writes_per_op / frames_per_op are this process's wire-"

@@ -86,6 +86,11 @@ def _locked(method):
     return wrapper
 
 
+# How long a lineage re-execution waits on a pending function-export
+# fence before its parked gets fail loudly (see _reconstruct).
+_FN_FENCE_TIMEOUT_S = 30.0
+
+
 def _runtime_env_key(renv) -> object:
     """Worker-pool identity of a runtime env: workers are only shared
     between tasks whose env_vars AND code packages match."""
@@ -311,6 +316,90 @@ class WorkerHandle:
         self.idle_since = 0.0
 
 
+class _ReadySpill:
+    """Disk overflow segment for the ready queue: beyond the
+    ready_queue_spill_after backlog, dependency-free plain specs live as
+    length-framed pickles in ONE append-only file and reload in FIFO
+    chunks as the in-memory backlog drains.  This is what bounds head RSS
+    under a 1M-task backlog (a TaskRecord+spec is ~1KB resident; the
+    reference absorbs the same backlog across its distributed raylet
+    queues — a single-node head needs disk).
+
+    Same-session only: the file dies with the head (spilled overflow
+    tasks are NOT in the snapshot's in-flight cap — a client retrying
+    across a head bounce re-submits them, the same at-least-once contract
+    lease-dispatched direct tasks already carry)."""
+
+    __slots__ = ("path", "_w", "_roff", "count", "appended", "loaded")
+
+    def __init__(self, path: str):
+        self.path = path
+        self._w = None       # lazily-opened append handle (buffered)
+        self._roff = 0       # read offset: everything before it was loaded
+        self.count = 0       # frames on disk not yet loaded
+        self.appended = 0    # lifetime counters (bench/telemetry surface)
+        self.loaded = 0
+
+    def append(self, spec) -> None:
+        import pickle as _pickle
+        import struct as _struct
+
+        if self._w is None:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            self._w = open(self.path, "ab")
+        blob = _pickle.dumps(spec, protocol=5)
+        self._w.write(_struct.pack("<I", len(blob)) + blob)
+        self.count += 1
+        self.appended += 1
+
+    def load(self, n: int) -> List[Any]:
+        """Next n specs in FIFO order; resets the file once drained so a
+        long-lived head doesn't grow an unbounded tombstone prefix."""
+        import pickle as _pickle
+        import struct as _struct
+
+        if self.count <= 0 or self._w is None:
+            return []
+        self._w.flush()
+        out: List[Any] = []
+        with open(self.path, "rb") as r:
+            r.seek(self._roff)
+            while len(out) < n and self.count > 0:
+                hdr = r.read(4)
+                if len(hdr) < 4:
+                    break
+                (ln,) = _struct.unpack("<I", hdr)
+                blob = r.read(ln)
+                if len(blob) < ln:
+                    break
+                out.append(_pickle.loads(blob))
+                self.count -= 1
+            self._roff = r.tell()
+        self.loaded += len(out)
+        if self.count <= 0:
+            # Fully drained: truncate in place (the append handle's
+            # position resets with it).
+            self._w.close()
+            self._w = open(self.path, "wb")
+            self._w.close()
+            self._w = open(self.path, "ab")
+            self._roff = 0
+            self.count = 0
+        return out
+
+    def close(self) -> None:
+        if self._w is not None:
+            try:
+                self._w.close()
+            except OSError:
+                pass
+            self._w = None
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
 class _ReadyQueue:
     """Ready tasks bucketed by scheduling shape (ray: ClusterTaskManager
     keys its queues by scheduling class).  Dispatch probes one head task
@@ -329,14 +418,22 @@ class _ReadyQueue:
             # Bundle index is part of the shape: a full bundle 0 must not
             # block a sibling task targeting free bundle 1.
             return ("pg", pg_id, want_idx, tuple(sorted(spec.resources.items())))
+        # Plain-task shape doubles as the lease SchedulingKey (ray:
+        # scheduling_key.h = scheduling class + function descriptor):
+        # fn_id keeps the leaseholder's fn-blob cache hot, env_key keeps
+        # runtime-env workers distinct.  Head-of-line semantics are
+        # unchanged — finer buckets, one head probe each.
         return (
             tuple(sorted(spec.resources.items())),
             Runtime._strategy_shape_key(spec.scheduling_strategy),
+            spec.fn_id,
+            None if not spec.runtime_env else _runtime_env_key(spec.runtime_env),
         )
 
-    def append(self, tid: str) -> None:
-        spec = self._rt.tasks[tid].spec
-        self.buckets.setdefault(self._shape_of(spec), deque()).append(tid)
+    def append(self, tid: str, shape=None) -> None:
+        if shape is None:
+            shape = self._shape_of(self._rt.tasks[tid].spec)
+        self.buckets.setdefault(shape, deque()).append(tid)
 
     def __iter__(self):
         for q in self.buckets.values():
@@ -346,10 +443,35 @@ class _ReadyQueue:
         return sum(len(q) for q in self.buckets.values())
 
 
+class TaskLease:
+    """One head-side worker lease: a worker bound to a SchedulingKey with
+    its resources HELD across tasks (ray: direct_task_transport.h:75 —
+    the same pooling the caller-side peer leases do, applied to the
+    head's own dispatch loop).  idle_since is None while a task runs on
+    the leaseholder; a monotonic stamp while it waits for the next
+    same-key task."""
+
+    __slots__ = (
+        "lease_id", "key", "worker_id", "node_id", "resources",
+        "granted_t", "idle_since", "dispatched", "last_extend_journal",
+    )
+
+    def __init__(self, lease_id, key, worker_id, node_id, resources):
+        self.lease_id = lease_id
+        self.key = key
+        self.worker_id = worker_id
+        self.node_id = node_id
+        self.resources = resources
+        self.granted_t = time.monotonic()
+        self.idle_since: Optional[float] = None  # a task is running now
+        self.dispatched = 1
+        self.last_extend_journal = self.granted_t
+
+
 class TaskRecord:
     __slots__ = (
         "spec", "state", "node_id", "worker_id", "unmet_deps", "cancelled",
-        "pg", "start_time", "allow_pending", "stages",
+        "pg", "start_time", "allow_pending", "stages", "lease",
     )
 
     def __init__(self, spec):
@@ -361,6 +483,10 @@ class TaskRecord:
         self.cancelled = False
         self.pg = None  # (pg_id, bundle_index) when resources come from a PG
         self.start_time = None  # wall time when dispatched (timeline)
+        # The TaskLease this record dispatched on, when any: the LEASE
+        # owns the node resources (release happens at revoke, not per
+        # task) — _release_for must not double-release them.
+        self.lease = None
         # Re-driven tasks (head-restart recovery) PARK when infeasible —
         # the cluster's daemon nodes rejoin seconds after restore, and
         # failing fast there would defeat the re-drive.
@@ -547,6 +673,20 @@ class Runtime:
         from ray_tpu._private import config as _config
 
         self.lineage_max = _config.get("lineage_max_entries")
+        # Resolved once (dispatch hot path): lease idle window.
+        self._lease_idle_s = _config.get("task_lease_idle_s")
+        # Ready-queue disk overflow (bounded head RSS under a 1M-task
+        # backlog): lazily created at the first spill.
+        self._ready_spill: Optional[_ReadySpill] = None
+        self._spill_after = _config.get("ready_queue_spill_after")
+        # Lineage re-executions parked on a missing fn blob:
+        # fn_id -> (since_mono, [oids]).  Released by the export hook,
+        # failed loudly by the io-loop tick after the fence timeout.
+        self._fn_fences: Dict[str, tuple] = {}
+        self.state.on_function_export = self._on_function_export
+        # (histogram, {stage: resolved series key}) — lazy, see
+        # _observe_stage_durations.
+        self._stage_key_cache = None
         # Footprint bound (bytes of retained args_blob) in addition to the
         # entry-count cap — ray: task_manager.h:97-104 lineage accounting.
         self.lineage_max_bytes = _config.get("lineage_max_bytes")
@@ -571,6 +711,10 @@ class Runtime:
             "pull_parks": 0,
             "journal_appends": 0,
             "journal_fsyncs": 0,
+            "journal_entries": 0,
+            "task_leases_granted": 0,
+            "task_leases_revoked": 0,
+            "lease_dispatches": 0,
         }
         # Staggered broadcast admission (see _admit_pull): oid -> grant
         # timestamps of in-flight pulls; round-robin rotation counter.
@@ -602,6 +746,17 @@ class Runtime:
         # Lease grants awaiting a spawning worker's ready handshake:
         # worker_id -> [(caller, req_id, lease_id)].
         self._parked_peer_leases: Dict[str, list] = {}
+        # HEAD-side lease reuse (ray: direct_task_transport.h:40-55 —
+        # "subsequent same-shape tasks skip the lease round trip"): a
+        # worker dispatched a lease-eligible task stays BOUND to that
+        # task's SchedulingKey (fn + resource shape + strategy + env),
+        # resources held, and same-key tasks dispatch straight onto it —
+        # no per-task placement, no pool churn.  Revoked on worker death,
+        # idle timeout (RAY_TPU_LEASE_IDLE_S), or on demand when another
+        # shape can't place (the idle lease's resources are the slack).
+        self.task_leases: Dict[Any, List[TaskLease]] = {}
+        self.lease_by_worker: Dict[str, "TaskLease"] = {}
+        self._task_lease_seq = 0
         # Adaptive prestart (ray: worker_pool.h:156): pool-miss bursts
         # raise the target; 5 quiet seconds halve it.  Topped up from the
         # io-loop tick.
@@ -901,8 +1056,30 @@ class Runtime:
                 "head_sharded_conns": float(
                     sum(len(s.conns) for s in self._io_shards.values())
                 ),
-                "journal_appends": float(self.metrics["journal_appends"]),
-                "journal_fsyncs": float(self.metrics["journal_fsyncs"]),
+                "journal_entries": float(
+                    self._journal.entries if self._journal else 0
+                ),
+                "journal_appends": float(
+                    self._journal.writes if self._journal
+                    else self.metrics["journal_appends"]
+                ),
+                "journal_fsyncs": float(
+                    self._journal.fsyncs if self._journal
+                    else self.metrics["journal_fsyncs"]
+                ),
+                "head_task_leases": float(
+                    sum(len(p) for p in self.task_leases.values())
+                ),
+                "task_leases_granted": float(
+                    self.metrics["task_leases_granted"]
+                ),
+                "task_leases_revoked": float(
+                    self.metrics["task_leases_revoked"]
+                ),
+                "lease_dispatches": float(self.metrics["lease_dispatches"]),
+                "head_ready_spilled": float(
+                    self._ready_spill.count if self._ready_spill else 0
+                ),
                 "tasks_finished": float(self.metrics["tasks_finished"]),
                 "tasks_failed": float(self.metrics["tasks_failed"]),
             }
@@ -931,20 +1108,26 @@ class Runtime:
 
     def _journal_append(self, entry: tuple) -> None:
         """GlobalState journal hook + inline-lineage writer: mirror one
-        control-plane mutation into the append-only journal.  Best-effort
-        by contract — a failed append degrades this mutation back to
-        snapshot-tick durability, and the reconciliation handshake covers
-        the actor records regardless."""
+        control-plane mutation into the append-only journal (group-
+        committed — see MutationJournal).  Best-effort by contract — a
+        failed append degrades this mutation back to snapshot-tick
+        durability, and the reconciliation handshake covers the actor
+        records regardless."""
         j = self._journal
         if j is None:
             return
         try:
-            synced = j.append(entry)
+            j.append(entry)
         except Exception:
             return
-        self.metrics["journal_appends"] += 1
-        if synced:
-            self.metrics["journal_fsyncs"] += 1
+        # Mirror the journal's own counters (the flusher thread advances
+        # writes/fsyncs asynchronously; entries advance here).  NOTE the
+        # post-group-commit meaning: journal_appends = PHYSICAL writes,
+        # journal_entries = logical mutations — their ratio is the
+        # group-commit factor, same shape as wire writes_per_op.
+        self.metrics["journal_entries"] = j.entries
+        self.metrics["journal_appends"] = j.writes
+        self.metrics["journal_fsyncs"] = j.fsyncs
         if j.size_bytes() >= self._journal_compact_bytes:
             self._snapshot_kick.set()
 
@@ -1960,6 +2143,15 @@ class Runtime:
     def _return_worker(self, h: WorkerHandle) -> None:
         if h.state == "dead":
             return
+        # Safety net: returning a still-leased worker (conn-reset
+        # re-drive, any future path) must revoke its lease first or the
+        # held resources would strand.  No recursion — revoke pops the
+        # binding before it ever calls back here.
+        le = self.lease_by_worker.get(h.worker_id)
+        if le is not None:
+            self._revoke_lease_locked(
+                le, cause="worker_returned", return_worker=False
+            )
         h.state = "idle"
         h.current_task = None
         h.idle_since = time.monotonic()
@@ -2232,7 +2424,20 @@ class Runtime:
         if msg[0] == "shard_fwd":
             proxy = sh.conns.get(msg[1])
             if proxy is not None:
-                self._dispatch_sharded_msgs(proxy, msg[2])
+                # Bodies arrive raw (native untouched, pickled ones
+                # shard-validated + re-encoded): decode here — the ONLY
+                # decode native bodies ever get.  wire.recv faults fired
+                # on the shard; firing again here would double-drop.
+                msgs = []
+                for body in msg[2]:
+                    try:
+                        msgs.append(_wire.decode_body(body))
+                    except Exception:
+                        import traceback
+
+                        traceback.print_exc()
+                if msgs:
+                    self._dispatch_sharded_msgs(proxy, msgs)
         elif msg[0] == "shard_eof":
             proxy = sh.conns.pop(msg[1], None)
             if proxy is not None:
@@ -2864,6 +3069,13 @@ class Runtime:
                                 did
                             ] not in self._conn_to_driver:
                                 self._on_driver_death(did)
+                    # Task leases idle past RAY_TPU_LEASE_IDLE_S return
+                    # their worker + resources to the shared pool.
+                    if self.task_leases:
+                        self._revoke_idle_leases(now)
+                    # Function-export fences that timed out fail loudly.
+                    if self._fn_fences:
+                        self._sweep_fn_fences(now)
                     # Idle-worker reaping (ray: worker_pool idle killing):
                     # default-env head workers beyond the prestart floor
                     # that sat idle >60s exit, so a burst's pool shrinks
@@ -4108,6 +4320,29 @@ class Runtime:
             return False
         if spec.task_id in self.tasks:
             return True  # reconstruction already in flight
+        if spec.fn_id and self.state.get_function(spec.fn_id) is None:
+            # PR-4 edge, closed: the fn blob isn't exported yet (a journal
+            # torn-tail ate the export, or the re-execution raced the
+            # owner's re-export after a head bounce).  PARK this
+            # reconstruction on a function-export FENCE instead of
+            # dispatching a task that can only fail "unknown function" —
+            # the export hook re-kicks it, and the io-loop tick fails it
+            # loudly after _FN_FENCE_TIMEOUT_S so a never-returning owner
+            # can't wedge the get forever.
+            since, oids = self._fn_fences.setdefault(
+                spec.fn_id, (time.monotonic(), [])
+            )
+            if oid not in oids:
+                oids.append(oid)
+            with self.store._available:
+                for rid in spec.return_ids():
+                    self.store._ready.pop(rid, None)
+            self.events.emit(
+                "WARNING", "lineage",
+                "re-execution parked on pending function export",
+                fn_id=spec.fn_id, object_id=oid,
+            )
+            return True
         # Dependencies may have been freed since the original run: recurse
         # up the lineage first (ray: recovery walks the lineage DAG).  A dep
         # that is "ready" but with lost bytes is handled lazily when the
@@ -4126,6 +4361,40 @@ class Runtime:
                 self.store._ready.pop(rid, None)
         self.submit_task(spec)
         return True
+
+    def _on_function_export(self, fn_id: str) -> None:
+        """GlobalState export hook (fires OUTSIDE state.lock): release
+        lineage re-executions parked on this function's fence."""
+        with self.lock:
+            ent = self._fn_fences.pop(fn_id, None)
+            if ent is None:
+                return
+            for oid in ent[1]:
+                try:
+                    self._reconstruct(oid)
+                except Exception:
+                    continue
+
+    def _sweep_fn_fences(self, now_mono: float) -> None:
+        """io-loop tick (holds self.lock): a fence nobody re-exported
+        within the timeout fails its parked gets LOUDLY instead of
+        parking them forever."""
+        for fn_id, (since, oids) in list(self._fn_fences.items()):
+            if now_mono - since < _FN_FENCE_TIMEOUT_S:
+                continue
+            self._fn_fences.pop(fn_id, None)
+            err = ObjectLostError(
+                f"lineage re-execution waited {_FN_FENCE_TIMEOUT_S:.0f}s "
+                f"for function {fn_id} to be re-exported; the owner never "
+                "re-exported it"
+            )
+            for oid in oids:
+                self.store.put_error(oid, err)
+                self._object_ready(oid)
+            self.events.emit(
+                "WARNING", "lineage", "function-export fence timed out",
+                fn_id=fn_id, objects=len(oids),
+            )
 
     def _worker_node(self, wid: str) -> str:
         h = self.workers.get(wid)
@@ -4312,6 +4581,27 @@ class Runtime:
             self.metrics["tasks_submitted"] += 1
             if spec.is_actor_creation:
                 self.metrics["actors_created"] += 1
+            if (
+                self._spill_after > 0
+                and len(self.tasks) >= self._spill_after
+                and not spec.deps
+                and not spec.contained_refs
+                and not spec.runtime_env
+                and self._lease_eligible(spec)
+            ):
+                # Backlog overflow: the spec rides a disk segment instead
+                # of ~1KB of head memory; _dispatch reloads FIFO chunks
+                # as the in-memory backlog drains.  No TaskRecord, no
+                # dedupe entry — an overflow task re-submitted across a
+                # head bounce re-runs (at-least-once, same contract as
+                # direct dispatch).
+                if self._ready_spill is None:
+                    self._ready_spill = _ReadySpill(os.path.join(
+                        f"/tmp/raytpu-spill-{self.session_name}",
+                        "ready_overflow.bin",
+                    ))
+                self._ready_spill.append(spec)
+                return return_ids
             self.tasks[spec.task_id] = rec
             for c in spec.contained_refs:
                 self.store.add_ref(c)  # arg borrow for the task's lifetime
@@ -4330,7 +4620,27 @@ class Runtime:
             if unmet == 0:
                 rec.state = "READY"
                 rec.stamp("queued")
-                self.ready_queue.append(spec.task_id)
+                shape = self.ready_queue._shape_of(spec)
+                # Submit→running FAST PATH: deps ready, bucket empty, and
+                # an idle same-key leaseholder exists — push straight to
+                # it and skip the whole dispatch scan (per-submit cost
+                # O(1), not O(shapes)).  Dep errors still fail the task
+                # exactly as the scan would.
+                if not self.ready_queue.buckets.get(shape):
+                    dep_err = None
+                    for d in spec.deps:
+                        e = self.store.error_for(d)
+                        if e is not None:
+                            dep_err = e
+                            break
+                    if dep_err is not None:
+                        self._finish_with_error(rec, dep_err, release=False)
+                        return return_ids
+                    le = self._idle_lease_for(shape)
+                    if le is not None:
+                        self._dispatch_on_lease(le, rec)
+                        return return_ids
+                self.ready_queue.append(spec.task_id, shape)
             self._dispatch()
         return return_ids
 
@@ -4413,15 +4723,214 @@ class Runtime:
             return ("affinity", strategy.node_id, strategy.soft)
         return strategy if isinstance(strategy, (str, type(None))) else repr(strategy)
 
+    # ------------------------------------------------------------------
+    # head-side lease reuse (ray: direct_task_transport.h:40-55 — the
+    # SchedulingKey-keyed lease pool, applied to the head's own relayed
+    # dispatch): the first task of a key pays full placement and BINDS
+    # its worker to the key with resources held; same-key tasks then
+    # bypass the scheduler entirely and push straight onto an idle
+    # leaseholder.  All helpers run under self.lock.
+
+    @staticmethod
+    def _lease_eligible(spec) -> bool:
+        return (
+            spec.actor_id is None
+            and not spec.is_actor_creation
+            and spec.placement_group_id is None
+            and spec.scheduling_strategy in (None, "DEFAULT", "SPREAD")
+        )
+
+    def _idle_lease_for(self, key) -> Optional[TaskLease]:
+        leases = self.task_leases.get(key)
+        if not leases:
+            return None
+        for le in list(leases):
+            if le.idle_since is None:
+                continue
+            h = self.workers.get(le.worker_id)
+            if h is None or h.state != "busy" or h.current_task is not None:
+                # Defensive: the crash path revokes synchronously, so a
+                # stale binding here means the worker moved on without
+                # us — drop the lease WITHOUT re-releasing resources (a
+                # double release would inflate the node ledger).
+                self._revoke_lease_locked(
+                    le, cause="stale", release=False, return_worker=False
+                )
+                continue
+            return le
+        return None
+
+    def _grant_lease_locked(self, key, h, node, spec) -> TaskLease:
+        self._task_lease_seq += 1
+        le = TaskLease(
+            f"tl-{self._task_lease_seq}", key, h.worker_id, node,
+            dict(spec.resources),
+        )
+        self.task_leases.setdefault(key, []).append(le)
+        self.lease_by_worker[h.worker_id] = le
+        self.metrics["task_leases_granted"] += 1
+        self._journal_append(
+            ("lease", "grant", le.lease_id, repr(key), h.worker_id, node,
+             dict(spec.resources))
+        )
+        return le
+
+    def _dispatch_on_lease(self, le: TaskLease, rec: TaskRecord) -> None:
+        """Fast path: push a ready same-key task straight onto an idle
+        leaseholder — no placement, no resource churn, no pool ops."""
+        h = self.workers[le.worker_id]
+        le.idle_since = None
+        le.dispatched += 1
+        self.metrics["lease_dispatches"] += 1
+        now = time.monotonic()
+        if now - le.last_extend_journal > self._lease_idle_s * 0.5:
+            # Extends journal at half-idle-window granularity: restart
+            # diagnostics see the lease was hot without paying one entry
+            # per task (group commit batches these anyway).
+            le.last_extend_journal = now
+            self._journal_append(("lease", "extend", le.lease_id, le.dispatched))
+        spec = rec.spec
+        rec.state = "RUNNING"
+        rec.start_time = time.time()
+        rec.stages["leased"] = rec.start_time
+        rec.node_id = le.node_id
+        rec.worker_id = h.worker_id
+        rec.lease = le
+        h.current_task = spec.task_id
+        blob = None
+        if spec.fn_id not in h.known_fns:
+            blob = self.state.get_function(spec.fn_id)
+            h.known_fns.add(spec.fn_id)
+        self._send(h, ("task", spec, blob))
+        if h.conn is not None:
+            rec.stamp("pushed")
+
+    def _lease_task_finished(self, rec: TaskRecord, h) -> None:
+        """A task finished (or retry-released) on a LIVE leaseholder:
+        re-arm the lease and chain the next same-key task immediately —
+        the completion-to-dispatch path the flamegraphs showed paying
+        full placement per task."""
+        le = rec.lease
+        rec.lease = None
+        rec.node_id = None
+        le.idle_since = time.monotonic()
+        if h is not None:
+            h.current_task = None
+        q = self.ready_queue.buckets.get(le.key)
+        while q:
+            tid = q[0]
+            nrec = self.tasks.get(tid)
+            if nrec is None or nrec.cancelled:
+                q.popleft()
+                continue
+            dep_err = None
+            for d in nrec.spec.deps:
+                e = self.store.error_for(d)
+                if e is not None:
+                    dep_err = e
+                    break
+            if dep_err is not None:
+                q.popleft()
+                self._finish_with_error(nrec, dep_err, release=False)
+                continue
+            q.popleft()
+            if not q:
+                self.ready_queue.buckets.pop(le.key, None)
+            self._dispatch_on_lease(le, nrec)
+            return
+        if q is not None and not q:
+            self.ready_queue.buckets.pop(le.key, None)
+
+    def _revoke_lease_locked(
+        self, le: TaskLease, cause: str, release: bool = True,
+        return_worker: bool = True,
+    ) -> None:
+        """Unbind a lease: journal the revocation, release its held
+        resources (exactly once — the caller says whether this revoke
+        still owns them), return the worker to the shared pool."""
+        pool = self.task_leases.get(le.key)
+        if pool is not None:
+            try:
+                pool.remove(le)
+            except ValueError:
+                pass
+            if not pool:
+                self.task_leases.pop(le.key, None)
+        if self.lease_by_worker.get(le.worker_id) is le:
+            self.lease_by_worker.pop(le.worker_id, None)
+        if release:
+            self.scheduler.release(le.node_id, le.resources)
+        self.metrics["task_leases_revoked"] += 1
+        self._journal_append(("lease", "revoke", le.lease_id, cause))
+        if return_worker:
+            h = self.workers.get(le.worker_id)
+            if h is not None and h.state == "busy" and h.current_task is None:
+                self._return_worker(h)
+
+    def _revoke_one_idle_lease(self) -> bool:
+        """Demand revocation: a different shape (or a placement group)
+        can't place while idle leases pin resources — free the stalest
+        one and let the caller retry.  Same-key idle leases can't reach
+        here (dispatch consumes them first), so this never thrashes a
+        hot stream."""
+        best = None
+        for pool in self.task_leases.values():
+            for le in pool:
+                if le.idle_since is None:
+                    continue
+                if best is None or le.idle_since < best.idle_since:
+                    best = le
+        if best is None:
+            return False
+        self._revoke_lease_locked(best, cause="demand")
+        return True
+
+    def _revoke_idle_leases(self, now_mono: float) -> None:
+        """io-loop tick: leases idle past RAY_TPU_LEASE_IDLE_S return
+        their worker + resources to the shared pool, so a burst's leases
+        can't strand capacity (chaos leans on this + the crash-path
+        revoke)."""
+        revoked = False
+        for pool in list(self.task_leases.values()):
+            for le in list(pool):
+                if (
+                    le.idle_since is not None
+                    and now_mono - le.idle_since > self._lease_idle_s
+                ):
+                    self._revoke_lease_locked(le, cause="idle-timeout")
+                    revoked = True
+        if revoked:
+            self._dispatch()
+
     @_locked
     def _dispatch(self) -> None:
         # caller holds self.lock
+        sp = self._ready_spill
+        if (
+            sp is not None
+            and sp.count
+            and len(self.tasks) <= max(self._spill_after // 2, 1000)
+        ):
+            # The in-memory backlog drained below the low watermark:
+            # reload the next FIFO chunk of spilled overflow specs.
+            for spec in sp.load(2000):
+                if spec.task_id in self.tasks:
+                    continue
+                rec = TaskRecord(spec)
+                rec.state = "READY"
+                rec.stamp("queued")
+                self.tasks[spec.task_id] = rec
+                self.ready_queue.append(spec.task_id)
         for pg_id in list(self.pending_pgs):
             pg = self.state.placement_groups.get(pg_id)
             if pg is None or pg.state != "PENDING":
                 self.pending_pgs.remove(pg_id)
                 continue
-            if self.scheduler.reserve_placement_group(pg):
+            ok = self.scheduler.reserve_placement_group(pg)
+            while not ok and self._revoke_one_idle_lease():
+                # Idle leases were pinning the bundle capacity.
+                ok = self.scheduler.reserve_placement_group(pg)
+            if ok:
                 self.pending_pgs.remove(pg_id)
         # Shape-bucketed dispatch (ray: ClusterTaskManager queues tasks per
         # scheduling class): probe ONE head task per shape; if it cannot
@@ -4452,10 +4961,19 @@ class Runtime:
                 if Scheduler.is_pg_task(spec):
                     sel = self.scheduler.select_pg(spec, spec.resources)
                     if sel is None:
+                        if self._revoke_one_idle_lease():
+                            continue  # freed pinned resources: retry head
                         break  # bucket blocked: siblings can't place either
                     node, bidx = sel
                     rec.pg = (self.scheduler._pg_for_spec(spec)[0], bidx)
                 else:
+                    # Lease fast path: an idle same-key leaseholder takes
+                    # the task with zero placement work.
+                    le = self._idle_lease_for(shape)
+                    if le is not None:
+                        q.popleft()
+                        self._dispatch_on_lease(le, rec)
+                        continue
                     try:
                         node = self.scheduler.select_node(spec)
                     except ValueError as e:
@@ -4467,14 +4985,16 @@ class Runtime:
                     if node is None or not self.scheduler.acquire(
                         node, spec.resources
                     ):
+                        if self._revoke_one_idle_lease():
+                            continue  # idle leases were the missing slack
                         break
                 q.popleft()
-                self._dispatch_placed(rec, node)
+                self._dispatch_placed(rec, node, shape)
             if not q:
                 self.ready_queue.buckets.pop(shape, None)
 
     @_locked
-    def _dispatch_placed(self, rec: TaskRecord, node: str) -> None:
+    def _dispatch_placed(self, rec: TaskRecord, node: str, shape=None) -> None:
         # caller holds self.lock; resources for `node` already acquired
         spec = rec.spec
         tid = spec.task_id
@@ -4485,6 +5005,14 @@ class Runtime:
         rec.node_id = node
         rec.worker_id = h.worker_id
         h.current_task = tid
+        if self._lease_eligible(spec):
+            # First task of its SchedulingKey through full placement:
+            # bind the worker to the key — same-key successors skip the
+            # scheduler entirely (_dispatch_on_lease).
+            rec.lease = self._grant_lease_locked(
+                shape if shape is not None else self.ready_queue._shape_of(spec),
+                h, node, spec,
+            )
         if spec.is_actor_creation:
             h.state = "actor"
             h.actor_id = spec.actor_id
@@ -4512,6 +5040,13 @@ class Runtime:
     # completion / failure
 
     def _release_for(self, rec: TaskRecord) -> None:
+        if rec.lease is not None:
+            # The LEASE owns the node resources: they release exactly once
+            # at revoke (idle timeout, demand, worker death), never per
+            # task — releasing here too would inflate the node ledger.
+            rec.lease = None
+            rec.node_id = None
+            return
         if rec.pg is not None:
             self.scheduler.release_pg(rec.pg[0], rec.pg[1], rec.spec.resources)
             rec.pg = None
@@ -4622,9 +5157,20 @@ class Runtime:
             if ar:
                 ar.in_flight.pop(task_id, None)
         elif not spec.is_actor_creation:
-            self._release_for(rec)
-            if h is not None and h.state == "busy":
-                self._return_worker(h)
+            le = rec.lease
+            if (
+                le is not None
+                and h is not None
+                and h.state == "busy"
+                and self.lease_by_worker.get(wid) is le
+            ):
+                # Leaseholder stays bound: chain the next same-key task
+                # now, or idle within the lease window.
+                self._lease_task_finished(rec, h)
+            else:
+                self._release_for(rec)
+                if h is not None and h.state == "busy":
+                    self._return_worker(h)
         for oid in ready_ids:
             self._object_ready(oid)
         if spec.is_actor_creation:
@@ -4662,9 +5208,20 @@ class Runtime:
             else:
                 ar.queued.append(spec.task_id)
             return
-        self._release_for(rec)
-        if h is not None and h.state == "busy":
-            self._return_worker(h)
+        le = rec.lease
+        if (
+            le is not None
+            and h is not None
+            and h.state == "busy"
+            and self.lease_by_worker.get(h.worker_id) is le
+        ):
+            # Error-retry on a live leaseholder: the lease re-arms (the
+            # retried attempt likely re-dispatches right back onto it).
+            self._lease_task_finished(rec, h)
+        else:
+            self._release_for(rec)
+            if h is not None and h.state == "busy":
+                self._return_worker(h)
         rec.state = "READY"
         rec.stamp("queued")
         rec.node_id = rec.worker_id = None
@@ -4746,15 +5303,25 @@ class Runtime:
     def _observe_stage_durations(self, durations) -> None:
         """Fold one task's per-stage seconds into the
         task_stage_seconds{stage=...} histograms (never raises — the
-        fold must not take the completion path down)."""
+        fold must not take the completion path down).  Tag resolution is
+        cached per stage label: this runs for EVERY finished task (twice
+        per direct task via task_events) and the per-observe merge+sort
+        was a measured slice of the head's completion cost."""
         if not durations:
             return
         try:
-            from ray_tpu._private import telemetry as _telemetry
+            cache = self._stage_key_cache
+            if cache is None:
+                from ray_tpu._private import telemetry as _telemetry
 
-            hist = _telemetry.task_stage_histogram()
+                hist = _telemetry.task_stage_histogram()
+                cache = self._stage_key_cache = (hist, {})
+            hist, keys = cache
             for stage, v in durations.items():
-                hist.observe(v, tags={"stage": stage})
+                k = keys.get(stage)
+                if k is None:
+                    k = keys[stage] = hist.resolved_key({"stage": stage})
+                hist.observe_resolved(k, v)
         except Exception:
             pass
 
@@ -4863,6 +5430,14 @@ class Runtime:
                 # relay path forever.
                 verdict = "pending" if ent[4] else "dead"
                 self._reply(ent[0], ent[1], True, (verdict, None, None, ent[4]))
+        # A head-side task lease dies with its worker: revoke NOW (journal
+        # + release the held resources exactly once) so the in-flight
+        # task's retry below re-places through the scheduler instead of
+        # binding to a ghost — chaos asserts no stranded capacity.
+        tle = self.lease_by_worker.get(wid)
+        if tle is not None:
+            self._revoke_lease_locked(tle, cause="worker_death",
+                                      return_worker=False)
         # Leases die with the worker they lease (callers see the peer conn
         # EOF and retry) and with the CALLER that held them (its workers
         # return to the pool).
@@ -5323,6 +5898,8 @@ class Runtime:
             self._snapshot_storage.close()
         if getattr(self, "_journal", None) is not None:
             self._journal.close()
+        if getattr(self, "_ready_spill", None) is not None:
+            self._ready_spill.close()
         if getattr(self, "_mem_monitor", None) is not None:
             self._mem_monitor.stop()
         # Final log drain: crash output written moments ago must reach the

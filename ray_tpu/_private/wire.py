@@ -15,11 +15,13 @@ This module gives every control connection:
   * a per-message schema registry: str-kinded control tuples are checked
     for known kind, arity bounds, and leading field types at decode time —
     unknown or malformed control messages are rejected at the boundary;
-  * pickle confined to the framed body (it still carries user payload
-    blobs and complex specs — the authkey HMAC gates the bytes before any
-    unpickling, as before), with raw passthrough (`send_bytes` /
+  * serialization confined to the framed body — since v3 the hot control
+    kinds ride NATIVE bodies (wire_native.py: struct-framed marshal data
+    tuples, no pickle; the first body byte discriminates, 0x80 = pickle)
+    and everything else stays pickled (the authkey HMAC gates the bytes
+    before any decode, as before), with raw passthrough (`send_bytes` /
     `recv_bytes` / `fileno`) for the object-transfer body path, which is
-    not pickled at all.
+    not serialized here at all.
 
 Protocol v2 adds the BATCH frame: one physical write carrying N
 schema-validated sub-frames.  PROFILE_r5.md showed the head's steady
@@ -61,8 +63,10 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu._private import config as _config
 from ray_tpu._private import faults
 from ray_tpu._private import lock_watchdog
+from ray_tpu._private import wire_native
 
 
 def _kind(obj: Any) -> Optional[str]:
@@ -77,9 +81,19 @@ MAGIC = b"RT"
 # physical write of N sub-frames from a plain single frame; a v1 receiver
 # fails both shapes with the same clean bad-magic/version error.
 MAGIC_BATCH = b"RB"
-# v2: batch frames exist (single frames are wire-compatible with v1, but
-# any conn may now carry a batch, so the version must fence old peers).
-PROTOCOL_VERSION = 2
+# v3: frame BODIES may be native (wire_native.py: struct-framed marshal,
+# no pickle) for the hot control kinds.  The first body byte
+# discriminates — pickle protocol-2+ streams always start with 0x80,
+# native bodies with their kind id (1..0x7F) — so pickled and native
+# bodies coexist per conn and per batch.  Negotiation IS the version
+# fence: every frame header carries v3, an older peer rejects the first
+# frame with the clean mismatch error naming both versions, and a v3
+# peer by contract decodes both body forms.  Fallback is per-frame: any
+# message whose kind has no native codec, or whose payload doesn't fit
+# the packed schema (strategy objects, exceptions in replies), pickles
+# exactly as in v2 (RAY_TPU_WIRE_NATIVE=0 forces the pickle path for
+# every frame).
+PROTOCOL_VERSION = 3
 _HEADER = struct.pack("<2sH", MAGIC, PROTOCOL_VERSION)
 _BATCH_HEADER = struct.Struct("<2sHI")  # magic, version, sub-frame count
 _SUBLEN = struct.Struct("<I")
@@ -136,14 +150,15 @@ SCHEMAS: Dict[str, Tuple[int, Optional[int], tuple]] = {
     "prof_push": (1, 1, (dict,)),
     # head io-shard fabric (io_shard.py): the internal channel between the
     # head process and its io-shard processes.  shard_fwd carries a conn's
-    # decoded control messages IN ORDER (the list is the order they came
-    # off the wire — the per-conn ordering invariant across the shard
-    # boundary); shard_send is the reverse path (head reply/pub/fence
-    # frames routed out through the owning shard); shard_eof reports a
-    # handed-off conn's death.
+    # raw sub-frame BODIES in arrival order (native bodies untouched —
+    # the head's decode is the only decode; pickled bodies were decoded/
+    # validated on the shard pid and re-encoded): the per-conn ordering
+    # invariant across the shard boundary is the list order.  shard_send
+    # is the reverse path — ONE head-encoded body the shard writes to the
+    # conn without decoding; shard_eof reports a handed-off conn's death.
     "shard_fwd": (2, 2, (str, list)),
     "shard_eof": (1, 2, (str,)),
-    "shard_send": (2, 2, (str,)),
+    "shard_send": (2, 2, (str, bytes)),
     "shard_close": (1, 1, (str,)),
     # cross-process pubsub (pubsub.py remote delivery)
     "subscribe": (2, 3, (str,)),
@@ -231,8 +246,41 @@ def _check_version(magic: bytes, version: int) -> None:
         )
 
 
+def encode_body(obj: Any) -> bytes:
+    """Body bytes for one control message: native (struct-framed marshal,
+    wire_native.py) for the hot kinds when the knob allows, else pickle.
+    The first body byte self-describes which (0x80 = pickle)."""
+    if _config.get("wire_native"):
+        body = wire_native.encode(obj)
+        if body is not None:
+            _count_codec(native_encodes=1)
+            return body
+    _count_codec(pickle_encodes=1)
+    return pickle.dumps(obj, protocol=5)
+
+
+def decode_body(body) -> Any:
+    """Decode + schema-validate ONE sub-frame body (pickled or native)."""
+    if body and body[0] != 0x80:
+        try:
+            obj = wire_native.decode(body)
+        except wire_native.ProtocolError as e:
+            raise ProtocolError(str(e)) from None
+        _count_codec(native_decodes=1)
+    else:
+        obj = pickle.loads(body)
+        _count_codec(pickle_decodes=1)
+    _validate(obj)
+    return obj
+
+
 def encode(obj: Any) -> bytes:
     return _HEADER + pickle.dumps(obj, protocol=5)
+
+
+def encode_native(obj: Any) -> bytes:
+    """One full frame using the body codec (native when possible)."""
+    return _HEADER + encode_body(obj)
 
 
 def encode_batch(bodies: List[bytes]) -> bytes:
@@ -255,52 +303,56 @@ def decode(buf) -> Any:
     return objs[0]
 
 
-def decode_frames(buf) -> List[Any]:
-    """Decode a physical frame into its validated sub-frames, in order.
-
-    A single frame yields [obj].  For a batch, EVERY sub-frame is
-    unpickled and schema-validated before any is returned: one malformed
-    sub-frame rejects the whole batch at the boundary (no partial
-    dispatch), and a body that doesn't exactly cover its declared
-    sub-frame lengths is a truncated write — a clean ProtocolError, the
-    shape a mid-batch sender crash leaves behind."""
+def split_frame_bodies(buf) -> List[memoryview]:
+    """Parse a physical frame into its raw sub-frame BODIES, in order,
+    without decoding any of them.  Structural validation only: truncated
+    batches reject whole (the shape a mid-batch sender crash leaves
+    behind).  The io shards use this to forward native bodies raw —
+    decode happens exactly once, head-side."""
     if len(buf) < 4:
         raise ProtocolError("short control frame")
     magic, version = struct.unpack_from("<2sH", buf, 0)
     _check_version(magic, version)
     view = memoryview(buf)
     if magic == MAGIC:
-        obj = pickle.loads(view[4:])
-        _validate(obj)
-        return [obj]
+        return [view[4:]]
     if len(buf) < _BATCH_HEADER.size:
         raise ProtocolError("truncated batch frame (short header)")
     _m, _v, count = _BATCH_HEADER.unpack_from(buf, 0)
-    objs: List[Any] = []
+    bodies: List[memoryview] = []
     off = _BATCH_HEADER.size
     for _ in range(count):
         if off + _SUBLEN.size > len(buf):
             raise ProtocolError(
-                f"truncated batch frame ({len(objs)}/{count} sub-frames "
+                f"truncated batch frame ({len(bodies)}/{count} sub-frames "
                 "before the body ran out)"
             )
         (n,) = _SUBLEN.unpack_from(buf, off)
         off += _SUBLEN.size
         if off + n > len(buf):
             raise ProtocolError(
-                f"truncated batch frame (sub-frame {len(objs)} declares "
+                f"truncated batch frame (sub-frame {len(bodies)} declares "
                 f"{n} bytes, {len(buf) - off} remain)"
             )
-        obj = pickle.loads(view[off:off + n])
-        _validate(obj)
-        objs.append(obj)
+        bodies.append(view[off:off + n])
         off += n
     if off != len(buf):
         raise ProtocolError(
             f"batch frame has {len(buf) - off} trailing bytes after "
             f"{count} sub-frames"
         )
-    return objs
+    return bodies
+
+
+def decode_frames(buf) -> List[Any]:
+    """Decode a physical frame into its validated sub-frames, in order.
+
+    A single frame yields [obj].  For a batch, EVERY sub-frame is
+    decoded and schema-validated before any is returned: one malformed
+    sub-frame rejects the whole batch at the boundary (no partial
+    dispatch).  Bodies may be pickled or native (v3) — decode_body
+    dispatches per body."""
+    return [decode_body(b) for b in split_frame_bodies(buf)]
 
 
 # ---------------------------------------------------------------------------
@@ -324,6 +376,14 @@ _STAT_KEYS = (
     "flush_linger",
     "flush_explicit",
     "flush_direct",     # unbatched TypedConn.send / single passthrough
+    # codec split: how many control bodies this process pickled vs
+    # native-encoded (and the decode twins).  pickle_* per task is the
+    # deterministic acceptance metric of the native-codec work — host
+    # noise can fake an ops/s win, a counter can't.
+    "pickle_encodes",
+    "pickle_decodes",
+    "native_encodes",
+    "native_decodes",
 )
 _stats: Dict[str, int] = {k: 0 for k in _STAT_KEYS}
 
@@ -340,6 +400,17 @@ def _count(n_logical: int, n_bytes: int, reason: str) -> None:
             _stats[key] += 1
 
 
+def _count_codec(
+    pickle_encodes: int = 0, pickle_decodes: int = 0,
+    native_encodes: int = 0, native_decodes: int = 0,
+) -> None:
+    with _stats_lock:
+        _stats["pickle_encodes"] += pickle_encodes
+        _stats["pickle_decodes"] += pickle_decodes
+        _stats["native_encodes"] += native_encodes
+        _stats["native_decodes"] += native_decodes
+
+
 def stats() -> Dict[str, int]:
     """Snapshot of this process's wire counters."""
     _fork_check()
@@ -348,8 +419,6 @@ def stats() -> Dict[str, int]:
 
 
 def stats_enabled() -> bool:
-    from ray_tpu._private import config as _config
-
     return bool(_config.get("wire_stats"))
 
 
@@ -376,8 +445,6 @@ _flusher_started = False
 
 
 def _linger_s() -> float:
-    from ray_tpu._private import config as _config
-
     return max(_config.get("wire_flush_us"), 0) / 1e6
 
 
@@ -489,7 +556,7 @@ class TypedConn:
     def send(self, obj: Any) -> None:
         if faults.ENABLED and faults.point("wire.send", key=_kind(obj)) == "drop":
             return  # frame lost on the wire; the sender believes it went out
-        buf = encode(obj)
+        buf = _HEADER + encode_body(obj)
         with self._send_lock:
             self._c.send_bytes(buf)
             _count(1, len(buf), "direct")
@@ -525,6 +592,18 @@ class TypedConn:
         them, so an epoll/wait would strand a buffered tail."""
         return len(self._rbuf)
 
+    def recv_bodies(self) -> List[bytes]:
+        """One physical frame's raw sub-frame bodies, NO decode (io-shard
+        forward path: native bodies ship head-ward untouched).  Must not
+        be mixed with recv() on the same conn while decoded sub-frames
+        are buffered — the interleaving would reorder the stream."""
+        if self._rbuf:
+            raise RuntimeError(
+                "recv_bodies() with decoded sub-frames pending would "
+                "reorder the stream"
+            )
+        return [bytes(b) for b in split_frame_bodies(self._c.recv_bytes())]
+
     # raw passthrough (object-transfer body, recv_into via fileno)
     def send_bytes(self, b) -> None:
         self._c.send_bytes(b)
@@ -554,8 +633,9 @@ class TypedConn:
 class BatchingConn:
     """Coalescing sender over a TypedConn (recv side passes through).
 
-    send() pickles the message immediately (cheap, and the bytes are what
-    the size threshold meters) and queues it; the pending run is flushed
+    send() encodes the message immediately (native codec or pickle —
+    cheap, and the bytes are what the size threshold meters) and queues
+    it; the pending run is flushed
     as ONE physical frame on size / linger / explicit flush.  A single
     pending message flushes as a plain frame — the batch envelope only
     appears when it pays for itself.
@@ -578,8 +658,6 @@ class BatchingConn:
     )
 
     def __init__(self, conn, batch_bytes: Optional[int] = None):
-        from ray_tpu._private import config as _config
-
         self._c = wrap(conn)
         self.send_lock = lock_watchdog.make_lock("BatchingConn.send_lock")
         self._pending: List[bytes] = []
@@ -612,7 +690,7 @@ class BatchingConn:
             raise OSError("connection previously failed a batch flush")
         if faults.ENABLED and faults.point("wire.send", key=_kind(obj)) == "drop":
             return  # frame lost on the wire; the sender believes it went out
-        body = pickle.dumps(obj, protocol=5)
+        body = encode_body(obj)
         with self.send_lock:
             if not self._pending:
                 self._pending_first_kind = _kind(obj)
@@ -678,7 +756,7 @@ class BatchingConn:
     def drain_pending(self) -> List[Any]:
         """drain_pending_bodies, decoded (tests/diagnostics — do NOT call
         while holding a conn lock, see above)."""
-        return [pickle.loads(b) for b in self.drain_pending_bodies()]
+        return [decode_body(b) for b in self.drain_pending_bodies()]
 
     def send_body(self, body: bytes) -> None:
         """Queue an already-pickled body (replay of a drained tail)."""
@@ -700,6 +778,9 @@ class BatchingConn:
 
     def recv(self) -> Any:
         return self._c.recv()
+
+    def recv_bodies(self) -> List[bytes]:
+        return self._c.recv_bodies()
 
     def pending_frames(self) -> int:
         return self._c.pending_frames()

@@ -1046,6 +1046,24 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
 
     def peer_handler(msg: tuple, reply) -> None:
         if msg[0] == "pcall":
+            spec = msg[1]
+            if (
+                spec.actor_id is None
+                and not spec.is_actor_creation
+                and spec.max_concurrency <= 1
+            ):
+                # Leased plain task: execute INLINE on this conn's recv
+                # thread.  A leased worker serves exactly ONE caller and
+                # the conn is its FIFO, so ordering and serialization
+                # are identical to the task_q route — what disappears is
+                # the queue handoff (two futex waits + a context switch
+                # per task, a measured slice of per-task wall on a
+                # contended host).  Actor calls keep the queue: their
+                # cross-conn ordering and max_concurrency semantics live
+                # there.
+                spec._recv_t = time.time()
+                _run_and_reply(("task", spec, None), reply)
+                return
             route_task(("task", msg[1], None), reply)
         elif msg[0] == "pcancel":
             # Best-effort: queued (not yet started) calls are dropped at

@@ -105,7 +105,16 @@ class Histogram(Metric):
         self._counts: Dict[Tuple, int] = {}
 
     def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
-        k = _tag_key(self._resolve_tags(tags))
+        self.observe_resolved(_tag_key(self._resolve_tags(tags)), value)
+
+    def resolved_key(self, tags: Optional[Dict[str, str]] = None) -> Tuple:
+        """Pre-resolve a tag set into the internal series key.  Hot paths
+        observing the SAME tags repeatedly (the head folds ~8 stage
+        samples per finished task) cache this once instead of paying the
+        merge + sort per observation."""
+        return _tag_key(self._resolve_tags(tags))
+
+    def observe_resolved(self, k: Tuple, value: float):
         with self._lock:
             buckets = self._buckets.setdefault(k, [0] * (len(self.boundaries) + 1))
             idx = 0

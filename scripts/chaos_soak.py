@@ -647,6 +647,48 @@ def run_soak(
             f"drain: {[r['object_id'] for r in mem['leaks']][:10]}"
         )
 
+        # ---- lease revocation (ISSUE 11): the match=^done crash clause
+        # kills workers at their result-send hazard — each victim was an
+        # executing LEASEHOLDER (head-side when its task relayed,
+        # caller-side when direct), so the storm exercises the
+        # crash-revocation path throughout.  The POST-storm incarnation's
+        # counters start clean, so drive a small RELAYED burst (SPREAD is
+        # direct-ineligible — it must take the head's queued path and
+        # grant head-side leases) and then require convergence: every
+        # lease revoked or idle-reaped with its resources back in the
+        # pool.  A stranded lease would starve the cluster quietly.
+        @ray_tpu.remote(max_retries=5, scheduling_strategy="SPREAD")
+        def lease_probe(i):
+            return i
+
+        probe_out = ray_tpu.get(
+            [lease_probe.remote(i) for i in range(16)], timeout=120
+        )
+        assert probe_out == list(range(16))
+        lease_state = None
+        lease_deadline = time.monotonic() + 60
+        while time.monotonic() < lease_deadline:
+            try:
+                internal = state_api.telemetry_summary()["internal"]
+            except Exception:
+                time.sleep(1.0)
+                continue
+            lease_state = {
+                "granted": internal.get("task_leases_granted"),
+                "revoked": internal.get("task_leases_revoked"),
+                "lease_dispatches": internal.get("lease_dispatches"),
+                "live_at_quiesce": internal.get("head_task_leases"),
+            }
+            if lease_state["live_at_quiesce"] == 0.0:
+                break
+            time.sleep(1.0)
+        report["task_leases"] = lease_state
+        assert lease_state is not None, "telemetry unreachable at quiesce"
+        assert lease_state["granted"], "storm never exercised a task lease"
+        assert lease_state["live_at_quiesce"] == 0.0, (
+            f"task leases stranded after the storm: {lease_state}"
+        )
+
         # ---- the ledger: executions within retry budgets, kills fired.
         counts = _count_log(log_path)
         head_kills = report["kills"]["head"]

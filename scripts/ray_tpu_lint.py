@@ -122,8 +122,8 @@ def main(argv=None) -> int:
     for v in result.violations:
         by_pass.setdefault(v.pass_name, []).append(v)
     for pass_name in ("blocking-under-lock", "lock-order", "fault-registry",
-                      "hot-send", "gcs-mutation", "metric-names",
-                      "span-names"):
+                      "hot-send", "gcs-mutation", "journal-coverage",
+                      "metric-names", "span-names"):
         vs = by_pass.get(pass_name, [])
         new = [v for v in vs if v.key not in result.allowlist]
         print(

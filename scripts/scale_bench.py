@@ -92,6 +92,85 @@ def bench_many_tasks(m: int) -> dict:
     }
 
 
+def bench_backlog(n: int, spill_after: int) -> dict:
+    """Absorb an n-task backlog on one head with BOUNDED RSS (reference:
+    '1M queued tasks on one node', SURVEY.md §6 stress_tests).
+
+    Methodology: measure steady-state head RSS after a small warmup,
+    submit n dependency-free noop tasks as fast as the submit path goes
+    (specs beyond ready_queue_spill_after overflow to the disk segment —
+    runtime._ReadySpill), sample RSS throughout the drain, and prove
+    completion by counter delta: every 1000th task carries num_returns=1
+    and its value is asserted; the rest run with num_returns=0 (zero
+    result objects — the backlog stresses the QUEUE, not the store).
+    Zero lost results == tasks_finished advanced by exactly n and every
+    sampled return value is correct."""
+    import ray_tpu
+    from ray_tpu._private.runtime import get_runtime
+
+    rt = get_runtime()
+
+    @ray_tpu.remote(num_cpus=0.5, max_retries=5)
+    def nought():
+        return None
+
+    @ray_tpu.remote(num_cpus=0.5, max_retries=5)
+    def probe(i):
+        return i
+
+    # Warmup: workers booted, pools warm, THEN the steady-state floor.
+    ray_tpu.get([probe.remote(i) for i in range(200)], timeout=300)
+    time.sleep(1.0)
+    steady_gb = _rss_gb()
+    base_finished = rt.metrics["tasks_finished"] + rt.metrics["tasks_failed"]
+
+    peak_gb = steady_gb
+    probes = []
+    t0 = time.monotonic()
+    for i in range(n):
+        if i % 1000 == 999:
+            probes.append((i, probe.remote(i)))
+            peak_gb = max(peak_gb, _rss_gb())
+        else:
+            nought.options(num_returns=0).remote()
+    submit_dt = time.monotonic() - t0
+    spill = rt._ready_spill
+    spilled_peak = spill.appended if spill is not None else 0
+    backlog_peak = len(rt.tasks) + (spill.count if spill is not None else 0)
+
+    # Drain, sampling RSS once a second.
+    deadline = time.monotonic() + 3600
+    while time.monotonic() < deadline:
+        done = (
+            rt.metrics["tasks_finished"] + rt.metrics["tasks_failed"]
+            - base_finished
+        )
+        peak_gb = max(peak_gb, _rss_gb())
+        if done >= n:
+            break
+        time.sleep(1.0)
+    total_dt = time.monotonic() - t0
+    finished = rt.metrics["tasks_finished"] - base_finished
+    failed = rt.metrics["tasks_failed"]
+    vals = ray_tpu.get([r for _i, r in probes], timeout=600)
+    assert vals == [i for i, _r in probes], "probe results corrupted"
+    return {
+        "backlog_tasks": n,
+        "spill_after": spill_after,
+        "submit_per_s": round(n / submit_dt, 1),
+        "drain_per_s": round(n / total_dt, 1),
+        "specs_spilled": spilled_peak,
+        "backlog_peak": backlog_peak,
+        "tasks_finished": finished,
+        "tasks_failed": failed,
+        "lost_results": n - finished - failed,
+        "probes_verified": len(probes),
+        "steady_rss_gb": round(steady_gb, 3),
+        "peak_rss_gb": round(peak_gb, 3),
+        "rss_ratio": round(peak_gb / steady_gb, 2) if steady_gb else None,
+    }
+
+
 def bench_many_pgs(p: int) -> dict:
     import ray_tpu
 
@@ -239,8 +318,24 @@ def main(argv=None) -> int:
                     help="steady actor pool size during churn")
     ap.add_argument("--churn-waves", type=int, default=5)
     ap.add_argument("--churn-wave-size", type=int, default=20)
+    ap.add_argument(
+        "--backlog", type=int, default=0, metavar="N",
+        help="ONLY the backlog scenario: absorb N queued tasks on one "
+             "head with bounded RSS (ready-queue disk overflow), then "
+             "drain to completion with zero lost results",
+    )
+    ap.add_argument(
+        "--spill-after", type=int, default=10000,
+        help="ready_queue_spill_after for the backlog scenario (in-memory "
+             "backlog cap before specs overflow to disk; ~1.2KB of head "
+             "RSS per in-memory task is the knob's direct meaning)",
+    )
     ap.add_argument("--output", default=None)
     args = ap.parse_args(argv)
+
+    if args.backlog:
+        # Must be exported before ray_tpu.init resolves the knob.
+        os.environ["RAY_TPU_READY_QUEUE_SPILL_AFTER"] = str(args.spill_after)
 
     import ray_tpu
 
@@ -255,6 +350,16 @@ def main(argv=None) -> int:
             "64-node clusters (release/benchmarks/README.md)"
         ),
     }
+    if args.backlog:
+        out["backlog"] = bench_backlog(args.backlog, args.spill_after)
+        print(json.dumps({"backlog": out["backlog"]}), flush=True)
+        ray_tpu.shutdown()
+        line = json.dumps(out)
+        print(line)
+        if args.output:
+            with open(args.output, "w") as f:
+                f.write(line + "\n")
+        return 0
     if args.churn:
         out["actor_churn"] = bench_actor_churn(
             args.churn_live, args.churn_waves, args.churn_wave_size

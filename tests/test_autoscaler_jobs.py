@@ -127,8 +127,18 @@ def test_tpu_resource_discovery_env():
             return "ok"
 
         assert ray_tpu.get(on_chip.remote(), timeout=60) == "ok"
-        # 4 chips: a 5th concurrent reservation must queue.
-        assert ray_tpu.available_resources().get("TPU") == 4.0
+        # The full chip pool returns once the task's lease idles out
+        # (lease reuse holds the reservation across same-shape tasks;
+        # another shape would reclaim it immediately via demand
+        # revocation — RAY_TPU_LEASE_IDLE_S is only the IDLE bound).
+        deadline = time.monotonic() + 10
+        avail = None
+        while time.monotonic() < deadline:
+            avail = ray_tpu.available_resources().get("TPU")
+            if avail == 4.0:
+                break
+            time.sleep(0.2)
+        assert avail == 4.0
     finally:
         ray_tpu.shutdown()
         os.environ.pop("RAY_TPU_CHIPS", None)

@@ -100,6 +100,82 @@ def test_chaos_task_chains_survive_worker_kills(ray_start_regular):
     assert killer.kills > 0, "chaos never actually fired"
 
 
+def test_chaos_lease_revocation_on_worker_kill(ray_start_regular):
+    """ISSUE 11 tier-1 twin of the soak's lease clause: SIGKILLing a
+    worker while it holds a HOT head-side task lease must (a) revoke the
+    lease (counted + journal-hooked), (b) re-drive the in-flight
+    same-key task on its retry budget to a correct result, and (c) leave
+    no stranded capacity — every lease's resources return to the pool."""
+    rt = _rt()
+
+    @ray_tpu.remote(max_retries=5)
+    def slow(i):
+        time.sleep(0.15)
+        return i
+
+    # Warm the lease pool (first task pays placement + binds a worker).
+    assert ray_tpu.get(slow.remote(-1), timeout=60) == -1
+    base_revoked = rt.metrics["task_leases_revoked"]
+    refs = [slow.remote(i) for i in range(12)]
+
+    # Kill leaseholders MID-TASK (idle_since None == executing).
+    killed = 0
+    deadline = time.monotonic() + 30
+    while killed < 2 and time.monotonic() < deadline:
+        with rt.lock:
+            hot = [
+                le.worker_id
+                for pool in rt.task_leases.values()
+                for le in pool
+                if le.idle_since is None
+            ]
+            victims = [
+                rt.workers[w] for w in hot
+                if w in rt.workers and rt.workers[w].proc is not None
+            ]
+        if victims:
+            try:
+                victims[0].proc.kill()
+                killed += 1
+            except Exception:
+                pass
+            time.sleep(0.4)
+        else:
+            time.sleep(0.05)
+    assert killed > 0, "never caught a worker holding a hot lease"
+
+    # (b) every task still lands its correct result, on budget.
+    assert ray_tpu.get(refs, timeout=120) == list(range(12))
+    # (a) each kill revoked a lease.
+    assert rt.metrics["task_leases_revoked"] >= base_revoked + killed
+    # (c) no stranded capacity: once the survivors' leases idle out
+    # (RAY_TPU_LEASE_IDLE_S sweep), availability returns to the full
+    # cluster total and no lease references a dead worker.
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        with rt.lock:
+            live = [
+                le for pool in rt.task_leases.values() for le in pool
+            ]
+            dead_bound = [
+                le for le in live
+                if rt.workers.get(le.worker_id) is None
+                or rt.workers[le.worker_id].state == "dead"
+            ]
+        total = rt.cluster_resources()
+        avail = rt.available_resources()
+        stranded = {
+            k: total[k] - avail.get(k, 0.0)
+            for k in total
+            if total[k] - avail.get(k, 0.0) > 1e-6
+        }
+        if not dead_bound and not stranded and not live:
+            break
+        time.sleep(0.5)
+    assert not dead_bound, f"leases still bound to dead workers: {dead_bound}"
+    assert not stranded, f"lease resources stranded: {stranded}"
+
+
 def test_chaos_restartable_actor_survives_kills(ray_start_regular):
     """A max_restarts actor keeps serving (with retry-budgeted calls)
     while its worker is repeatedly killed."""

@@ -784,6 +784,83 @@ def test_hot_send_covers_io_shard_module(tmp_path):
     assert hot_send.scan_file(p, "ray_tpu/other.py") == []
 
 
+def test_journal_coverage_flags_unjournaled_mutator(tmp_path):
+    """A GlobalState mutator that writes a journaled table without ever
+    calling self._journal(...) silently skips the durability journal —
+    the batched path makes this invisible to manual testing (the write
+    is decoupled from the mutation in time), so it fails tier-1."""
+    from ray_tpu._private.analysis import journal_coverage
+
+    p = _write(
+        tmp_path,
+        "gcs.py",
+        """
+        class GlobalState:
+            def register_actor(self, info):
+                self.actors[info.actor_id] = info
+                self._journal(("actor_register", info.actor_id))
+
+            def sneaky_bind(self, ns, name, aid):
+                self.named_actors[(ns, name)] = aid  # seeded: no journal
+
+            def sneaky_drop(self, aid):
+                self.actors.pop(aid, None)  # seeded: no journal
+
+            def import_functions(self, functions):
+                # restore-path bulk loader: exempt by name
+                self.functions.update(functions)
+
+            def kv_put(self, key, value, namespace=""):
+                # kv is snapshot-only by design: not a journaled table
+                self.kv.setdefault(namespace, {})[key] = value
+        """,
+    )
+    found = journal_coverage.scan_file(p, "ray_tpu/_private/gcs.py")
+    keys = {v.key for v in found}
+    assert keys == {
+        "journal-coverage:ray_tpu/_private/gcs.py:sneaky_bind:named_actors",
+        "journal-coverage:ray_tpu/_private/gcs.py:sneaky_drop:actors",
+    }, keys
+    # Outside the mutator module only the kind catalog applies.
+    assert journal_coverage.scan_file(p, "fix_gcs.py") == []
+
+
+def test_journal_coverage_flags_unreviewed_entry_kind(tmp_path):
+    """Every literal journal entry kind must be in the reviewed catalog:
+    a new kind whose restore-time handling nobody decided replays as
+    silence after a head bounce."""
+    from ray_tpu._private.analysis import journal_coverage
+
+    p = _write(
+        tmp_path,
+        "fix_kinds.py",
+        """
+        class Runtime:
+            def fine(self, oid, spec):
+                self._journal_append(("lineage", oid, spec))
+
+            def fine_lease(self, lease_id):
+                self._journal_append(("lease", "revoke", lease_id, "idle"))
+
+            def bad(self, x):
+                self._journal_append(("brand_new_kind", x))  # seeded
+        """,
+    )
+    found = journal_coverage.scan_file(p, "fix_kinds.py")
+    assert len(found) == 1, [v.key for v in found]
+    assert found[0].key == "journal-coverage:fix_kinds.py:kind:brand_new_kind"
+
+
+def test_journal_coverage_committed_tree_is_clean():
+    """The real gcs.py mutators all reach journal_hook and every kind the
+    runtime journals is reviewed."""
+    from ray_tpu._private.analysis import journal_coverage
+
+    for rel in ("ray_tpu/_private/gcs.py", "ray_tpu/_private/runtime.py"):
+        path = os.path.join(REPO, *rel.split("/"))
+        assert journal_coverage.scan_file(path, rel) == [], rel
+
+
 def test_gcs_mutation_exempts_the_mutator_module(tmp_path):
     from ray_tpu._private.analysis import gcs_mutation
 

@@ -65,16 +65,16 @@ def test_version_mismatch_clean_error():
 
 
 def test_version_mismatch_names_both_versions():
-    """A v1 peer against this v2 process: the error names BOTH versions so
+    """A v1 peer against this v3 process: the error names BOTH versions so
     the operator knows which side to upgrade."""
     frame = bytearray(wire.encode(("heartbeat",)))
     struct.pack_into("<H", frame, 2, 1)
-    with pytest.raises(wire.ProtocolError, match=r"peer speaks v1.*speaks v2"):
+    with pytest.raises(wire.ProtocolError, match=r"peer speaks v1.*speaks v3"):
         wire.decode(bytes(frame))
     # Batch frames carry the same version fence.
     batch = bytearray(wire.encode_batch([pickle.dumps(("heartbeat",))]))
     struct.pack_into("<H", batch, 2, 1)
-    with pytest.raises(wire.ProtocolError, match=r"peer speaks v1.*speaks v2"):
+    with pytest.raises(wire.ProtocolError, match=r"peer speaks v1.*speaks v3"):
         wire.decode_frames(bytes(batch))
 
 
