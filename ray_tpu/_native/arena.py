@@ -98,12 +98,15 @@ class PinnedView:
     """Zero-copy view of a sealed object that PINS its bytes for its own
     lifetime (plasma's client-hold semantics): the arena will not reuse the
     memory until this object is garbage-collected, even if the object is
-    deleted meanwhile (deferred free)."""
+    deleted meanwhile (deferred free).  The view is READ-ONLY: sealed
+    objects are immutable, and a reader scribbling into the shared mapping
+    would corrupt every other holder of the object (same contract as the
+    file backend's PROT_READ mmaps)."""
 
     __slots__ = ("view", "_finalizer", "__weakref__")
 
     def __init__(self, arena: "Arena", object_id: str, view: memoryview):
-        self.view = view
+        self.view = view.toreadonly()
         import weakref
 
         self._finalizer = weakref.finalize(
@@ -127,12 +130,37 @@ class Arena:
         if not arena._closed:
             arena._lib.arena_release(arena._h, object_id.encode())
 
-    def __init__(self, path: str, capacity: Optional[int] = None):
+    def __init__(
+        self, path: str, capacity: Optional[int] = None, fd: Optional[int] = None
+    ):
+        """Open (or create, when capacity is given) the arena at `path`.
+
+        fd: join via an inherited/SCM_RIGHTS-passed file descriptor of the
+        arena file instead of opening the path — the daemon hands its
+        workers the open fd over the existing AF_UNIX channels (netutil
+        send_fd/recv_fd), so a worker maps the store even when the path
+        itself is not resolvable from its mount/permission view.  The fd
+        is duplicated; the caller keeps ownership of its copy.
+        """
         lib = load_native()
         if lib is None:
             raise RuntimeError("native arena unavailable (no g++ / build failed)")
         self._lib = lib
         self.path = path
+        if fd is not None:
+            # /proc/self/fd/N resolves the passed descriptor to the same
+            # inode for the C++ side's own open(); the Python mapping
+            # comes straight off the duplicated fd.
+            dup = os.dup(fd)
+            try:
+                self._h = lib.arena_open(f"/proc/self/fd/{dup}".encode())
+                if not self._h:
+                    raise RuntimeError(f"arena_open via fd failed for {path}")
+                self._mm = mmap.mmap(dup, 0)
+            finally:
+                os.close(dup)
+            self._closed = False
+            return
         if capacity is not None and not os.path.exists(path):
             if lib.arena_init(path.encode(), capacity) != 0 and not os.path.exists(path):
                 raise RuntimeError(f"arena_init failed for {path}")
@@ -174,6 +202,13 @@ class Arena:
 
     def allocate(self, object_id: str, size: int) -> memoryview:
         """Two-phase create: returns a writable view; call seal() after."""
+        return self.allocate_at(object_id, size)[0]
+
+    def allocate_at(self, object_id: str, size: int):
+        """allocate() plus the slot's heap offset: (view, offset).  The
+        transfer plane's pull board publishes the offset so the node's
+        OTHER processes (the serving daemon) can relay the landed prefix
+        of an in-flight pull straight out of this pending slot."""
         bid = self._check_id(object_id)
         off = self._lib.arena_alloc(self._h, bid, size)
         if off == -2:
@@ -182,7 +217,16 @@ class Arena:
             raise RuntimeError("arena poisoned")
         if off < 0:
             raise MemoryError(f"arena full: need {size}")
-        return memoryview(self._mm)[off : off + size]
+        return memoryview(self._mm)[off : off + size], int(off)
+
+    def peek(self, offset: int, size: int) -> memoryview:
+        """READ-ONLY raw slice of the heap at (offset, size) — the relay
+        server's view into a pending pull slot published via a transfer
+        board.  Unpinned by design: the board protocol guarantees the
+        slot stays allocated while the board file exists, and every
+        relayed chunk carries a crc so a torn read is detected, never
+        propagated."""
+        return memoryview(self._mm)[offset : offset + size].toreadonly()
 
     def seal(self, object_id: str) -> None:
         if self._lib.arena_seal(self._h, self._check_id(object_id)) != 0:

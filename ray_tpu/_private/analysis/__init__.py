@@ -43,6 +43,7 @@ from typing import Dict, List, Optional, Sequence
 from ray_tpu._private.analysis.common import Violation, iter_py_files
 from ray_tpu._private.analysis import (
     blocking,
+    copy_coverage,
     fault_registry,
     gcs_mutation,
     hot_send,
@@ -62,6 +63,7 @@ PASSES = (
     "journal-coverage",
     "metric-names",
     "span-names",
+    "copy-coverage",
 )
 
 
@@ -106,6 +108,7 @@ def run_analysis(
         violations.extend(gcs_mutation.scan_file(path, rel))
         violations.extend(journal_coverage.scan_file(path, rel))
         violations.extend(metric_names.scan_file(path, rel))
+        violations.extend(copy_coverage.scan_file(path, rel))
     points = fault_registry.collect_points(files)
     if catalog_path is not None:
         violations.extend(fault_registry.check_catalog(points, catalog_path))

@@ -145,6 +145,27 @@ _DEFS: Dict[str, tuple] = {
         "(connect, header, each chunk) — a wedged server fails the fetch "
         "instead of hanging the get (ray: pull retry timer spirit)",
     ),
+    "relay_pipeline": (
+        1, int,
+        "1 = broadcast pulls get a pipelined transfer plan: in-flight "
+        "pullers re-serve landed chunks mid-transfer (chain/tree "
+        "broadcast, ray: push_manager.h chunk pipelining); 0 = classic "
+        "staggered whole-object rounds (grants capped at sealed copies)",
+    ),
+    "relay_fanout": (
+        2, int,
+        "max concurrent downstream pullers one feed (sealed source OR "
+        "in-flight relay) serves in a transfer plan; each admitted "
+        "puller immediately becomes a feed itself, so admission capacity "
+        "grows with the tree instead of with completed rounds",
+    ),
+    "relay_stall_timeout_s": (
+        10.0, float,
+        "relay liveness bound, both sides: a relay server whose upstream "
+        "watermark stops advancing closes the conn after this long, and "
+        "a receiver whose relay feed goes silent fails the fetch and "
+        "falls back to a sealed source (re-plan, not wedge)",
+    ),
     "node_ip": (
         "127.0.0.1", str,
         "address this node's object server advertises to other nodes "

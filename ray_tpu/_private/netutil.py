@@ -41,6 +41,25 @@ def recv_conn_fd(channel):
     return Connection(fd)
 
 
+def send_fd(channel, fd: int, dest_pid: int) -> None:
+    """Ship a PLAIN file descriptor (not a connection) over an AF_UNIX
+    channel via SCM_RIGHTS — the arena-handoff primitive: a node daemon
+    passes its open arena fd to the zygote, whose forked workers inherit
+    it and mmap the store without resolving the path.  The caller keeps
+    (and must close) its own copy; the receiver gets a duplicate."""
+    from multiprocessing import reduction
+
+    reduction.send_handle(channel, fd, dest_pid)
+
+
+def recv_fd(channel) -> int:
+    """Receive a plain fd passed with send_fd; the returned descriptor is
+    owned by the caller."""
+    from multiprocessing import reduction
+
+    return reduction.recv_handle(channel)
+
+
 def set_nodelay(conn) -> None:
     """Disable Nagle on a multiprocessing.connection.Connection (TCP only;
     silently no-ops for anything else)."""

@@ -113,9 +113,24 @@ def main() -> None:
     capacity = _config.get("object_store_memory") or _default_capacity(store_root)
     store = ShmStore(session, capacity=capacity, dir_path=store_dir)
     authkey = bytes.fromhex(authkey_hex)
+    # read_board: the pipelined-broadcast relay path — this server streams
+    # the landed prefix of a pull still in flight in one of this node's
+    # workers (the board file in the shared store dir carries progress).
     obj_server = ObjectServer(
-        store.get_raw, authkey, advertise_host=_config.get("node_ip")
+        store.get_raw, authkey, advertise_host=_config.get("node_ip"),
+        read_board=store.read_board,
     )
+    # The node arena's fd, held open for handoff to workers: the zygote
+    # gets it over its AF_UNIX pipe (SCM_RIGHTS, netutil.send_fd) and
+    # forked workers inherit it; directly-spawned workers inherit via
+    # pass_fds.  A worker that cannot map the fd falls back to the path,
+    # then to the file-per-object store (store.py arena.map fallback).
+    arena_fd = None
+    if store.arena is not None:
+        try:
+            arena_fd = os.open(store.arena.path, os.O_RDWR)
+        except OSError:
+            arena_fd = None
     # This node's log dir: workers' stdout/stderr land here; the monitor
     # below tails the files and forwards fresh lines to the head
     # (ray: per-node log_monitor.py publishing to the driver).
@@ -221,6 +236,20 @@ def main() -> None:
         zyg["conn"] = wire.wrap(parent)
         zyg["proc"] = p
         zyg["env"] = env
+        # Hand the node arena's open fd to the zygote over this AF_UNIX
+        # pipe (SCM_RIGHTS): the frame announces it, the ancillary
+        # message carries it, and every forked worker inherits the
+        # descriptor (the zygote stamps RAY_TPU_ARENA_FD with ITS fd
+        # number).  Failure is non-fatal — workers fall back to opening
+        # the arena by path.
+        if arena_fd is not None:
+            from ray_tpu._private import netutil
+
+            try:
+                zyg["conn"].send(("arena_fd", store.arena.path))
+                netutil.send_fd(zyg["conn"], arena_fd, p.pid)
+            except (OSError, ValueError):
+                pass
 
     def zygote_fork(wid: str, full_env: Dict[str, str]) -> bool:
         zc = zyg["conn"]
@@ -500,11 +529,16 @@ def main() -> None:
                     start_zygote()  # died/never started: next spawn forks
                 if not zygote_fork(wid, env):
                     outf, errf = open_worker_logs(log_dir, wid)
+                    if arena_fd is not None:
+                        # Direct spawn inherits the arena fd (the zygote
+                        # path receives it via SCM_RIGHTS instead).
+                        env["RAY_TPU_ARENA_FD"] = str(arena_fd)
                     try:
                         children[wid] = subprocess.Popen(
                             [sys.executable, "-m", "ray_tpu._private.worker_proc"],
                             env=env,
                             close_fds=True,
+                            pass_fds=(arena_fd,) if arena_fd is not None else (),
                             stdout=outf,
                             stderr=errf,
                         )

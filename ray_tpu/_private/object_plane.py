@@ -1,4 +1,4 @@
-"""Cross-host object transfer: per-node object servers + chunked pull.
+"""Cross-host object transfer: per-node object servers + pipelined pulls.
 
 The reference moves objects between nodes with a push/pull object manager
 attached to each raylet (ray: src/ray/object_manager/object_manager.h:117,
@@ -13,22 +13,38 @@ and transfer reduces to a pull protocol:
     sealed object out of that node's local shm store in fixed-size chunks;
   * the driver serves its own (head-node) store through one-shot
     "object_fetch" connections on its main listener — no extra port;
-  * a consumer that misses locally asks the owner, gets back a list of
-    endpoints holding a copy, pulls from one into its OWN node store
-    (allocate-then-fill, zero-copy into the arena when available), seals,
-    and reports the new copy so siblings on its node skip the wire.
+  * a consumer that misses locally asks the owner, gets back a TRANSFER
+    PLAN (a feed endpoint + sealed-source fallbacks), pulls into its OWN
+    node store (allocate-then-fill, zero-copy into the arena when
+    available), seals, and reports the new copy.
+
+PIPELINED RELAY (PushManager-style chunk pipelining, SURVEY.md §2.1): a
+node that is still PULLING an object re-serves the chunks it has already
+landed.  The puller publishes progress through a transfer board
+(store.py: a tiny mmap'd watermark file whose data region IS the pull's
+receive buffer), and this module's relay server streams verified bytes
+out of the board as the watermark advances — so an N-node broadcast forms
+a chain/tree where every hop transfers concurrently instead of in
+log2(N) staggered whole-object rounds.  Relay-served chunks carry a
+per-chunk integrity checksum (`u32 len | bytes | u32 sum`, zlib.adler32);
+a receiver verifies each chunk BEFORE advancing its own board, so a relay
+never propagates a torn chunk downstream.  When a relay
+dies mid-serve, the downstream receiver falls back to the sealed sources
+in its plan (or re-asks the owner for a fresh plan) — re-plan, not wedge.
 
 Admission control: the server bounds concurrent outbound transfers with a
-semaphore (excess fetches queue on accept), and chunking keeps any single
-send from pinning a whole object in socket buffers — the pull_manager's
+semaphore (excess fetches queue on accept), and the owner's transfer plan
+bounds the downstreams per feed (relay_fanout) — the pull_manager's
 "bounded in-flight bytes" intent at this design's scale.
 """
 
 from __future__ import annotations
 
 import os
+import struct
 import threading
-from typing import Callable, Iterable, List, Optional, Tuple
+import zlib
+from typing import Callable, List, Optional, Tuple
 
 from ray_tpu._private import config as _config
 from ray_tpu._private import faults
@@ -38,7 +54,32 @@ def _chunk_size() -> int:
     return _config.get("object_transfer_chunk_bytes")
 
 
-def stream_object(conn, read_raw: Callable[[str], Optional[tuple]], oid: str) -> None:
+def _stall_timeout() -> float:
+    return _config.get("relay_stall_timeout_s")
+
+
+# Per-chunk integrity checksum for relay-served bytes.  adler32, not
+# crc32: measured 1.5x faster per byte on the bench host where crc32
+# costs as much as an extra memcpy of the chunk — the check exists to
+# catch TORN reads out of a live board (a protocol/race bug), not
+# adversarial corruption, and adler32 catches those with the same
+# certainty at a fraction of the relay hop's CPU.
+_chunk_sum = zlib.adler32
+
+
+def _write_all(fd: int, mv: memoryview) -> None:
+    off = 0
+    total = len(mv)
+    while off < total:
+        off += os.write(fd, mv[off:total])
+
+
+def stream_object(
+    conn,
+    read_raw: Callable[[str], Optional[tuple]],
+    oid: str,
+    read_board: Optional[Callable[[str], object]] = None,
+) -> None:
     """Stream one object out over an accepted transfer connection and close
     it.  ONE implementation of the wire protocol — the daemon ObjectServer
     and the head's handshake-thread handler both call this, so the framing
@@ -49,6 +90,10 @@ def stream_object(conn, read_raw: Callable[[str], Optional[tuple]], oid: str) ->
     the receiver can seal it byte-for-byte without re-serialization.
     (A sendfile() fast path was measured SLOWER than mmap write() on hot
     tmpfs pages — the fallback IS the fast path.)
+
+    read_board(oid) -> store.BoardReader | None: when the object is not
+    sealed here but an in-flight pull's transfer board exists, the relay
+    path serves the landed prefix mid-transfer (pipelined broadcast).
     """
     try:
         # error -> the except below: the peer sees EOF mid-transfer and
@@ -56,6 +101,28 @@ def stream_object(conn, read_raw: Callable[[str], Optional[tuple]], oid: str) ->
         if faults.ENABLED:
             faults.point("object.serve", key=oid)
         raw = read_raw(oid)
+        if raw is None and read_board is not None:
+            # The owner's plan told the downstream THIS node is pulling,
+            # but its puller may not have allocated yet (plans are handed
+            # out before the first byte moves).  Wait briefly for the
+            # board (or a seal) to appear instead of answering "missing"
+            # — without this the whole chain degrades to source pulls in
+            # the first milliseconds of a broadcast.
+            import time as _time
+
+            wait_until = _time.monotonic() + min(1.0, _stall_timeout())
+            board = read_board(oid)
+            while board is None and raw is None and _time.monotonic() < wait_until:
+                _time.sleep(0.005)
+                raw = read_raw(oid)
+                if raw is None:
+                    board = read_board(oid)
+            if board is not None:
+                try:
+                    _stream_relay(conn, read_raw, board, oid)
+                finally:
+                    board.close()
+                return
         if raw is None:
             conn.send(("missing",))
             return
@@ -78,8 +145,74 @@ def stream_object(conn, read_raw: Callable[[str], Optional[tuple]], oid: str) ->
             pass
 
 
-def serve_fetch_conn(conn, read_raw: Callable[[str], Optional[tuple]]) -> None:
-    """Recv one ("object_fetch", oid) request and stream the reply."""
+def _stream_relay(conn, read_raw, board, oid: str) -> None:
+    """Serve an object OUT OF AN IN-FLIGHT PULL: chunks up to the board's
+    verified watermark stream immediately; the loop then chases the
+    watermark as the upstream transfer lands more bytes.  Every chunk is
+    framed `u32 len | bytes | u32 sum` (_chunk_sum) — the downstream
+    receiver verifies before advancing its own board, so a torn read here
+    can never propagate.  If the writer dies (board failed/gone without a
+    seal) the conn just closes: the downstream falls back to a sealed
+    source."""
+    import time
+
+    total = board.total
+    conn.send(("relay", total, _chunk_size()))
+    fd = conn.fileno()
+    chunk = _chunk_size()
+    off = 0
+    deadline = time.monotonic() + _config.get("object_transfer_timeout_s")
+    stall_at = time.monotonic() + _stall_timeout()
+    while off < total:
+        wm = board.watermark()
+        if wm > off:
+            n = min(chunk, wm - off)
+            view = board.data(off, n)
+            if faults.ENABLED:
+                # error -> downstream sees EOF mid-relay and falls back to
+                # a sealed source; crash kills the serving daemon exactly
+                # here (the CHAOS_r10 mid-relay clause).
+                faults.point("transfer.chunk_relay", key=oid)
+            _write_all(fd, struct.pack("<I", n))
+            _write_all(fd, view)
+            _write_all(fd, struct.pack("<I", _chunk_sum(view)))
+            off += n
+            stall_at = time.monotonic() + _stall_timeout()
+            continue
+        if board.failed():
+            return  # upstream pull aborted: close; downstream re-plans
+        if board.gone():
+            # Writer finished (sealed) or died.  A sealed copy serves the
+            # remainder through the same crc framing; otherwise abort.
+            raw = read_raw(oid)
+            if raw is None:
+                return
+            buf, _keepalive = raw
+            if len(buf) != total:
+                return  # respilled/re-sealed different image: bail out
+            mv = memoryview(buf)
+            while off < total:
+                n = min(chunk, total - off)
+                view = mv[off : off + n]
+                _write_all(fd, struct.pack("<I", n))
+                _write_all(fd, view)
+                _write_all(fd, struct.pack("<I", _chunk_sum(view)))
+                off += n
+            return
+        now = time.monotonic()
+        if now > deadline or now > stall_at:
+            return  # wedged upstream: close; downstream falls back
+        time.sleep(0.002)
+
+
+def serve_fetch_conn(
+    conn,
+    read_raw: Callable[[str], Optional[tuple]],
+    read_board: Optional[Callable[[str], object]] = None,
+) -> None:
+    """Recv one ("object_fetch", oid[, relay_ok]) request and stream the
+    reply.  relay_ok (protocol extension, same-session peers only) lets
+    the server answer from an in-flight pull's transfer board."""
     try:
         req = conn.recv()
     except (OSError, EOFError):
@@ -94,16 +227,16 @@ def serve_fetch_conn(conn, read_raw: Callable[[str], Optional[tuple]]) -> None:
         except OSError:
             pass
         return
-    stream_object(conn, read_raw, req[1])
+    relay_ok = len(req) > 2 and bool(req[2])
+    stream_object(conn, read_raw, req[1], read_board if relay_ok else None)
 
 
 class ObjectServer:
     """Per-node transfer server (daemon-side object manager).
 
-    ray: object_manager.h:117 — ours serves only Pull (the driver's
-    directory turns broadcast into N pulls; a dedicated push path is not
-    needed when every consumer knows where copies live).
-    """
+    ray: object_manager.h:117 — ours serves Pull plus the mid-transfer
+    RELAY path (the owner's transfer plan points downstream pullers at
+    nodes that are still pulling; this server streams their boards)."""
 
     def __init__(
         self,
@@ -111,10 +244,12 @@ class ObjectServer:
         authkey: bytes,
         advertise_host: str,
         bind_host: str = "0.0.0.0",
+        read_board: Optional[Callable[[str], object]] = None,
     ):
         from multiprocessing.connection import Listener
 
         self._read_raw = read_raw
+        self._read_board = read_board
         self._sem = threading.BoundedSemaphore(
             _config.get("object_transfer_max_concurrency")
         )
@@ -147,7 +282,7 @@ class ObjectServer:
 
     def _serve_one(self, conn) -> None:
         with self._sem:
-            serve_fetch_conn(conn, self._read_raw)
+            serve_fetch_conn(conn, self._read_raw, self._read_board)
 
     def close(self) -> None:
         self._shutdown = True
@@ -189,50 +324,44 @@ def _connect_with_deadline(endpoint: Tuple[str, int], authkey: bytes, timeout: f
     return wrap(conn)
 
 
-def _raw_chunks(conn, total: int, deadline: float):
-    """Yield the raw transfer body as memoryview chunks read with
-    recv_into on a reusable buffer — one kernel read per chunk, and the
-    store's allocate-then-fill copies each chunk straight into the arena
-    mmap (one copy total on the receive side)."""
-    import socket
+def _recv_exact(sock, view, deadline) -> None:
+    """recv_into `view` completely; bounded by deadline AND the relay
+    stall window (each successful recv resets neither — the per-call
+    socket timeout is min(remaining, stall), so a wedged upstream fails
+    in stall-time while a slow-but-flowing one keeps going)."""
+    import socket as _socket
     import time
+
+    got = 0
+    total = len(view)
+    while got < total:
+        if faults.ENABLED:
+            faults.point("object.chunk")  # error -> pull fails mid-body
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise OSError("object transfer timed out")
+        sock.settimeout(min(remaining, _stall_timeout()))
+        try:
+            n = sock.recv_into(view[got:total])
+        except _socket.timeout as e:
+            raise OSError("object transfer stalled") from e
+        if n == 0:
+            raise EOFError("transfer connection closed mid-body")
+        got += n
+
+
+def _recv_body(conn, total: int, deadline: float, sink) -> None:
+    """Classic sealed-source body: raw bytes straight into the sink's
+    buffer (the kernel's copy-out is the only receive-side copy), with
+    the sink's board advanced per recv so downstream relays chase us."""
+    import socket
 
     s = socket.socket(fileno=os.dup(conn.fileno()))
     try:
-        buf = bytearray(min(_chunk_size(), total) or 1)
-        mv = memoryview(buf)
+        view = sink.view
         got = 0
-        while got < total:
-            if faults.ENABLED:
-                faults.point("object.chunk")  # error -> pull fails mid-body
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                raise OSError("object transfer timed out")
-            s.settimeout(remaining)
-            want = min(len(buf), total - got)
-            try:
-                n = s.recv_into(mv[:want])
-            except socket.timeout as e:
-                raise OSError("object transfer timed out") from e
-            if n == 0:
-                raise EOFError("transfer connection closed mid-body")
-            got += n
-            yield mv[:n]
-    finally:
-        s.close()
+        import time
 
-
-def _recv_body_into(conn, total: int, deadline: float, view) -> None:
-    """Receive the raw transfer body DIRECTLY into `view` (the arena /
-    tmpfs mmap): the kernel's copy-out is the only receive-side copy.
-    At single-core loopback ceilings the staging bounce buffer this
-    replaces was ~40% of broadcast wall time."""
-    import socket
-    import time
-
-    s = socket.socket(fileno=os.dup(conn.fileno()))
-    try:
-        got = 0
         while got < total:
             if faults.ENABLED:
                 faults.point("object.chunk")  # error -> pull fails mid-body
@@ -247,6 +376,35 @@ def _recv_body_into(conn, total: int, deadline: float, view) -> None:
             if n == 0:
                 raise EOFError("transfer connection closed mid-body")
             got += n
+            sink.advance(n)
+    finally:
+        s.close()
+
+
+def _recv_relay_body(conn, total: int, deadline: float, sink) -> None:
+    """Relay-framed body: `u32 len | bytes | u32 crc32` per chunk.  Each
+    chunk lands straight in the sink's buffer, is crc-VERIFIED in place,
+    and only then advances the board — a torn chunk from a dying relay
+    raises here (the caller falls back) and is never re-served."""
+    import socket
+
+    s = socket.socket(fileno=os.dup(conn.fileno()))
+    try:
+        view = sink.view
+        hdr = bytearray(4)
+        got = 0
+        while got < total:
+            _recv_exact(s, memoryview(hdr), deadline)
+            (n,) = struct.unpack("<I", hdr)
+            if n == 0 or got + n > total:
+                raise OSError(f"relay framing error: chunk {n} at {got}/{total}")
+            _recv_exact(s, view[got : got + n], deadline)
+            _recv_exact(s, memoryview(hdr), deadline)
+            (want_crc,) = struct.unpack("<I", hdr)
+            if _chunk_sum(view[got : got + n]) != want_crc:
+                raise OSError(f"relay chunk crc mismatch at {got}/{total}")
+            got += n
+            sink.advance(n)
     finally:
         s.close()
 
@@ -255,22 +413,21 @@ def fetch_object(
     endpoint: Tuple[str, int],
     authkey: bytes,
     oid: str,
-    write_chunks: Optional[Callable[[str, int, Iterable[bytes]], None]] = None,
+    start_pull: Callable[[str, int], object],
     timeout: Optional[float] = None,
-    create_stream: Optional[Callable[[str, int, Callable], None]] = None,
-) -> Optional[int]:
-    """Pull one object from a remote ObjectServer endpoint.
+) -> Optional[Tuple[int, str]]:
+    """Pull one object from a remote endpoint into the local store.
 
-    Preferred sink: create_stream(oid, total, fill) — the store allocates
-    and hands `fill` a writable view that the socket recv_intos directly
-    (ShmStore.create_from_stream / OwnerStore.ingest_stream).  Legacy
-    sink: write_chunks(oid, total, chunk_iter) stages through a bounce
-    buffer (ShmStore.create_from_chunks / OwnerStore.ingest_packed).
-    Returns the transferred size, or None when the endpoint lacks a copy.
-    Raises OSError/EOFError on transport failure or deadline overrun —
-    caller tries the next endpoint.  Every blocking step is bounded by
-    `timeout` (default: object_transfer_timeout_s), so a wedged server can
-    never hang a get() forever.
+    start_pull(oid, total) -> store.PullSink | None (None = a sibling pull
+    already sealed it locally).  The sink's buffer is the receive target
+    (zero staging), its board makes this pull relay-servable mid-flight,
+    and commit() seals + publishes.  Returns (size, via) where via is
+    "pull" (sealed source), "relay" (served from an in-flight transfer)
+    or "local" (sealed under us — no bytes moved); None when the endpoint
+    lacks a copy.  Raises OSError/EOFError on transport failure, crc
+    mismatch, or deadline/stall overrun — caller tries the next endpoint.
+    The single fetch-side count_copy site lives here: every landed
+    transfer ticks exactly one `pull` or `relay` copy.
     """
     import time
 
@@ -283,23 +440,35 @@ def fetch_object(
         faults.point("object.fetch", key=oid)
     conn = _connect_with_deadline(endpoint, authkey, timeout)
     try:
-        conn.send(("object_fetch", oid))
+        conn.send(("object_fetch", oid, 1))
         if not conn.poll(max(deadline - time.monotonic(), 0.0)):
             raise OSError("object transfer timed out awaiting header")
         hdr = conn.recv()
-        if hdr[0] != "ok":
+        if hdr[0] == "missing":
+            return None
+        if hdr[0] == "ok":
+            via = "pull"
+        elif hdr[0] == "relay":
+            via = "relay"
+        else:
             return None
         total = int(hdr[1])
-        if create_stream is not None:
-            def fill(view):
-                if view is None:
-                    return  # already sealed locally; abandon the body
-                _recv_body_into(conn, total, deadline, view)
+        sink = start_pull(oid, total)
+        if sink is None:
+            return (total, "local")  # abandon the body; conn closes below
+        try:
+            if via == "relay":
+                _recv_relay_body(conn, total, deadline, sink)
+            else:
+                _recv_body(conn, total, deadline, sink)
+        except BaseException:
+            sink.abort()
+            raise
+        sink.commit()
+        from ray_tpu._private import telemetry as _telemetry
 
-            create_stream(oid, total, fill)
-        else:
-            write_chunks(oid, total, _raw_chunks(conn, total, deadline))
-        return total
+        _telemetry.count_copy(via, total)
+        return (total, via)
     finally:
         try:
             conn.close()
@@ -311,19 +480,18 @@ def pull_from_any(
     endpoints: List[Tuple[str, int]],
     authkey: bytes,
     oid: str,
-    write_chunks: Optional[Callable[[str, int, Iterable[bytes]], None]] = None,
+    start_pull: Callable[[str, int], object],
     timeout: Optional[float] = None,
-    create_stream: Optional[Callable[[str, int, Callable], None]] = None,
-) -> Optional[int]:
-    """Try each endpoint in order until one yields the object."""
+) -> Optional[Tuple[int, str]]:
+    """Try each endpoint of the transfer plan in order until one yields
+    the object: the plan's head is the assigned feed (possibly a relay),
+    the tail the sealed-source fallbacks — a dead relay degrades to a
+    direct source pull here, without a fresh owner round trip."""
     for ep in endpoints:
         try:
-            n = fetch_object(
-                tuple(ep), authkey, oid, write_chunks, timeout=timeout,
-                create_stream=create_stream,
-            )
+            r = fetch_object(tuple(ep), authkey, oid, start_pull, timeout=timeout)
         except (OSError, EOFError):
-            continue  # node died / wedged / conn refused: next copy
-        if n is not None:
-            return n
+            continue  # node died / wedged / torn chunk: next copy
+        if r is not None:
+            return r
     return None

@@ -796,29 +796,379 @@ def shard_sweep(out_path=None, shard_counts=(0, 1, 2, 4), rounds: int = 3):
     return report
 
 
+def host_copy_ceiling() -> Dict:
+    """The host's raw copy envelope, measured: memcpy GB/s, single-stream
+    loopback socket GB/s, and the relay integrity checksum's GB/s.  A
+    broadcast's effective GB/s is bounded by these — on a 1-vCPU box
+    whose memcpy runs ~1 GB/s, no transfer topology can land 300MB in
+    under ~0.2s, and a checksummed relay hop costs about one extra
+    memcpy of the object.  Stamped into BENCH artifacts so a number that
+    looks far from the reference envelope carries its own explanation."""
+    import os as _os
+    import socket
+    import threading
+    import zlib
+
+    mb = 100
+    buf = _os.urandom(mb * 1024 * 1024)
+    dst = bytearray(len(buf))
+    t0 = time.perf_counter()
+    dst[:] = buf
+    memcpy = mb / 1024 / (time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    zlib.adler32(buf)
+    adler = mb / 1024 / (time.perf_counter() - t0)
+    a, b = socket.socketpair()
+    view = memoryview(buf)
+
+    def sender():
+        off = 0
+        while off < len(view):
+            off += a.send(view[off : off + (1 << 20)])
+        a.close()
+
+    th = threading.Thread(target=sender, daemon=True)
+    recv = memoryview(dst)
+    t0 = time.perf_counter()
+    th.start()
+    got = 0
+    while got < len(buf):
+        n = b.recv_into(recv[got:], len(buf) - got)
+        if n == 0:
+            break
+        got += n
+    loopback = mb / 1024 / (time.perf_counter() - t0)
+    b.close()
+    th.join(5)
+    return {
+        "name": "host_copy_ceiling",
+        "memcpy_gb_per_s": round(memcpy, 2),
+        "adler32_gb_per_s": round(adler, 2),
+        "loopback_stream_gb_per_s": round(loopback, 2),
+    }
+
+
+def _cold_broadcast_once(rt, nids, payload, land, expect) -> float:
+    """One COLD broadcast round: fresh put (new object id), land on every
+    target node, free.  Returns the wall seconds of the landing wave."""
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    ref = ray_tpu.put(payload)
+    t0 = time.perf_counter()
+    outs = ray_tpu.get(
+        [
+            land.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(nid)
+            ).remote(ref)
+            for nid in nids
+        ],
+        timeout=300,
+    )
+    dt = time.perf_counter() - t0
+    assert all(o == expect for o in outs)
+    del ref  # free the copies before the next cold round
+    return dt
+
+
+def _set_relay(enabled: bool) -> None:
+    import os as _os
+
+    from ray_tpu._private import config as _config
+
+    _os.environ["RAY_TPU_RELAY_PIPELINE"] = "1" if enabled else "0"
+    _config._reset_for_tests()
+
+
+def broadcast_relay_ab(rt, nids, mb: int = 100, rounds: int = 3) -> Dict:
+    """INTERLEAVED relay on/off A/B of the cold broadcast (same cluster,
+    same payload size, alternating rounds): the acceptance measurement
+    for the pipelined transfer plan.  The OFF side is the classic
+    staggered admission (BENCH_objmem_r1's regime); the ON side hands
+    out chain/tree plans with mid-flight relays.  Counter leg: the ON
+    rounds must land EXACTLY one sealed copy (pull|relay) per receiving
+    node per round — pipelining must not multiply copies or re-read the
+    source."""
+    import numpy as np
+    import statistics
+
+    payload = np.random.default_rng(1).integers(
+        0, 255, size=mb * 1024 * 1024, dtype=np.uint8
+    )
+    expect = int(payload[::1024].sum())
+
+    @ray_tpu.remote
+    def land(x):
+        return int(x[::1024].sum())
+
+    total_gb = mb * len(nids) / 1024
+    times = {"on": [], "off": []}
+    try:
+        _set_relay(True)  # warm both regimes once (worker spawn etc.)
+        _cold_broadcast_once(rt, nids, payload, land, expect)
+        time.sleep(1.0)
+        c0 = _cluster_copy_stats()
+        on_rounds = 0
+        for _ in range(rounds):
+            for side in ("on", "off"):
+                _set_relay(side == "on")
+                times[side].append(
+                    round(_cold_broadcast_once(rt, nids, payload, land, expect), 3)
+                )
+                if side == "on":
+                    on_rounds += 1
+                time.sleep(0.3)  # let frees land before the next cold round
+        _set_relay(True)
+        time.sleep(1.5)  # final worker copy-counter pushes land
+        c1 = _cluster_copy_stats()
+    finally:
+        _set_relay(True)
+    stats = _copy_stats_delta(c0, c1)
+    landed = sum(
+        stats.get(p, {}).get("copies", 0) for p in ("pull", "relay")
+    )
+    on = statistics.median(times["on"])
+    off = statistics.median(times["off"])
+    return {
+        "name": f"broadcast_relay_ab_{mb}mb_to_{len(nids)}_nodes",
+        "note": (
+            "single-host A/B: all 'nodes' share one CPU, so both regimes "
+            "are bound by the host_copy_ceiling (every relay hop adds one "
+            "adler32 pass ~= a memcpy of the object) and the pipeline's "
+            "structural win — replacing log2(N) serial whole-object "
+            "rounds with one concurrent chain — cannot show in wall "
+            "clock; the relay counters + plan shape are the claim this "
+            "artifact proves, the multi-host wall-clock claim needs "
+            "multi-host hardware (same residual class as BENCH_shard_r2)"
+        ),
+        "rounds": rounds,
+        "relay_on_s": times["on"],
+        "relay_off_s": times["off"],
+        "on_median_s": on,
+        "off_median_s": off,
+        "on_gb_per_s": round(total_gb / on, 2),
+        "off_gb_per_s": round(total_gb / off, 2),
+        "speedup": round(off / on, 2),
+        # one sealed copy per receiving node per timed round (warm round
+        # + A/B off-rounds included in the window: every cold round of
+        # EITHER regime lands exactly n_nodes copies)
+        "copies_per_round": round(landed / max(2 * rounds + 1, 1), 2),
+        "nodes": len(nids),
+        "copy_stats": stats,
+    }
+
+
+def broadcast_sweep(rt, sizes_mb=(8, 100), fanouts=(2, 4),
+                    chunks_mb=(1, 8), rounds: int = 3) -> Dict:
+    """Cold-broadcast grid: object size x fan-out (receiving nodes) x
+    transfer chunk size, median-of-N cold rounds per cell, relay plans
+    on.  The effective GB/s figure is (size * fanout) / wall — bytes
+    landed per second of broadcast wall clock.  Daemons resolve the
+    chunk knob at spawn, so each chunk size gets a FRESH node set (env
+    inherited at daemon launch)."""
+    import os as _os
+    import statistics
+
+    import numpy as np
+
+    from ray_tpu._private import config as _config
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    @ray_tpu.remote
+    def land(x):
+        return int(x[::1024].sum())
+
+    @ray_tpu.remote
+    def warm():
+        return 1
+
+    cells = []
+    saved = _os.environ.get("RAY_TPU_OBJECT_TRANSFER_CHUNK_BYTES")
+    try:
+        for chunk_mb in chunks_mb:
+            _os.environ["RAY_TPU_OBJECT_TRANSFER_CHUNK_BYTES"] = str(
+                chunk_mb * 1024 * 1024
+            )
+            _config._reset_for_tests()
+            nids = [rt.add_daemon_node(num_cpus=1) for _ in range(max(fanouts))]
+            ray_tpu.get(
+                [
+                    warm.options(
+                        scheduling_strategy=NodeAffinitySchedulingStrategy(nid)
+                    ).remote()
+                    for nid in nids
+                ],
+                timeout=120,
+            )
+            for mb in sizes_mb:
+                payload = np.random.default_rng(mb).integers(
+                    0, 255, size=mb * 1024 * 1024, dtype=np.uint8
+                )
+                expect = int(payload[::1024].sum())
+                for fanout in fanouts:
+                    runs = [
+                        round(
+                            _cold_broadcast_once(
+                                rt, nids[:fanout], payload, land, expect
+                            ),
+                            3,
+                        )
+                        for _ in range(rounds)
+                    ]
+                    med = statistics.median(runs)
+                    cells.append(
+                        {
+                            "mb": mb,
+                            "fanout": fanout,
+                            "chunk_mb": chunk_mb,
+                            "cold_s": runs,
+                            "median_s": med,
+                            "gb_per_s": round(mb * fanout / 1024 / med, 2),
+                        }
+                    )
+                    time.sleep(0.3)
+            for nid in nids:
+                rt.remove_node(nid)
+    finally:
+        if saved is None:
+            _os.environ.pop("RAY_TPU_OBJECT_TRANSFER_CHUNK_BYTES", None)
+        else:
+            _os.environ["RAY_TPU_OBJECT_TRANSFER_CHUNK_BYTES"] = saved
+        _config._reset_for_tests()
+    return {
+        "name": "broadcast_sweep",
+        "note": "relay plans ON; gb_per_s = size*fanout/wall (median-of-%d); "
+        "fresh daemons per chunk size (the knob binds at spawn)" % rounds,
+        "cells": cells,
+    }
+
+
+def arena_put_get_ab(rounds: int = 3, chunk_mb: int = 100, n_chunks: int = 5) -> Dict:
+    """Arena vs file-per-object A/B for the hot put/get path: fresh
+    cluster per side per round (the store backend is fixed at init),
+    interleaved.  Counter leg: BOTH backends must show exactly one
+    sealed copy per put (create->seal is one copy); the arena side must
+    additionally show one zero-byte arena_map per get — reads MAP the
+    sealed buffer, they don't copy it out of the store."""
+    import os as _os
+    import statistics
+
+    import numpy as np
+
+    from ray_tpu._private import config as _config
+    from ray_tpu._private import telemetry as _telemetry
+
+    chunk = np.zeros(chunk_mb * 1024 * 1024, dtype=np.uint8)
+    gb = chunk_mb * n_chunks / 1024
+    out = {"arena": {"runs": []}, "file": {"runs": []}}
+    saved = _os.environ.get("RAY_TPU_NATIVE_STORE")
+    try:
+        for _ in range(rounds):
+            for side in ("arena", "file"):
+                _os.environ["RAY_TPU_NATIVE_STORE"] = (
+                    "1" if side == "arena" else "0"
+                )
+                _config._reset_for_tests()
+                ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+                try:
+                    refs = [ray_tpu.put(chunk) for _ in range(1)]  # warm
+                    ray_tpu.get(refs)
+                    del refs
+                    c0 = _telemetry.copy_counter_snapshot()
+                    t0 = time.perf_counter()
+                    refs = [ray_tpu.put(chunk) for _ in range(n_chunks)]
+                    for r in refs:
+                        v = ray_tpu.get(r, timeout=120)
+                        assert v.nbytes == chunk.nbytes
+                    dt = time.perf_counter() - t0
+                    del refs, v
+                    stats = _copy_stats_delta(
+                        c0, _telemetry.copy_counter_snapshot()
+                    )
+                    out[side]["runs"].append(round(gb / dt, 2))
+                    out[side]["copy_stats"] = stats
+                finally:
+                    ray_tpu.shutdown()
+    finally:
+        if saved is None:
+            _os.environ.pop("RAY_TPU_NATIVE_STORE", None)
+        else:
+            _os.environ["RAY_TPU_NATIVE_STORE"] = saved
+        _config._reset_for_tests()
+    for side in ("arena", "file"):
+        out[side]["gb_per_s"] = statistics.median(out[side]["runs"])
+    return {
+        "name": "arena_put_get_ab",
+        "note": (
+            "interleaved fresh-cluster A/B; gb_per_s is put+get of "
+            f"{gb:.2f}GB counted once, median-of-{rounds}.  copy_stats "
+            "(last round) prove 1 put-copy per put on both sides and "
+            "zero-byte arena_map reads on the arena side"
+        ),
+        **out,
+        "arena_over_file": round(
+            out["arena"]["gb_per_s"] / max(out["file"]["gb_per_s"], 1e-9), 3
+        ),
+    }
+
+
 def object_plane_bench(out_path=None):
-    """The measurement leg of the broadcast/arena roadmap item: put and
-    broadcast shapes with bytes-per-copy counters (median-of-3 timings,
-    counter deltas per path).
+    """The object-plane fast-path benchmark (ISSUE 12): put throughput,
+    the arena put/get A/B, the relay on/off broadcast A/B (acceptance:
+    cold 100MB x 3-node >= 3x the staggered baseline), and the broadcast
+    sweep (size x fan-out x chunk), all with bytes-per-copy counter
+    deltas.
 
         python -m ray_tpu._private.ray_perf --object-plane \
-            [--json BENCH_objmem_r1.json]
+            [--json BENCH_objmem_r2.json]
     """
     import os as _os
 
+    results = [{"name": "host_note", **host_shape()}, host_copy_ceiling()]
+    print(json.dumps(results[-1]), flush=True)
+    # Arena A/B boots its own clusters: run it FIRST (clean slate).
+    r = arena_put_get_ab()
+    results.append(r)
+    print(json.dumps(r), flush=True)
     ray_tpu.init(num_cpus=max(_os.cpu_count() or 1, 8), ignore_reinit_error=True)
-    results = [bench_put_gigabytes(), bench_broadcast_cross_node()]
-    for r in results:
-        print(json.dumps(r), flush=True)
+    from ray_tpu._private.runtime import get_runtime
+
+    rt = get_runtime()
+    results.append(bench_put_gigabytes())
+    print(json.dumps(results[-1]), flush=True)
+    nids = [rt.add_daemon_node(num_cpus=1) for _ in range(4)]
+
+    @ray_tpu.remote
+    def warm():
+        return 1
+
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    ray_tpu.get(
+        [
+            warm.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(nid)
+            ).remote()
+            for nid in nids
+        ],
+        timeout=120,
+    )
+    r = broadcast_relay_ab(rt, nids[:3])
+    results.append(r)
+    print(json.dumps(r), flush=True)
+    for nid in nids:
+        rt.remove_node(nid)
+    r = broadcast_sweep(rt)
+    results.append(r)
+    print(json.dumps(r), flush=True)
     ray_tpu.shutdown()
     report = {
-        "name": "object_plane_bytes_per_copy",
+        "name": "object_plane_fastpath",
         "note": (
-            "timings are median-of-3 (put) / cold+warm rounds "
-            "(broadcast); copy_stats are object_copies/object_copy_bytes "
-            "counter deltas — put counts this process's sealed copies, "
-            "broadcast counts the cluster-wide pushed aggregate (each "
-            "receiving node's pull)"
+            "relay A/B is interleaved on/off on one cluster (off = the "
+            "classic staggered rounds, BENCH_objmem_r1's regime); "
+            "broadcast gb_per_s = size*fanout/wall; copy_stats are "
+            "object_copies/object_copy_bytes counter deltas (cluster "
+            "aggregate for broadcasts, this process for puts)"
         ),
         "benches": results,
     }

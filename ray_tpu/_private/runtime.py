@@ -718,8 +718,18 @@ class Runtime:
         }
         # Staggered broadcast admission (see _admit_pull): oid -> grant
         # timestamps of in-flight pulls; round-robin rotation counter.
+        # (legacy mode, relay_pipeline=0)
         self._pull_grants: Dict[str, list] = {}
         self._pull_rr = 0
+        # Pipelined-broadcast transfer plans (relay_pipeline=1): oid ->
+        # {"feeds": {endpoint: {load, sealed, node}}, "pulling":
+        # {node_id: (endpoint, granted_at)}}.  Feeds are sealed sources
+        # AND in-flight pullers (their boards re-serve mid-transfer);
+        # each feed carries at most relay_fanout downstreams, so
+        # admission capacity grows with the tree, not with completed
+        # rounds.  Loads are soft bounds: releases ride object_copied /
+        # re-asks / timestamp decay, never block correctness.
+        self._xfer_plans: Dict[str, dict] = {}
         # Per-op counts of synchronous worker requests — the direct
         # transport's "zero head hops on the hot path" claim is asserted
         # against these (tests/test_direct_transport.py).
@@ -1481,6 +1491,7 @@ class Runtime:
                 self._inline_lineage.discard(oid)
                 self.object_sizes.pop(oid, None)
                 self.object_meta.pop(oid, None)
+                self._xfer_plans.pop(oid, None)  # freed mid-broadcast
                 # Remote copies die with the ownership release (ray: the
                 # owner's directory drives eviction on every holder node).
                 locs = self.object_locations.pop(oid, None)
@@ -1878,6 +1889,17 @@ class Runtime:
             locs.discard(node_id)
             if not locs:
                 del self.object_locations[oid]
+        # Transfer plans: the dead node's in-flight slot frees, and any
+        # relay feed it was serving is withdrawn — downstreams fall back
+        # to the sealed tail of their plan or re-ask (re-plan, not wedge).
+        for oid in list(self._xfer_plans):
+            self._release_pull_slot_locked(oid, node_id)
+            st = self._xfer_plans.get(oid)
+            if st is None:
+                continue
+            for ep, f in list(st["feeds"].items()):
+                if f.get("node") == node_id:
+                    del st["feeds"][ep]
         self.state.remove_node(node_id)
         for wid, h in list(self.workers.items()):
             if h.node_id == node_id and h.state != "dead":
@@ -2615,11 +2637,16 @@ class Runtime:
             # the HEAD store (this listener doubles as the head's object
             # server — no extra port).  Same streaming body as the daemon
             # ObjectServer, same admission bound, served on this
-            # handshake thread.
+            # handshake thread.  Relay-capable peers (3rd field) may be
+            # served out of an in-flight pull's transfer board.
             from ray_tpu._private import object_plane
 
+            relay_ok = len(first) > 2 and bool(first[2])
             with self._transfer_sem:
-                object_plane.stream_object(conn, self.store.get_raw_packed, first[1])
+                object_plane.stream_object(
+                    conn, self.store.get_raw_packed, first[1],
+                    self.store.read_board if relay_ok else None,
+                )
             return
         if first[0] == "driver":
             # Attached driver client (head-split mode): ("driver", did,
@@ -3472,7 +3499,10 @@ class Runtime:
             # A worker pulled a copy into its node's store: record it so
             # siblings on that node read locally — unless the object was
             # freed while the pull was in flight (then reap the orphan).
+            # The optional 4th field is the transfer path ("pull"/"relay")
+            # the puller used — released slot + ledger label.
             oid, size = msg[1], msg[2]
+            via = msg[3] if len(msg) > 3 else "pull"
             with self.lock:
                 node = self._worker_node(wid)
                 grants = self._pull_grants.get(oid)
@@ -3480,6 +3510,7 @@ class Runtime:
                     grants.pop()  # this puller's grant: capacity freed
                     if not grants:
                         self._pull_grants.pop(oid, None)
+                self._release_pull_slot_locked(oid, node)
                 if wid in self.drivers and node != self.head_node_id:
                     return  # remote driver's private store: nobody else reads it
                 if node == self.head_node_id:
@@ -3496,7 +3527,11 @@ class Runtime:
                 else:
                     self._daemon_send(node, ("delete_object", oid))
                     return
-                self._obj_event(oid, "transfer", size, node)
+                # Ledger/timeline label carries the transfer path: a
+                # "relay" event proves the copy rode an in-flight feed.
+                self._obj_event(
+                    oid, "relay" if via == "relay" else "transfer", size, node
+                )
                 # Unpark staggered pullers: the source set just grew
                 # (deferred callbacks run after the lock drops).
                 deferred = self.pubsub.publish("object_copied", oid, oid)
@@ -4109,36 +4144,113 @@ class Runtime:
                 self._zygote_spawning = False
 
     def _admit_pull(self, wid: str, req_id: int, oid: str, eps: list):
-        """Staggered broadcast admission (ray: push_manager.h:29 bounds
-        in-flight pushes; our pull-based twin bounds concurrent pulls PER
-        SOURCE COPY).  A cold broadcast of one object to N nodes would
-        otherwise open N full-object streams against the single holder —
-        the measured 0.18 GB/s wall at round 4, and the reason the
-        reference's 1 GiB × 50-node row takes 91 s.  Instead: grants are
-        capped at the number of source copies; excess pullers park until a
-        new copy registers (object_copied publishes), then pull from the
-        GROWN source set — each completed transfer doubles capacity, so a
-        broadcast completes in ~log2(N) source-bandwidth rounds.  Replies
-        rotate the endpoint list so concurrent pullers spread across
-        sources."""
+        """Broadcast admission.  Two regimes:
+
+        relay_pipeline=1 (default) — PIPELINED TRANSFER PLAN: the reply's
+        endpoint list is [assigned feed] + sealed-source fallbacks.  A
+        feed is a sealed copy OR a node still pulling (its transfer board
+        re-serves landed chunks mid-flight, object_plane._stream_relay),
+        each carrying at most relay_fanout downstreams; every admitted
+        puller immediately registers as a feed itself, so an N-node cold
+        broadcast forms a chain/tree where all hops stream concurrently.
+        A dead relay costs its downstreams one fallback hop (the sealed
+        tail of their plan) or one re-ask (which re-plans); it never
+        wedges the broadcast.
+
+        relay_pipeline=0 — classic STAGGERED rounds (ray: push_manager.h
+        bounds in-flight pushes; the pull twin bounds concurrent pulls
+        per SOURCE COPY): grants capped at sealed copies, excess pullers
+        park until object_copied grows the source set — ~log2(N)
+        source-bandwidth rounds."""
         from ray_tpu._private import config as _cfg
 
         import time as _t
 
         now = _t.monotonic()
         horizon = now - _cfg.get("object_transfer_timeout_s")
-        with self.lock:
-            grants = [t for t in self._pull_grants.get(oid, ()) if t > horizon]
-            if len(grants) >= max(len(eps), 1):
+        if not _cfg.get("relay_pipeline"):
+            with self.lock:
+                grants = [t for t in self._pull_grants.get(oid, ()) if t > horizon]
+                if len(grants) >= max(len(eps), 1):
+                    self._pull_grants[oid] = grants
+                    self.metrics["pull_parks"] += 1
+                    self._park_pull(wid, req_id, oid)
+                    return _PARKED
+                grants.append(now)
                 self._pull_grants[oid] = grants
+                self._pull_rr += 1
+                k = self._pull_rr % len(eps) if eps else 0
+            return ("pull", eps[k:] + eps[:k])
+        fanout = max(_cfg.get("relay_fanout"), 1)
+        with self.lock:
+            node = self._worker_node(wid)
+            st = self._xfer_plans.setdefault(oid, {"feeds": {}, "pulling": {}})
+            feeds, pulling = st["feeds"], st["pulling"]
+            for ep in eps:  # sealed sources may have grown since last ask
+                f = feeds.setdefault(
+                    tuple(ep), {"load": 0, "sealed": False, "node": None}
+                )
+                f["sealed"] = True
+            for n_, (_ep, ts_) in list(pulling.items()):
+                if ts_ < horizon:  # dead puller that never reported back
+                    self._release_pull_slot_locked(oid, n_)
+            st = self._xfer_plans.setdefault(oid, {"feeds": feeds, "pulling": pulling})
+            if node in pulling:
+                # A re-ask from a node already pulling means its previous
+                # plan failed (or a sibling worker races it): release the
+                # old slot and re-plan fresh.
+                self._release_pull_slot_locked(oid, node)
+                st = self._xfer_plans.setdefault(
+                    oid, {"feeds": feeds, "pulling": pulling}
+                )
+            # SEALED-FIRST: fill the sources' fanout before chaining off
+            # relays — bushier trees mean fewer checksummed relay hops
+            # (each hop costs a verify+re-sum of the whole object, about
+            # a memcpy's worth of CPU) and shorter failure cascades,
+            # while the per-feed fanout bound still caps source egress.
+            cands = [
+                (not f["sealed"], f["load"], ep)
+                for ep, f in feeds.items()
+                if f["load"] < fanout and f.get("node") != node
+            ]
+            if not cands:
                 self.metrics["pull_parks"] += 1
                 self._park_pull(wid, req_id, oid)
                 return _PARKED
-            grants.append(now)
-            self._pull_grants[oid] = grants
-            self._pull_rr += 1
-            k = self._pull_rr % len(eps) if eps else 0
-        return ("pull", eps[k:] + eps[:k])
+            cands.sort(key=lambda c: (c[0], c[1]))
+            _relay, _load, feed_ep = cands[0]
+            feeds[feed_ep]["load"] += 1
+            pulling[node] = (feed_ep, now)
+            rep = self.node_object_endpoints.get(node)
+            if rep is not None and tuple(rep) != feed_ep:
+                # The requester's node serves its own in-flight pull's
+                # board from now on: register it as a relay feed.
+                rf = feeds.setdefault(
+                    tuple(rep), {"load": 0, "sealed": False, "node": node}
+                )
+                rf["node"] = node
+            plan = [list(feed_ep)] + [
+                list(ep) for ep in eps if tuple(ep) != feed_ep
+            ]
+        return ("pull", plan)
+
+    def _release_pull_slot_locked(self, oid: str, node: str) -> None:
+        """Caller holds self.lock.  Free `node`'s slot in oid's transfer
+        plan (its pull finished, failed, or decayed); drop the plan when
+        fully quiesced — sealed feeds rebuild from the directory on the
+        next ask."""
+        st = self._xfer_plans.get(oid)
+        if st is None:
+            return
+        ent = st["pulling"].pop(node, None)
+        if ent is not None:
+            f = st["feeds"].get(ent[0])
+            if f is not None and f["load"] > 0:
+                f["load"] -= 1
+        if not st["pulling"] and not any(
+            f["load"] > 0 for f in st["feeds"].values()
+        ):
+            self._xfer_plans.pop(oid, None)
 
     def _park_pull(self, wid: str, req_id: int, oid: str) -> None:
         """Caller holds self.lock.  Park a staggered puller until a new
@@ -5718,16 +5830,18 @@ class Runtime:
     def _fetch_remote(self, oid: str) -> bool:
         """Pull an object whose bytes live only on other nodes into the
         head store (driver-side consumption of remote results —
-        ray: PullManager on the requesting raylet)."""
+        ray: PullManager on the requesting raylet).  The sink's transfer
+        board makes even this pull relay-servable to other nodes
+        mid-flight (the head's listener serves its boards)."""
         from ray_tpu._private import object_plane
 
         eps = self._pull_endpoints(oid, exclude_head=True)
         if not eps:
             return False
-        n = object_plane.pull_from_any(
-            eps, self._authkey, oid, create_stream=self.store.ingest_stream
+        r = object_plane.pull_from_any(
+            eps, self._authkey, oid, self.store.start_pull
         )
-        return n is not None
+        return r is not None
 
     def wait_refs(self, refs, num_returns=1, timeout=None):
         oids = [r.id for r in refs]

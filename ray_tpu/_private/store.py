@@ -19,10 +19,12 @@ import mmap
 import os
 import pickle
 import shutil
+import struct
 import tempfile
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu._private import faults
 from ray_tpu._private import lock_watchdog
 from ray_tpu._private import serialization as ser
 
@@ -80,6 +82,181 @@ class SealedObject:
         return ser.deserialize(self.payload, self.buffers, ref_factory)
 
 
+# ---------------------------------------------------------------------------
+# transfer boards: shared-memory progress ledger for in-flight pulls
+#
+# A node that is PULLING an object can simultaneously RE-SERVE the chunks it
+# has already landed (pipelined tree/chain broadcast, ray: push_manager.h:29
+# chunked push pipelining).  The puller (a worker process) and the server
+# (the node daemon / the head's handshake thread) are different processes
+# sharing the node store, so progress is published through a tiny mmap'd
+# board file next to the object: backend + total + arena offset + a
+# monotonically advancing watermark of verified bytes.  The data itself is
+# the pull's real receive buffer (the arena pending slot or the .tmp file)
+# — the relay path adds ZERO extra copies.
+
+_BOARD_MAGIC = b"RTPB"
+_BOARD_VER = 1
+_BOARD_FMT = "<4sHHQQQII"  # magic, ver, backend, total, arena_off, wm, state, pid
+_BOARD_SIZE = struct.calcsize(_BOARD_FMT)  # 40
+_BOARD_WM_OFF = 24  # byte offset of the watermark field (8-aligned)
+_BOARD_STATE_OFF = 32
+BOARD_FILE_BACKEND = 0
+BOARD_ARENA_BACKEND = 1
+
+
+class _PullBoard:
+    """Writer side of a transfer board (lives in the pulling process)."""
+
+    __slots__ = ("path", "_mm", "_wm")
+
+    def __init__(self, path: str, backend: int, total: int, arena_off: int):
+        self.path = path
+        with open(path, "wb+") as f:
+            f.write(
+                struct.pack(
+                    _BOARD_FMT, _BOARD_MAGIC, _BOARD_VER, backend, total,
+                    arena_off, 0, 0, os.getpid(),
+                )
+            )
+            f.flush()
+            self._mm = mmap.mmap(f.fileno(), _BOARD_SIZE)
+        self._wm = 0
+
+    def advance(self, n: int) -> None:
+        """Publish n more verified bytes.  The data write happens-before
+        this store on the same host (one page-cache), so a reader that
+        observes the new watermark observes the bytes under it."""
+        self._wm += n
+        struct.pack_into("<Q", self._mm, _BOARD_WM_OFF, self._wm)
+
+    def fail(self) -> None:
+        try:
+            struct.pack_into("<I", self._mm, _BOARD_STATE_OFF, 1)
+        except ValueError:
+            pass  # already closed
+
+    def close(self) -> None:
+        try:
+            self._mm.close()
+        except (BufferError, ValueError):
+            pass
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class BoardReader:
+    """Server side of a transfer board: maps the in-flight pull's receive
+    buffer read-only and tracks the writer's watermark.  Constructed by
+    ShmStore.read_board in the SERVING process (daemon / head)."""
+
+    __slots__ = ("path", "total", "_mm", "_data", "_keepalive")
+
+    def __init__(self, path: str, total: int, data: memoryview, mm, keepalive):
+        self.path = path
+        self.total = total
+        self._mm = mm
+        self._data = data
+        self._keepalive = keepalive
+
+    def watermark(self) -> int:
+        try:
+            wm = struct.unpack_from("<Q", self._mm, _BOARD_WM_OFF)[0]
+        except ValueError:
+            return 0
+        return min(wm, self.total)
+
+    def failed(self) -> bool:
+        try:
+            return struct.unpack_from("<I", self._mm, _BOARD_STATE_OFF)[0] != 0
+        except ValueError:
+            return True
+
+    def gone(self) -> bool:
+        """The writer finished (sealed + unlinked the board) or died and
+        was cleaned up.  The reader's own mappings stay valid (the inode
+        lives while mapped), so a board at watermark==total can still be
+        drained after it is gone."""
+        return not os.path.exists(self.path)
+
+    def data(self, off: int, n: int) -> memoryview:
+        return self._data[off : off + n]
+
+    def close(self) -> None:
+        self._data = memoryview(b"")
+        try:
+            self._mm.close()
+        except (BufferError, ValueError):
+            pass
+
+
+class PullSink:
+    """One in-flight pull's receive state: the writable buffer (arena
+    pending slot or .tmp mmap), the optional transfer board, and the
+    commit/abort lifecycle.  Produced by ShmStore.start_pull; driven by
+    object_plane.fetch_object."""
+
+    __slots__ = ("store", "oid", "view", "total", "_board", "_backend",
+                 "_tmp_path", "_done", "on_commit")
+
+    def __init__(self, store, oid, view, total, board, backend, tmp_path):
+        self.store = store
+        self.oid = oid
+        self.view = view
+        self.total = total
+        self._board = board
+        self._backend = backend
+        self._tmp_path = tmp_path
+        self._done = False
+        self.on_commit = None  # OwnerStore accounting hook
+
+    def advance(self, n: int) -> None:
+        if self._board is not None:
+            self._board.advance(n)
+
+    def commit(self) -> None:
+        """Seal the landed bytes.  After commit the sink's buffer is gone:
+        writes through the sink raise (sealed-buffer immutability)."""
+        if self._done:
+            return
+        self._done = True
+        self.view = None  # release the writable buffer before sealing
+        if self._backend == BOARD_ARENA_BACKEND:
+            self.store.arena.seal(self.oid)
+        else:
+            os.rename(self._tmp_path, self.store._path(self.oid))
+        # Seal-then-unlink: a relay reader that loses the board re-checks
+        # the sealed copy and finds it (never a window with neither).
+        if self._board is not None:
+            self._board.close()
+        if self.on_commit is not None:
+            self.on_commit()
+
+    def abort(self) -> None:
+        """Reclaim the pending allocation; downstream relay readers see
+        the failed state (or the missing board) and fall back."""
+        if self._done:
+            return
+        self._done = True
+        self.view = None
+        if self._board is not None:
+            self._board.fail()
+        if self._backend == BOARD_ARENA_BACKEND:
+            try:
+                self.store.arena.delete(self.oid)
+            except Exception:
+                pass
+        else:
+            try:
+                os.unlink(self._tmp_path)
+            except OSError:
+                pass
+        if self._board is not None:
+            self._board.close()
+
+
 class ShmStore:
     """Host-shared object segments, mmap'ed zero-copy on read.
 
@@ -125,8 +302,27 @@ class ShmStore:
                 if capacity is not None:
                     # ~5.3MB of table metadata + the data heap
                     self.arena = Arena(arena_path, capacity=capacity + 8 * 1024 * 1024)
-                elif os.path.exists(arena_path):
-                    self.arena = Arena(arena_path)
+                else:
+                    # Joining processes prefer the fd their node daemon
+                    # passed over the AF_UNIX spawn channels (SCM_RIGHTS,
+                    # netutil.send_fd): the map works even when the store
+                    # path is not resolvable from this process's view.
+                    # Any failure here falls back to the classic path
+                    # open, and failing THAT leaves arena=None — the
+                    # file-per-object copy path.
+                    fd_env = os.environ.get("RAY_TPU_ARENA_FD")
+                    arena = None
+                    if fd_env and os.environ.get("RAY_TPU_STORE_DIR") == self.dir:
+                        try:
+                            if faults.ENABLED:
+                                # error -> fd map fails -> path fallback
+                                faults.point("arena.map", key=self.dir)
+                            arena = Arena(arena_path, fd=int(fd_env))
+                        except Exception:
+                            arena = None
+                    if arena is None and os.path.exists(arena_path):
+                        arena = Arena(arena_path)
+                    self.arena = arena
             except Exception:
                 self.arena = None  # toolchain/platform unavailable: files
 
@@ -181,6 +377,12 @@ class ShmStore:
             if pinned is not None:
                 # The PinnedView pins the arena bytes for the SealedObject's
                 # lifetime: delete/spill under live readers defers the free.
+                # path=arena_map with ZERO bytes: the read maps the sealed
+                # buffer in place — the counter records the event so the
+                # zero-copy claim is counted, not asserted.
+                from ray_tpu._private import telemetry as _telemetry
+
+                _telemetry.count_copy("arena_map", 0)
                 payload, buffers = ser.unpack(pinned.view)
                 return SealedObject(payload, buffers, keepalive=pinned)
         path = self._path(object_id)
@@ -197,32 +399,33 @@ class ShmStore:
         return SealedObject(payload, buffers, keepalive=m)
 
     def _allocate_for_pull(self, object_id: str, total: int):
-        """Arena slot for an incoming pull, or None when the object is (or
-        becomes) sealed.  A PENDING slot usually means ANOTHER LIVE PULLER
-        (workers of one node can race on the same arg ref — each process
-        only serializes its own pulls): deleting it would yank memory out
-        from under its writer, so wait for its seal and only reclaim a slot
-        that stays pending past the transfer deadline (dead puller)."""
+        """(view, offset) of an arena slot for an incoming pull, or
+        (None, 0) when the object is (or becomes) sealed.  A PENDING slot
+        usually means ANOTHER LIVE PULLER (workers of one node can race on
+        the same arg ref — each process only serializes its own pulls):
+        deleting it would yank memory out from under its writer, so wait
+        for its seal and only reclaim a slot that stays pending past the
+        transfer deadline (dead puller)."""
         import time
 
         try:
-            return self.arena.allocate(object_id, total)
+            return self.arena.allocate_at(object_id, total)
         except FileExistsError:
             pass
         deadline = time.monotonic() + _config.get("object_transfer_timeout_s")
         while time.monotonic() < deadline:
             if self.arena.contains(object_id):
-                return None  # concurrent puller sealed it
+                return None, 0  # concurrent puller sealed it
             if not self.arena.is_pending(object_id):
                 # slot vanished (freed): take it
                 try:
-                    return self.arena.allocate(object_id, total)
+                    return self.arena.allocate_at(object_id, total)
                 except FileExistsError:
                     continue
             time.sleep(0.05)
         # stale PENDING past the transfer deadline: the writer is dead
         self.arena.delete(object_id)
-        return self.arena.allocate(object_id, total)
+        return self.arena.allocate_at(object_id, total)
 
     def get_raw(self, object_id: str) -> Optional[Tuple[Any, Any]]:
         """(buffer, keepalive) of the PACKED segment bytes, or None.
@@ -257,7 +460,7 @@ class ShmStore:
         view = None
         if self._use_arena(object_id):
             try:
-                view = self._allocate_for_pull(object_id, total)
+                view, _off = self._allocate_for_pull(object_id, total)
                 if view is None and self.arena.contains(object_id):
                     fill(None)
                     return
@@ -295,7 +498,7 @@ class ShmStore:
         view = None
         if self._use_arena(object_id):
             try:
-                view = self._allocate_for_pull(object_id, total)
+                view, _off = self._allocate_for_pull(object_id, total)
                 if view is None and self.arena.contains(object_id):
                     for _ in chunks:
                         pass  # already sealed locally: drain politely
@@ -322,6 +525,92 @@ class ShmStore:
                     m[off : off + len(b)] = b
                     off += len(b)
         os.rename(tmp, path)
+
+    # -- transfer boards (pipelined relay broadcast) ----------------------
+
+    def _board_path(self, object_id: str) -> str:
+        return self._path(object_id) + ".prog"
+
+    def start_pull(self, object_id: str, total: int, board: bool = True):
+        """Open a PullSink for an incoming transfer: the receive buffer IS
+        the final resting place (arena pending slot or the .tmp file), and
+        the optional transfer board publishes landed-byte progress so this
+        node's server can relay the prefix mid-transfer.  Returns None
+        when the object is already sealed locally (a sibling pull landed
+        it — the caller abandons the body)."""
+        view = None
+        off = 0
+        backend = BOARD_FILE_BACKEND
+        tmp_path = None
+        if self._use_arena(object_id):
+            try:
+                view, off = self._allocate_for_pull(object_id, total)
+                if view is None and self.arena.contains(object_id):
+                    return None
+                backend = BOARD_ARENA_BACKEND
+            except (MemoryError, RuntimeError):
+                view = None  # fragmentation/poison: file fallback
+        if view is None:
+            backend = BOARD_FILE_BACKEND
+            tmp_path = self._path(object_id) + ".tmp"
+            with open(tmp_path, "wb+") as f:
+                f.truncate(total)
+                view = memoryview(mmap.mmap(f.fileno(), total)) if total else memoryview(bytearray())
+        pb = None
+        if board and total:
+            try:
+                pb = _PullBoard(self._board_path(object_id), backend, total, off)
+            except OSError:
+                pb = None  # board is an optimization; the pull proceeds
+        return PullSink(self, object_id, view, total, pb, backend, tmp_path)
+
+    def read_board(self, object_id: str) -> Optional[BoardReader]:
+        """Open the serving side of an in-flight pull's transfer board, or
+        None when no live board exists.  The returned reader maps the
+        pull's receive buffer read-only; its mappings survive the writer's
+        seal/unlink (inodes live while mapped), so a fully-watermarked
+        board drains even after the writer finishes."""
+        path = self._board_path(object_id)
+        try:
+            f = open(path, "rb")
+        except OSError:
+            return None
+        try:
+            hdr = f.read(_BOARD_SIZE)
+            if len(hdr) < _BOARD_SIZE:
+                return None
+            magic, ver, backend, total, arena_off, _wm, state, _pid = struct.unpack(
+                _BOARD_FMT, hdr
+            )
+            if magic != _BOARD_MAGIC or ver != _BOARD_VER or state != 0 or not total:
+                return None
+            mm = mmap.mmap(f.fileno(), _BOARD_SIZE, prot=mmap.PROT_READ)
+        finally:
+            f.close()
+        if backend == BOARD_ARENA_BACKEND:
+            if self.arena is None:
+                mm.close()
+                return None
+            data = self.arena.peek(arena_off, total)
+            keepalive = None
+        else:
+            tmp = self._path(object_id) + ".tmp"
+            try:
+                df = open(tmp, "rb")
+            except OSError:
+                mm.close()
+                return None
+            try:
+                size = os.fstat(df.fileno()).st_size
+                if size < total:
+                    mm.close()
+                    return None
+                dmm = mmap.mmap(df.fileno(), total, prot=mmap.PROT_READ)
+            finally:
+                df.close()
+            data = memoryview(dmm)
+            keepalive = dmm
+        return BoardReader(path, total, data, mm, keepalive)
 
     def delete(self, object_id: str) -> None:
         if self._use_arena(object_id) and self.arena.delete(object_id):
@@ -763,6 +1052,30 @@ class OwnerStore:
 
         _telemetry.count_copy("pull", total)
         self._mark_ready(object_id)
+
+    def start_pull(self, object_id: str, total: int):
+        """OwnerStore twin of ShmStore.start_pull: same sink, plus head
+        capacity admission up front and owner accounting + readiness
+        publication on commit (the copy counter ticks at the single
+        fetch-side site in object_plane).  Non-strict admission: the
+        object exists in the cluster and the driver asked for it."""
+        self._make_room(total, strict=False)
+        sink = self.shm.start_pull(object_id, total)
+        if sink is None:
+            return None
+
+        def _on_commit():
+            with self._lock:
+                self._account_shm(object_id, total)
+                self._touch(object_id)
+            self._mark_ready(object_id)
+
+        sink.on_commit = _on_commit
+        return sink
+
+    def read_board(self, object_id: str):
+        """Serving-side board lookup for the head's object server."""
+        return self.shm.read_board(object_id)
 
     def has_local(self, object_id: str) -> bool:
         """Any byte-bearing copy here (mem / shm / spill)?"""

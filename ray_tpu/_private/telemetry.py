@@ -632,10 +632,16 @@ def _copy_counters():
     """Process-wide bytes-per-copy counters, created lazily (module import
     runs before config/metric setup in some entrypoints).  Every byte-
     moving path of the object plane increments these: put/seal (create a
-    sealed copy), pull (a transfer-plane copy), spill/restore (disk round
-    trips), promote (inline bytes uploaded to the head).  ray_perf's
-    put/broadcast shapes report bytes-per-copy off the deltas; the cluster
-    aggregate sums every process's counts via the metrics push."""
+    sealed copy), pull (a transfer-plane copy from a sealed source),
+    relay (a transfer-plane copy served out of an in-flight pull's board
+    — pipelined broadcast), spill/restore (disk round trips), promote
+    (inline bytes uploaded to the head), arena_map (a same-node zero-copy
+    map of a sealed arena buffer: copies tick, bytes stay ZERO — the
+    counted proof reads don't copy).  The copy-coverage lint pass holds
+    every byte-moving function in store/object_plane/arena to this
+    counter (or a reviewed allowlist entry).  ray_perf's put/broadcast
+    shapes report bytes-per-copy off the deltas; the cluster aggregate
+    sums every process's counts via the metrics push."""
     global _OBJ_COPIES, _OBJ_COPY_BYTES
     if _OBJ_COPIES is None:
         from ray_tpu.util.metrics import Counter
@@ -659,8 +665,8 @@ _OBJ_COPY_BYTES = None
 
 def count_copy(path: str, nbytes: int) -> None:
     """Record one object-plane copy of nbytes via `path` (put/seal/pull/
-    spill/restore/promote).  Never raises — called from store/transfer hot
-    paths, sometimes under their locks."""
+    relay/spill/restore/promote/arena_map).  Never raises — called from
+    store/transfer hot paths, sometimes under their locks."""
     try:
         copies, by = _copy_counters()
         copies.inc(tags={"path": path})

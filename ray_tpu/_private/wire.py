@@ -124,7 +124,11 @@ SCHEMAS: Dict[str, Tuple[int, Optional[int], tuple]] = {
     "done": (3, 4, (str,)),
     "refop": (2, 2, (str, str)),
     "req": (3, 3, (int, str)),
-    "object_copied": (2, 2, (str, int)),
+    # object_copied's optional 3rd extra field is the transfer path the
+    # puller used ("pull" sealed source / "relay" in-flight feed) — the
+    # owner releases the right transfer-plan slot and labels the ledger
+    # event with it.
+    "object_copied": (2, 3, (str, int)),
     "actor_exit": (1, 1, (str,)),
     "fence_ack": (1, 1, (str,)),
     "direct_seal": (3, 3, (str, int)),
@@ -167,7 +171,9 @@ SCHEMAS: Dict[str, Tuple[int, Optional[int], tuple]] = {
     "lease_return": (1, 1, (str,)),
     "sync": (0, 1, ()),
     "kv_fetch": (1, 1, (str,)),
-    "object_fetch": (1, 1, (str,)),
+    # object_fetch's optional 2nd extra field flags a relay-capable
+    # receiver (it understands the crc-framed "relay" body).
+    "object_fetch": (1, 2, (str,)),
     # driver hello's optional 3rd extra = sender clock (same offset
     # estimate the worker ready carries).
     "driver": (2, 3, (str,)),
@@ -183,6 +189,11 @@ SCHEMAS: Dict[str, Tuple[int, Optional[int], tuple]] = {
     "zygote": (1, 1, (int,)),
     "fork": (4, 4, (str, dict, str, str)),
     "forked": (2, 2, (str, int)),
+    # daemon -> zygote: the node arena's open fd follows this frame as an
+    # SCM_RIGHTS ancillary message on the same AF_UNIX pipe (netutil
+    # send_fd/recv_fd); forked workers inherit the descriptor and map the
+    # store without touching the path.
+    "arena_fd": (1, 1, (str,)),
     # daemon <-> head
     "daemon": (3, 3, (str,)),
     "heartbeat": (0, 1, ()),
@@ -198,6 +209,9 @@ SCHEMAS: Dict[str, Tuple[int, Optional[int], tuple]] = {
     "pdone": (3, 3, (str,)),
     # transfer plane / handshake replies
     "ok": (1, 1, (int,)),
+    # relay reply header: (total_bytes, chunk_bytes) — body is crc-framed
+    # chunks streamed as the serving board's watermark advances.
+    "relay": (2, 2, (int, int)),
     "missing": (0, 0, ()),
     "driver_ack": (1, 1, (dict,)),
     "protocol_error": (1, 2, ()),

@@ -65,7 +65,10 @@ class WorkerRuntime:
             dir_path=store_dir or os.environ.get("RAY_TPU_STORE_DIR"),
         )
         self.session_name = session_name
+        # Guards _pulls_inflight only (held for dict ops, never across
+        # the wire): oid -> Event of the in-flight leader pull.
         self._pull_lock = lock_watchdog.make_lock("WorkerRuntime._pull_lock")
+        self._pulls_inflight: Dict[str, Any] = {}
         # Remote (non-co-located) drivers cannot seal into any node store
         # the cluster can read: their puts always ride the control conn.
         self.force_inline_puts = False
@@ -509,33 +512,59 @@ class WorkerRuntime:
         """Fetch a remote copy into this node's store via the transfer
         plane; one pull at a time per worker (pull-manager-style admission
         — concurrent arg resolutions of the same object would race the
-        allocate anyway).  `timeout` carries the caller's remaining get()
-        budget so a user timeout is honored over the transfer default."""
+        allocate anyway).  The endpoint list is the owner's TRANSFER PLAN:
+        assigned feed first (possibly a mid-flight relay), sealed sources
+        as fallback.  This pull's own board makes the node a relay feed
+        the moment bytes start landing.  `timeout` carries the caller's
+        remaining get() budget so a user timeout is honored over the
+        transfer default."""
         from ray_tpu._private import config as _cfg
         from ray_tpu._private.object_plane import pull_from_any
 
+        import threading as _threading
+
         cap = _cfg.get("object_transfer_timeout_s")
         timeout = cap if timeout is None else min(timeout, cap)
+        # Per-OBJECT dedup instead of one worker-wide pull lock: pulls of
+        # DIFFERENT objects run concurrently (multi-arg resolution
+        # overlaps its transfers), while a second thread wanting the SAME
+        # object parks on the leader's event — and no lock is ever held
+        # across the wire (the old whole-pull lock showed up as multi-
+        # second watchdog holds once relays made long transfers common).
         with self._pull_lock:
+            evt = self._pulls_inflight.get(object_id)
+            leader = evt is None
+            if leader:
+                evt = _threading.Event()
+                self._pulls_inflight[object_id] = evt
+        if not leader:
+            evt.wait(timeout)
+            return self.shm.get(object_id)
+        try:
             obj = self.shm.get(object_id)  # a sibling pull may have landed it
             if obj is not None:
                 return obj
-            n = pull_from_any(
+            r = pull_from_any(
                 endpoints, self.authkey, object_id,
-                create_stream=self.shm.create_from_stream,
+                self.shm.start_pull,
                 timeout=timeout,
             )
-            if n is None:
+            if r is None:
                 return None
-            from ray_tpu._private import telemetry as _telemetry
-
-            _telemetry.count_copy("pull", n)
-            # Report the new copy (with its packed size) so the directory
-            # serves this node locally from now on, deletes the copy when
-            # the object is freed, and — for head-node workers — enters it
-            # in the owner store's capacity accounting.
-            self.oneway(("object_copied", object_id, n))
+            n, via = r
+            # Report the new copy (with its packed size + transfer path)
+            # so the directory serves this node locally from now on,
+            # releases the plan slot, deletes the copy when the object is
+            # freed, and — for head-node workers — enters it in the owner
+            # store's capacity accounting.  A "local" landing (sibling
+            # sealed it under us) moved no bytes and reports nothing.
+            if via != "local":
+                self.oneway(("object_copied", object_id, n, via))
             return self.shm.get(object_id)
+        finally:
+            with self._pull_lock:
+                self._pulls_inflight.pop(object_id, None)
+            evt.set()
 
     def put_value(self, value: Any) -> str:
         """Store a value under a locally-minted id with fire-and-forget

@@ -161,6 +161,19 @@ def main() -> None:
             msg = conn.recv()
         except (EOFError, OSError):
             os._exit(0)  # owner gone; children follow via their own pdeathsig
+        if isinstance(msg, tuple) and msg and msg[0] == "arena_fd":
+            # The daemon's node-arena fd follows as an SCM_RIGHTS
+            # ancillary message on this AF_UNIX pipe: hold it open so
+            # every forked worker inherits it and maps the store without
+            # resolving the path (store.py prefers RAY_TPU_ARENA_FD).
+            from ray_tpu._private import netutil
+
+            try:
+                afd = netutil.recv_fd(conn)
+                os.environ["RAY_TPU_ARENA_FD"] = str(afd)
+            except (OSError, EOFError, ValueError):
+                pass  # workers fall back to opening the arena by path
+            continue
         if not (isinstance(msg, tuple) and msg and msg[0] == "fork"):
             continue
         _, wid, overrides, out_path, err_path = msg

@@ -8,7 +8,8 @@ and every delay comes from a named, seeded RAY_TPU_FAULT_SPEC clause, so a
 failing run prints its seed and the exact spec to rerun.
 
 The soak boots a SPLIT cluster (standalone head subprocess + one external
-node daemon) and keeps four workloads running while the spec fires:
+node daemon + one RELAY node under a scoped spec) and keeps five
+workloads running while the spec fires:
 
   * task chains (produce -> fold, lineage + retries) — every round's
     results must be exactly right;
@@ -20,7 +21,11 @@ node daemon) and keeps four workloads running while the spec fires:
     and the ledger proves a restart happened;
   * serve HTTP traffic against a 2-replica deployment (replicas are
     killed in the head-kill window too) — every logical request must
-    eventually succeed.
+    eventually succeed;
+  * pipelined BROADCASTS (ISSUE 12): fresh multi-chunk objects land on
+    several nodes per round through relay transfer plans while the
+    relay node's daemon is crash-killed MID-RELAY — every sum must stay
+    exact and nothing may leak.
 
 The default schedule (seeded, per-process deterministic):
   * workers crash at their result-send hazard (wire.send of done/pdone
@@ -91,6 +96,13 @@ import ray_tpu  # noqa: E402
 #     soak exercises BOTH fabric hazards: conns failing over to the
 #     surviving shard and the head's shard respawn path, all while the
 #     head itself bounces.  Zero lost results still required.
+#   * ISSUE 12 (RELAY_SPEC below, scoped to the relay node):
+#     transfer.chunk_relay crash-kills the relay daemon MID-RELAY of a
+#     live broadcast (serving chunks of a pull still in flight on its
+#     node) after its 8th relayed chunk — the downstream puller must fall
+#     back to a sealed source (or re-plan via the owner) and the
+#     broadcast workload must lose nothing; the 256KB soak chunk size
+#     keeps every broadcast multi-chunk so the hazard window stays wide.
 DEFAULT_SPEC = (
     "wire.send:crash@proc=worker,match=^done,after=40,every=53,times=2;"
     "wire.send:delay=0.002@prob=0.02;"
@@ -102,6 +114,19 @@ DEFAULT_SPEC = (
     "shard.forward:crash@proc=io_shard:1,at=12,times=1;"
     "gcs.journal_append:crash@proc=head,at=24,times=1;"
     "gcs.save:crash@proc=head,at=30,times=1"
+)
+
+# The RELAY node runs a SCOPED spec: just the mid-relay daemon kill (+ the
+# ambient wire delay).  Its workers inherit this spec too — deliberately
+# WITHOUT the worker/actor kill clauses: a relay node carrying the full
+# schedule re-arms the per-process actor kills on every respawned worker
+# it hosts, which turns post-storm placement onto that node into an
+# infinite kill loop (observed: replicas/actors re-killed every ~30s
+# through the whole drain).  The relay hazard this node exists for lives
+# in the DAEMON process, so that is what the clause targets.
+RELAY_SPEC = (
+    "transfer.chunk_relay:crash@proc=daemon,after=8,times=1;"
+    "wire.send:delay=0.002@prob=0.02"
 )
 
 TASK_RETRIES = 25
@@ -140,6 +165,17 @@ def fold(a, j, r, log_path):
     return np.full((ARR,), int(a.sum()) + j, dtype=np.int64)
 
 
+# Broadcast payload: ~4MB of int64 => 16 relay chunks at the soak's 256KB
+# transfer chunk size, so a mid-relay kill has a wide window to land in.
+BCAST_N = (4 << 20) // 8
+
+
+@ray_tpu.remote(max_retries=TASK_RETRIES, scheduling_strategy="SPREAD")
+def bcast_land(x, r, i, log_path):
+    _append(log_path, f"bcast:{r}:{i}")
+    return int(x.sum())
+
+
 @ray_tpu.remote(max_restarts=100, max_task_retries=ACTOR_RETRIES)
 class SoakActor:
     def __init__(self, log_path):
@@ -168,10 +204,19 @@ class AnonSoak:
         return i
 
 
-def _launch_daemon(head_json: str, node_id: str, num_cpus: int):
+def _launch_daemon(head_json: str, node_id: str, num_cpus: int,
+                   spec_override: Optional[str] = None):
+    """spec_override scopes the fault plan THIS daemon (and every worker
+    it spawns) runs under; empty string = no faults; None = inherit the
+    ambient os.environ spec (the classic soak daemons)."""
     with open(head_json) as f:
         info = json.load(f)
     env = os.environ.copy()
+    if spec_override is not None:
+        if spec_override:
+            env["RAY_TPU_FAULT_SPEC"] = spec_override
+        else:
+            env.pop("RAY_TPU_FAULT_SPEC", None)
     env.update(
         {
             "RAY_TPU_DRIVER_HOST": info["host"],
@@ -330,6 +375,48 @@ class _AnonLoad(_Workload):
         time.sleep(0.1)  # same shared-box pacing as the named actor load
 
 
+class _BroadcastLoad(_Workload):
+    """ISSUE 12: a live pipelined broadcast under the storm.  Each round
+    puts a FRESH multi-chunk object (head store) and lands it on several
+    nodes at once via SPREAD — the owner hands out relay transfer plans,
+    in-flight pullers re-serve chunks, and the spec's
+    transfer.chunk_relay clause crash-kills a daemon MID-RELAY.  Every
+    round's sums must be exactly right (a torn or short relay would
+    corrupt them), and the re-drive budget covers head/daemon deaths.
+    The put rides inside make_refs so a re-drive after a head bounce
+    re-seals fresh bytes instead of chasing a dead object id."""
+
+    WIDTH = 3  # landing tasks per round (SPREAD across the node set)
+
+    def __init__(self, stop, log_path):
+        super().__init__("soak-bcast", stop)
+        self.log_path = log_path
+
+    def step(self):
+        r = self.iterations
+        fill = r % 251 + 1
+        arr = np.full(BCAST_N, fill, dtype=np.int64)
+        expect = fill * BCAST_N
+
+        def make_refs():
+            ref = ray_tpu.put(arr)
+            return [
+                bcast_land.remote(ref, r, i, self.log_path)
+                for i in range(self.WIDTH)
+            ]
+
+        def check(outs):
+            for i, got in enumerate(outs):
+                if got != expect:
+                    raise AssertionError(
+                        f"broadcast round {r} lane {i}: {got} != {expect} "
+                        "(CORRUPT relay)"
+                    )
+
+        self.eventually(make_refs, check)
+        time.sleep(0.3)  # shared-box pacing; frees land between rounds
+
+
 class _ServeLoad(_Workload):
     """One logical request per step; each retries (with address
     re-discovery — a restarted proxy binds a fresh port) until it succeeds
@@ -444,11 +531,21 @@ def run_soak(
             "RAY_TPU_METRICS_PUSH_MS",
             "RAY_TPU_HEAD_IO_SHARDS",
             "RAY_TPU_PROF_HZ",
+            "RAY_TPU_OBJECT_TRANSFER_CHUNK_BYTES",
+            "RAY_TPU_RELAY_FANOUT",
         )
     }
     os.environ["RAY_TPU_FAULT_SPEC"] = spec
     os.environ["RAY_TPU_FAULT_SEED"] = str(seed)
     os.environ["RAY_TPU_RECONNECT_WINDOW_S"] = "45"
+    # ISSUE 12: small transfer chunks keep every broadcast multi-chunk, so
+    # mid-relay kill windows stay wide and relays genuinely pipeline.
+    os.environ.setdefault("RAY_TPU_OBJECT_TRANSFER_CHUNK_BYTES", "262144")
+    # relay_fanout=1 makes every multi-node pull a CHAIN (the 2nd puller
+    # feeds off the 1st's in-flight board), so relays form with just two
+    # daemon nodes — the shared 1-vCPU box can't afford the node count a
+    # bushier tree would need to exercise the relay path.
+    os.environ.setdefault("RAY_TPU_RELAY_FANOUT", "1")
     # ISSUE 8: the soak runs the SHARDED head fabric — every head
     # incarnation fans its conns across 2 io shards, and the spec kills
     # one shard mid-forward (its conns must fail over with zero lost
@@ -492,6 +589,7 @@ def run_soak(
         "result": "FAIL",
     }
     head = daemon = None
+    relay_daemons: Dict[str, subprocess.Popen] = {}
     serve_mod = None
     stop = threading.Event()
     loads = []
@@ -500,6 +598,35 @@ def run_soak(
             workdir, num_cpus=num_cpus, session=session
         )
         daemon = _launch_daemon(head_json, "soak-d1", num_cpus)
+        # One extra RELAY node (ISSUE 12): the broadcast workload's
+        # SPREAD landings pull one head-store object onto both daemon
+        # nodes at once; with relay_fanout=1 the second puller MUST
+        # chain off the first's in-flight board — and the
+        # transfer.chunk_relay clause kills whichever daemon is serving
+        # a mid-flight relay.
+        relay_daemons.update(
+            {"soak-b1": _launch_daemon(head_json, "soak-b1", 2,
+                                       spec_override=RELAY_SPEC)}
+        )
+        relay_gen = {"soak-b1": 1}
+        report["kills"]["relay_daemon"] = 0
+
+        def check_relay_daemons(draining: bool) -> None:
+            for slot, proc in list(relay_daemons.items()):
+                if proc.poll() is None:
+                    continue
+                report["kills"]["relay_daemon"] += 1
+                relay_gen[slot] += 1
+                nid = f"{slot}g{relay_gen[slot]}"
+                note(
+                    f"relay daemon {slot} died (kill "
+                    f"#{report['kills']['relay_daemon']}); relaunching as {nid}"
+                )
+                relay_daemons[slot] = _launch_daemon(
+                    head_json, nid, 2,
+                    spec_override="" if draining else RELAY_SPEC,
+                )
+
         ray_tpu.init(address=head_json)
 
         if use_serve:
@@ -522,6 +649,7 @@ def run_soak(
             _ChainLoad(stop, log_path),
             _ActorLoad(stop, log_path),
             _AnonLoad(stop, log_path),
+            _BroadcastLoad(stop, log_path),
         ]
         if use_serve:
             loads.append(_ServeLoad(stop, addr, serve_mod.get_http_address))
@@ -559,6 +687,7 @@ def run_soak(
                 note(f"daemon died (kill #{report['kills']['daemon']}); "
                      f"relaunching as soak-d{daemon_n}")
                 daemon = _launch_daemon(head_json, f"soak-d{daemon_n}", num_cpus)
+            check_relay_daemons(draining)
             dead = [w for w in loads if w.failure]
             if dead:
                 note(f"workload failure: {[(w.name, w.failure) for w in dead]}")
@@ -588,6 +717,7 @@ def run_soak(
                 daemon_n += 1
                 note(f"daemon died during drain; relaunching as soak-d{daemon_n}")
                 daemon = _launch_daemon(head_json, f"soak-d{daemon_n}", num_cpus)
+            check_relay_daemons(True)
         for w in loads:
             w.join(timeout=10)
             if w.is_alive():
@@ -702,6 +832,7 @@ def run_soak(
         chains = next(w for w in loads if w.name == "soak-chains")
         actor = next(w for w in loads if w.name == "soak-actor")
         anon = next(w for w in loads if w.name == "soak-anon")
+        bcast = next(w for w in loads if w.name == "soak-bcast")
         anon_inits = counts.get("anoninit:0", 0)
         report.update(
             {
@@ -713,6 +844,10 @@ def run_soak(
                 "anon_actor_calls": anon.iterations,
                 "anon_actor_redrives": anon.redrives,
                 "anon_actor_restarts": max(anon_inits - 1, 0),
+                "broadcast_rounds": bcast.iterations,
+                "broadcast_results_checked": bcast.iterations
+                * _BroadcastLoad.WIDTH,
+                "broadcast_redrives": bcast.redrives,
                 "distinct_executions": len(counts),
                 "duplicate_executions": dup_execs,
                 "execution_budget": budget,
@@ -769,6 +904,25 @@ def run_soak(
             "shard.forward kill clause never fired — no io-shard flight "
             "dump found (is the sharded fabric actually on?)"
         )
+        # ISSUE 12 acceptance: the broadcast workload ran through the
+        # storm with every sum exact, AND the transfer.chunk_relay clause
+        # provably crash-killed a daemon MID-RELAY of a live broadcast
+        # (its flight dump names the point) — the downstream pullers fell
+        # back to sealed sources / re-planned with zero lost results, and
+        # the ledger's leak sweep (asserted above) covered the broadcast
+        # objects too.
+        relay_kill_dumps = [
+            d
+            for d in _telemetry.collect_dumps(flight_dir)
+            if "transfer.chunk_relay" in str(d.get("reason", ""))
+        ]
+        report["relay_kills_mid_broadcast"] = len(relay_kill_dumps)
+        assert bcast.iterations >= 3, "soak too short: <3 broadcast rounds ran"
+        assert relay_kill_dumps, (
+            "transfer.chunk_relay kill clause never fired — no daemon was "
+            "mid-relay during the storm (is the pipelined broadcast "
+            "actually on?)"
+        )
         # ISSUE 10 acceptance: the profiler sampled through the chaos —
         # crash dumps carry collapsed-stack snapshots (prof_stacks > 0 in
         # the dump header), so a killed process records where its time
@@ -815,7 +969,7 @@ def run_soak(
             ray_tpu.shutdown()
         except Exception:
             pass
-        for proc in (daemon, head):
+        for proc in (daemon, head, *relay_daemons.values()):
             if proc is not None and proc.poll() is None:
                 proc.terminate()
                 try:

@@ -123,7 +123,7 @@ def main(argv=None) -> int:
         by_pass.setdefault(v.pass_name, []).append(v)
     for pass_name in ("blocking-under-lock", "lock-order", "fault-registry",
                       "hot-send", "gcs-mutation", "journal-coverage",
-                      "metric-names", "span-names"):
+                      "metric-names", "span-names", "copy-coverage"):
         vs = by_pass.get(pass_name, [])
         new = [v for v in vs if v.key not in result.allowlist]
         print(

@@ -119,6 +119,145 @@ def test_shmstore_uses_arena(tmp_path):
         store.destroy()
 
 
+def test_arena_open_via_fd(tmp_path):
+    """fd-based join (the SCM_RIGHTS handoff path): a process maps the
+    arena from an open descriptor without resolving the path."""
+    path = str(tmp_path / "arena")
+    a = Arena(path, capacity=32 * MB)
+    a.create("fd-obj", b"via-descriptor")
+    fd = os.open(path, os.O_RDWR)
+    try:
+        b = Arena(path, fd=fd)
+        try:
+            assert bytes(b.get("fd-obj")) == b"via-descriptor"
+            b.create("fd-new", b"written-through-fd")
+        finally:
+            b.close()
+        assert bytes(a.get("fd-new")) == b"written-through-fd"
+    finally:
+        os.close(fd)
+        a.destroy()
+
+
+def test_sealed_views_are_readonly(arena):
+    """Sealed-buffer immutability: reader views are read-only — a write
+    through a sealed view raises instead of corrupting every other
+    holder (same contract as the file backend's PROT_READ mmaps)."""
+    arena.create("frozen", b"immutable")
+    pv = arena.get("frozen")
+    assert pv.view.readonly
+    with pytest.raises(TypeError):
+        pv.view[0] = 0
+    # peek (the relay server's raw slice) is read-only too.
+    view, off = arena.allocate_at("staged2", 4)
+    view[:] = b"abcd"
+    del view
+    arena.seal("staged2")
+    raw = arena.peek(off, 4)
+    assert bytes(raw) == b"abcd" and raw.readonly
+    with pytest.raises(TypeError):
+        raw[0] = 0
+
+
+def test_pull_sink_lifecycle_and_immutability(tmp_path):
+    """PullSink create/fill/seal round-trip; writes after commit raise
+    (the buffer is gone); abort reclaims the pending slot."""
+    from ray_tpu._private.store import ShmStore
+
+    store = ShmStore(f"sink-{os.getpid()}", capacity=32 * MB,
+                     dir_path=str(tmp_path / "s"))
+    try:
+        payload = os.urandom(64 * 1024)
+        sink = store.start_pull("o:sink:0", len(payload))
+        assert os.path.exists(store._board_path("o:sink:0"))
+        sink.view[:] = payload
+        sink.advance(len(payload))
+        sink.commit()
+        assert not os.path.exists(store._board_path("o:sink:0"))
+        buf, keep = store.get_raw("o:sink:0")
+        assert bytes(buf) == payload
+        del buf, keep
+        with pytest.raises((TypeError, AttributeError)):
+            sink.view[:4] = b"XXXX"  # sealed: the sink's buffer is gone
+        # Abort path: pending slot reclaimed, id reusable.
+        sink2 = store.start_pull("o:sink:1", 1024)
+        sink2.abort()
+        assert store.get_raw("o:sink:1") is None
+        sink3 = store.start_pull("o:sink:1", 1024)
+        sink3.view[:] = b"y" * 1024
+        sink3.commit()
+        assert bytes(store.get_raw("o:sink:1")[0]) == b"y" * 1024
+    finally:
+        store.destroy()
+
+
+def test_arena_fd_failure_falls_back_to_path(tmp_path, monkeypatch):
+    """A bad handed-off fd (or an injected arena.map fault) must degrade
+    to the classic path-open — never a dead store."""
+    from ray_tpu._private import config as _config
+    from ray_tpu._private.store import ShmStore
+
+    d = tmp_path / "node"
+    d.mkdir()
+    creator = ShmStore(f"fdfall-{os.getpid()}", capacity=32 * MB,
+                       dir_path=str(d))
+    try:
+        creator.create("o:fdfall:0", b"survives-bad-fd", [])
+        monkeypatch.setenv("RAY_TPU_STORE_DIR", str(d))
+        monkeypatch.setenv("RAY_TPU_ARENA_FD", "987654")  # nonsense fd
+        joiner = ShmStore(f"fdfall-{os.getpid()}", dir_path=str(d))
+        assert joiner.arena is not None, "path fallback must engage"
+        assert bytes(joiner.get("o:fdfall:0").payload) == b"survives-bad-fd"
+        # Injected map fault on a VALID fd: same fallback.
+        from ray_tpu._private import faults
+
+        fd = os.open(creator.arena.path, os.O_RDWR)
+        monkeypatch.setenv("RAY_TPU_ARENA_FD", str(fd))
+        faults.configure("arena.map:error", 1)
+        try:
+            joiner2 = ShmStore(f"fdfall-{os.getpid()}", dir_path=str(d))
+            assert joiner2.arena is not None
+            assert bytes(joiner2.get("o:fdfall:0").payload) == b"survives-bad-fd"
+        finally:
+            faults.configure("", 1)
+            os.close(fd)
+    finally:
+        monkeypatch.delenv("RAY_TPU_ARENA_FD", raising=False)
+        monkeypatch.delenv("RAY_TPU_STORE_DIR", raising=False)
+        creator.destroy()
+        _config._reset_for_tests()
+
+
+def test_arena_objects_spill_and_restore(tmp_path):
+    """Arena-backed segments spill to disk under pressure and restore
+    transparently on the next read, value-intact."""
+    import numpy as np
+    import pickle
+
+    from ray_tpu._private import serialization as ser
+    from ray_tpu._private.store import OwnerStore
+
+    store = OwnerStore(
+        f"spill-{os.getpid()}", spill_dir=str(tmp_path / "spill"),
+        capacity_bytes=4 * MB,
+    )
+    try:
+        assert store.shm.arena is not None
+        vals = {}
+        for i in range(4):  # 4 x 1.5MB > 4MB capacity -> LRU spill
+            arr = np.full(1536 * 1024, i, dtype=np.uint8)
+            oid = f"o:spill:{i}"
+            vals[oid] = arr
+            store.put(oid, arr)
+            store.add_ref(oid)
+        assert store._spilled, "capacity pressure must have spilled"
+        for oid, arr in vals.items():  # spilled ones restore on read
+            got = store.get_sealed(oid).deserialize()
+            assert np.array_equal(got, arr)
+    finally:
+        store.destroy()
+
+
 def test_pinned_view_survives_delete_and_reuse(arena):
     """The use-after-free hazard: a live reader's bytes must NOT be
     recycled by delete + new allocations (deferred free via pins)."""
