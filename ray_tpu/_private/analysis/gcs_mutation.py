@@ -47,9 +47,13 @@ PASS = "gcs-mutation"
 # The journaled tables (GlobalState attributes whose mutations must ride
 # the journal).  `functions` joined in the telemetry PR (function exports
 # are journaled so a lineage re-execution within the snapshot tick never
-# hits "unknown function" — the PR-4 residual); kv/placement_groups stay
-# snapshot-only by design (full-table capture every tick).
-_JOURNALED_TABLES = frozenset({"actors", "named_actors", "jobs", "functions"})
+# hits "unknown function" — the PR-4 residual); `placement_groups` joined
+# with elastic re-mesh (a RESHAPING episode must survive a head bounce or
+# the gang wedges forever); kv stays snapshot-only by design (full-table
+# capture every tick).
+_JOURNALED_TABLES = frozenset({
+    "actors", "named_actors", "jobs", "functions", "placement_groups",
+})
 
 # Mutating dict methods; everything else on the table is a read.
 _MUTATING_METHODS = frozenset({"pop", "popitem", "update", "setdefault", "clear"})

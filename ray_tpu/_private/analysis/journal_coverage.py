@@ -40,24 +40,30 @@ from ray_tpu._private.analysis.common import (
 PASS = "journal-coverage"
 
 # Keep in sync with gcs_mutation._JOURNALED_TABLES.
-_JOURNALED_TABLES = frozenset({"actors", "named_actors", "jobs", "functions"})
+_JOURNALED_TABLES = frozenset({
+    "actors", "named_actors", "jobs", "functions", "placement_groups",
+})
 _MUTATING_METHODS = frozenset({"pop", "popitem", "update", "setdefault", "clear"})
 _MUTATOR_MODULE = "ray_tpu/_private/gcs.py"
 
 # Bulk loaders on the RESTORE path: they apply entries that came FROM the
 # journal/snapshot being replayed; journaling them again would double
 # every entry at the next compaction.
-_RESTORE_EXEMPT = frozenset({"import_functions"})
+_RESTORE_EXEMPT = frozenset({"import_functions", "restore_pg"})
 
 # Reviewed journal entry kinds with their restore-time handling:
 #   actor_register / actor_state / job_state / function / lineage —
 #     applied by Runtime._restore_snapshot;
+#   pg_register / pg_state — applied by Runtime._restore_snapshot (PG
+#     record upsert / lifecycle merge); a PG that died mid-RESHAPING
+#     replays as RESHAPING and re-enters the reshape sweep with a fresh
+#     wait deadline (the deadline itself is head-local, never persisted);
 #   lease — diagnostic only: leases are runtime state that cannot outlive
 #     the workers' resource reservations, a restarted head re-grants from
 #     live traffic (restore ignores them by design).
 KNOWN_KINDS = frozenset({
     "actor_register", "actor_state", "job_state", "function", "lineage",
-    "lease",
+    "lease", "pg_register", "pg_state",
 })
 
 

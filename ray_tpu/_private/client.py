@@ -271,6 +271,21 @@ class CoreClient:
         else:
             self._rt().remove_placement_group(pg_id)
 
+    def pg_info(self, pg_id: str) -> Optional[Dict]:
+        """Elastic-gang introspection: state + generation + shrunk size +
+        scale-up cue (see Runtime.pg_info)."""
+        wr = self._wr()
+        if wr is not None:
+            return wr.request("pg_info", pg_id)
+        return self._rt().pg_info(pg_id)
+
+    def pg_reshape(self, pg_id: str) -> bool:
+        """Ask the head to re-mesh a shrunk MESH gang back to full size."""
+        wr = self._wr()
+        if wr is not None:
+            return bool(wr.request("pg_reshape", pg_id))
+        return self._rt().pg_reshape(pg_id)
+
     # -- cluster -------------------------------------------------------------
 
     def cluster_resources(self) -> Dict[str, float]:

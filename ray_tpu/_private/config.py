@@ -436,6 +436,13 @@ _DEFS: Dict[str, tuple] = {
         "everything the rings hold); `ray_tpu timeline --last/--since` "
         "override per call",
     ),
+    "remesh_wait_s": (
+        30.0, float,
+        "elastic MESH gangs: after a member host dies, how long the "
+        "reshape sweep waits for a replacement host before re-planning a "
+        "smaller contiguous box at N-1 (wait-vs-shrink policy; 0 = shrink "
+        "immediately)",
+    ),
 }
 
 # Back-compat env names from before the knob table existed, plus the

@@ -63,7 +63,7 @@ class PlacementGroupInfo:
     pg_id: str
     bundles: List[Dict[str, float]]
     strategy: str
-    state: str = "PENDING"  # PENDING | CREATED | REMOVED
+    state: str = "PENDING"  # PENDING | CREATED | RESHAPING | REMOVED
     # bundle index -> node_id
     bundle_nodes: Dict[int, str] = field(default_factory=dict)
     # bundle index -> remaining capacity inside the reserved bundle
@@ -71,6 +71,44 @@ class PlacementGroupInfo:
     #  ray: src/ray/raylet/placement_group_resource_manager.h)
     bundle_available: Dict[int, Dict[str, float]] = field(default_factory=dict)
     name: Optional[str] = None
+    # Elastic re-mesh (MESH gangs): the full-size bundle list as requested
+    # at creation.  `bundles` shrinks to N-1 when a reshape re-plans a
+    # smaller box; orig_bundles is what scale-up restores.
+    orig_bundles: List[Dict[str, float]] = field(default_factory=list)
+    # Bumped on every successful (re)reservation after a reshape; trainers
+    # watch it to detect that the gang they joined no longer exists.
+    generation: int = 0
+    # Node whose death triggered the current RESHAPING episode.
+    lost_node: Optional[str] = None
+    # Set by the reshape sweep on a shrunk-but-CREATED gang when a full-size
+    # box has become plannable again; the trainer opts in via pg_reshape.
+    scale_up_ready: bool = False
+    # Head-local (NOT persisted): monotonic deadline after which the sweep
+    # stops waiting for a replacement host and shrinks the box.  A head
+    # bounce mid-RESHAPING resets the wait window on restore.
+    reshape_deadline: Optional[float] = None
+    # Head-local (NOT persisted): monotonic stamp of when the current
+    # RESHAPING episode began — trainers read it via pg_info to attribute
+    # the "detect" stage of recovery (monotonic is system-wide on Linux,
+    # so driver-side deltas against it are meaningful).
+    reshaping_since: Optional[float] = None
+
+
+def pg_record(info: "PlacementGroupInfo") -> Dict[str, Any]:
+    """Persistable dict form of one PG-table row (journal entries and the
+    snapshot fold share it, so restore merges them field-for-field).
+    Reservation state (bundle_nodes/bundle_available) is deliberately NOT
+    persisted: a restored head re-reserves against the rebuilt node table."""
+    return {
+        "pg_id": info.pg_id,
+        "bundles": info.bundles,
+        "strategy": info.strategy,
+        "state": info.state,
+        "name": info.name,
+        "orig_bundles": info.orig_bundles,
+        "generation": info.generation,
+        "lost_node": info.lost_node,
+    }
 
 
 def actor_record(info: "ActorInfo") -> Dict[str, Any]:
@@ -225,6 +263,44 @@ class GlobalState:
                  {**kw, "num_restarts": a.num_restarts})
             )
         self.publish("actor_state", actor_id, state)
+
+    # -- placement groups (ray: gcs_placement_group_manager.h) ---------------
+
+    def register_pg(self, info: PlacementGroupInfo) -> None:
+        """Journaled PG registration.  Reservation state stays volatile;
+        the durable record is the spec + lifecycle state (pg_record)."""
+        with self.lock:
+            if not info.orig_bundles:
+                info.orig_bundles = [dict(b) for b in info.bundles]
+            self.placement_groups[info.pg_id] = info
+            self._journal(("pg_register", pg_record(info)))
+
+    def set_pg_state(self, pg_id: str, state: str, **kw) -> None:
+        """Journaled PG lifecycle transition (PENDING|CREATED|RESHAPING|
+        REMOVED) plus any reshape bookkeeping riders (generation,
+        lost_node, bundles after a shrink...).  Mutate+journal only — no
+        publish: callers hold scheduler.lock (order: scheduler.lock ->
+        state.lock) and events go out through the runtime's EventLog."""
+        with self.lock:
+            pg = self.placement_groups.get(pg_id)
+            if not pg:
+                return
+            pg.state = state
+            for k, v in kw.items():
+                setattr(pg, k, v)
+            self._journal(
+                ("pg_state", pg_id, state,
+                 {**{k: v for k, v in kw.items()
+                     if k not in ("reshape_deadline", "reshaping_since")},
+                  "generation": pg.generation})
+            )
+
+    def restore_pg(self, info: PlacementGroupInfo) -> None:
+        """Restore-path upsert (snapshot merge / journal replay) — NOT
+        journaled: the record came from the journal/snapshot being
+        replayed."""
+        with self.lock:
+            self.placement_groups[info.pg_id] = info
 
     # -- jobs (ray: gcs_job_manager) -----------------------------------------
 

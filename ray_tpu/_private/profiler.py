@@ -168,6 +168,14 @@ def running() -> bool:
     return ENABLED and _thread is not None and _thread.is_alive()
 
 
+def status() -> Dict[str, Any]:
+    """Current sampler state.  The head answers this for the
+    late-subscriber sync: a worker spawned AFTER a cluster-wide
+    profile_start never saw the broadcast (pubsub is live-only), so it
+    asks once right after subscribing and catches up."""
+    return {"running": running(), "hz": _hz}
+
+
 def start(hz: Optional[float] = None) -> float:
     """Start (or retune) the sampler in THIS process.  Resets the table —
     a profile run measures from its own start.  Returns the effective

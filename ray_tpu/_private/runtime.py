@@ -43,6 +43,7 @@ from ray_tpu._private.gcs import (
     GlobalState,
     NodeInfo,
     PlacementGroupInfo,
+    pg_record as _pg_record,
 )
 from ray_tpu._private.refs import ObjectRef, set_ref_hooks
 from ray_tpu._private.scheduler import Scheduler
@@ -880,6 +881,12 @@ class Runtime:
         self.events.emit(
             "INFO", "runtime", "session started", session=self.session_name
         )
+        # Planning failures that need operator eyes (inconsistent
+        # mesh_coord labels) surface through the same event log.
+        self.scheduler.events = self.events
+        # RESHAPING pg_ids already announced through the mesh.member_death
+        # fault point (the sweep fires it once per episode, off the lock).
+        self._remesh_announced: "Set[str]" = set()
         self.worker_logs: Dict[str, deque] = {}
         self.log_to_driver = _config.get("log_to_driver") != 0
         from ray_tpu._private.log_monitor import LogMonitor
@@ -1186,7 +1193,7 @@ class Runtime:
                 "functions": dict(self.state.functions),
                 "actors": actors,
                 "placement_groups": {
-                    pid: (pg.bundles, pg.strategy, pg.name, pg.state)
+                    pid: _pg_record(pg)
                     for pid, pg in self.state.placement_groups.items()
                     if pg.state != "REMOVED"
                 },
@@ -1238,14 +1245,18 @@ class Runtime:
             # next to bytes the directory knows about.
             self.store.mark_remote_sealed(oid)
         self.object_sizes.update(snap.get("object_sizes", {}))
-        for pid, (bundles, strategy, name, pstate) in snap.get(
-            "placement_groups", {}
-        ).items():
-            if pid in self.state.placement_groups:
-                continue
-            pg = PlacementGroupInfo(pid, bundles, strategy, name=name)
-            self.state.placement_groups[pid] = pg
-            self.pending_pgs.append(pid)  # re-reserve once nodes register
+        # PG table: snapshot rows merged with journal replay below (the
+        # dict form is pg_record; pre-remesh snapshots held 4-tuples).
+        pgs_by_id: Dict[str, dict] = {}
+        for pid, rec in snap.get("placement_groups", {}).items():
+            if isinstance(rec, dict):
+                pgs_by_id[pid] = dict(rec)
+            else:
+                bundles, strategy, name, pstate = rec
+                pgs_by_id[pid] = {
+                    "pg_id": pid, "bundles": bundles, "strategy": strategy,
+                    "name": name, "state": pstate,
+                }
         # ---- merge the actor/job tables: snapshot + journal replay.  The
         # journal holds every mutation since the snapshot's tick (torn
         # tail already truncated by replay()), so applying the entries in
@@ -1273,6 +1284,15 @@ class Runtime:
                     jobs.setdefault(jid, {"job_id": jid}).update(
                         {"state": jstate, **kw}
                     )
+                elif kind == "pg_register":
+                    rec = dict(entry[1])
+                    pgs_by_id[rec["pg_id"]] = rec
+                elif kind == "pg_state":
+                    _, pid, pstate, kw = entry
+                    rec = pgs_by_id.get(pid)
+                    if rec is not None:
+                        rec["state"] = pstate
+                        rec.update(kw)
                 elif kind == "lineage":
                     restored_lineage.append((entry[1], entry[2]))
                 elif kind == "function":
@@ -1286,6 +1306,40 @@ class Runtime:
         for jid, rec in jobs.items():
             kw = {k: v for k, v in rec.items() if k not in ("job_id", "state")}
             self.state.set_job_state(jid, rec.get("state", "RUNNING"), **kw)
+        for pid, rec in pgs_by_id.items():
+            if pid in self.state.placement_groups:
+                continue
+            try:
+                pg = PlacementGroupInfo(
+                    pid, rec["bundles"], rec["strategy"], name=rec.get("name"),
+                    orig_bundles=[
+                        dict(b)
+                        for b in (rec.get("orig_bundles") or rec["bundles"])
+                    ],
+                    generation=int(rec.get("generation", 0)),
+                    lost_node=rec.get("lost_node"),
+                )
+            except (KeyError, TypeError):
+                continue  # malformed record: skip, don't block boot
+            pstate = rec.get("state", "PENDING")
+            if pstate == "REMOVED":
+                # Kept (not re-queued) so a retried pg_remove/pg_state
+                # across the bounce answers instead of "unknown pg".
+                pg.state = "REMOVED"
+                self.state.restore_pg(pg)
+            elif pstate == "RESHAPING":
+                # Died mid-reshape: resume the episode.  The wait deadline
+                # is head-local and NOT persisted — the sweep re-arms a
+                # fresh remesh_wait_s window on first sight (a bounce
+                # extends the replacement wait; it never skips straight to
+                # shrink on stale wall-clock).
+                pg.state = "RESHAPING"
+                self.state.restore_pg(pg)
+            else:
+                # PENDING and CREATED both re-reserve: bundle reservations
+                # are volatile, the rebuilt node table re-acquires them.
+                self.state.restore_pg(pg)
+                self.pending_pgs.append(pid)
         # Inline-result lineage: the bytes died with the old head, but the
         # producer specs survive — a get() on one of these re-executes from
         # lineage instead of parking forever (ray: task_manager.h:97 +
@@ -1906,6 +1960,9 @@ class Runtime:
                 if isinstance(h.proc, _RemoteProcHandle):
                     h.proc.dead = True
                 self._on_worker_crash(wid)
+        # A MESH gang that lost this host is torn as a whole: withdraw it
+        # and open a RESHAPING episode (the io-loop sweep advances it).
+        self._withdraw_mesh_gangs(node_id)
 
     def _child_env(self, extra: Dict[str, str]) -> Dict[str, str]:
         """Base env for child processes (workers/daemons): driver address +
@@ -3159,6 +3216,10 @@ class Runtime:
                 # lock dance inside; decrefs may fan daemon deletes).
                 if self._dead_refs:
                     self.reclaim_dead_refs()
+                # Elastic MESH gangs: advance RESHAPING episodes.  Off the
+                # runtime lock — the reshape fault points can delay/crash;
+                # each mutation step re-takes the lock and re-checks.
+                self._sweep_reshaping_pgs(now)
             if self._prestart_target > 0 and now - last_topup > 0.05:
                 # Throttled: an every-iteration lock acquire here convoys
                 # with the hot message path during drains.
@@ -3927,6 +3988,10 @@ class Runtime:
         if op == "pg_remove":
             self.remove_placement_group(payload)
             return None
+        if op == "pg_info":
+            return self.pg_info(payload)
+        if op == "pg_reshape":
+            return self.pg_reshape(payload)
         if op == "cluster_resources":
             return self.cluster_resources()
         if op == "available_resources":
@@ -3957,6 +4022,12 @@ class Runtime:
                 return self.profile_start(payload[1] if len(payload) > 1 else None)
             if action == "stop":
                 return self.profile_stop()
+            if action == "status":
+                # Late-subscriber sync: a worker that subscribed after a
+                # cluster-wide start polls this once and catches up.
+                from ray_tpu._private import profiler as _profiler
+
+                return _profiler.status()
             if action == "report":
                 return self.profile_report(
                     **(payload[1] if len(payload) > 1 and payload[1] else {})
@@ -5931,7 +6002,7 @@ class Runtime:
             name=name,
         )
         with self.lock:
-            self.state.placement_groups[pg.pg_id] = pg
+            self.state.register_pg(pg)  # journaled (orig_bundles captured)
             if not self.scheduler.reserve_placement_group(pg):
                 self.pending_pgs.append(pg.pg_id)
         return pg
@@ -5943,6 +6014,205 @@ class Runtime:
                 self.scheduler.remove_placement_group(pg)
                 if pg_id in self.pending_pgs:
                     self.pending_pgs.remove(pg_id)
+
+    # -- elastic re-mesh (MESH gangs; SURVEY.md §7: one host's failure
+    #    tears/reshapes the whole mesh, unlike independent-worker retry) --
+
+    def pg_info(self, pg_id: str) -> Optional[dict]:
+        """Gang introspection for elastic trainers: lifecycle state plus
+        the reshape bookkeeping (generation, shrunk size, scale-up cue)."""
+        with self.lock:
+            pg = self.state.placement_groups.get(pg_id)
+            if pg is None:
+                return None
+            return {
+                "state": pg.state,
+                "generation": pg.generation,
+                "size": len(pg.bundles),
+                "orig_size": len(pg.orig_bundles or pg.bundles),
+                "bundle_nodes": dict(pg.bundle_nodes),
+                "scale_up_ready": pg.scale_up_ready,
+                "lost_node": pg.lost_node,
+                # Monotonic stamp of the last RESHAPING entry (system-wide
+                # clock on Linux): trainers subtract it from their own
+                # monotonic "noticed" time to attribute the detect stage.
+                "reshaping_since": pg.reshaping_since,
+            }
+
+    def _kill_gang_actors(self, pg_id: str) -> int:
+        """Caller holds self.lock.  Kill every live actor scheduled inside
+        the gang: SPMD collectives span all members, so the survivors of a
+        torn mesh are dead weight pinning capacity the re-plan needs —
+        and killing them gives the trainer one clean gang-wide
+        ActorDiedError instead of a half-alive group."""
+        killed = 0
+        for aid, ar in list(self.actors.items()):
+            placement = ar.placement
+            if not placement or placement[0] != "pg" or placement[1] != pg_id:
+                continue
+            info = self.state.get_actor(aid)
+            if info is None or info.state == DEAD:
+                continue
+            killed += 1
+            self.kill_actor(aid, no_restart=True)
+        return killed
+
+    def _withdraw_mesh_gangs(self, node_id: str) -> None:
+        """Caller holds self.lock.  Node loss: every CREATED MESH gang the
+        dead host was a member of is withdrawn as a whole — surviving
+        reservations released, gang actors killed — and enters a journaled
+        RESHAPING episode.  The io-loop sweep then waits for a replacement
+        host up to remesh_wait_s before re-planning a smaller box."""
+        from ray_tpu._private import config as _config
+
+        for pg in list(self.state.placement_groups.values()):
+            if pg.strategy != "MESH" or pg.state != "CREATED":
+                continue
+            if node_id not in pg.bundle_nodes.values():
+                continue
+            if not self.scheduler.withdraw_gang(pg, node_id):
+                continue
+            wait_s = float(_config.get("remesh_wait_s"))
+            self.state.set_pg_state(
+                pg.pg_id, "RESHAPING",
+                lost_node=node_id, scale_up_ready=False,
+                reshape_deadline=time.monotonic() + wait_s,
+                reshaping_since=time.monotonic(),
+            )
+            killed = self._kill_gang_actors(pg.pg_id)
+            self.events.emit(
+                "WARNING", "pg",
+                "MESH gang lost a member host: gang withdrawn, RESHAPING",
+                pg_id=pg.pg_id, lost_node=node_id, size=len(pg.bundles),
+                actors_killed=killed, wait_s=wait_s,
+            )
+
+    def _sweep_reshaping_pgs(self, now: float) -> None:
+        """Advance elastic re-mesh episodes (io-loop 0.5s tick).
+
+        Runs OFF the runtime lock: the mesh.member_death / pg.reshape
+        fault points below are delay/crash-capable, and every mutation
+        step below re-takes the lock and re-checks state first — a racing
+        remove_placement_group wins, the sweep never resurrects it.
+        """
+        from ray_tpu._private import config as _config
+
+        with self.lock:
+            reshaping = [
+                pg for pg in self.state.placement_groups.values()
+                if pg.state == "RESHAPING"
+            ]
+            shrunk = [
+                pg for pg in self.state.placement_groups.values()
+                if (
+                    pg.state == "CREATED"
+                    and pg.strategy == "MESH"
+                    and pg.orig_bundles
+                    and len(pg.bundles) < len(pg.orig_bundles)
+                    and not pg.scale_up_ready
+                )
+            ]
+        for pg in reshaping:
+            if faults.ENABLED:
+                if pg.pg_id not in self._remesh_announced:
+                    self._remesh_announced.add(pg.pg_id)
+                    faults.point("mesh.member_death", key=pg.pg_id)
+                deadline = pg.reshape_deadline
+                faults.point(
+                    "pg.reshape",
+                    key="shrink"
+                    if deadline is not None and now >= deadline
+                    else "wait",
+                )
+            with self.lock:
+                if pg.state != "RESHAPING":
+                    continue
+                if pg.reshape_deadline is None:
+                    # Restored mid-episode after a head bounce: the wait
+                    # deadline is head-local, re-arm a fresh window.
+                    pg.reshape_deadline = now + float(
+                        _config.get("remesh_wait_s")
+                    )
+                # Full size first — a replacement host may have joined.
+                ok = self.scheduler.reserve_placement_group(pg)
+                did_shrink = False
+                if not ok and now >= pg.reshape_deadline and len(pg.bundles) > 1:
+                    # Wait window expired: shrink the box by one host
+                    # (journaled) and re-plan, demand-revoking idle leases
+                    # when fragmentation blocks the smaller box.  Another
+                    # window must elapse before shrinking further.
+                    self.state.set_pg_state(
+                        pg.pg_id, "RESHAPING",
+                        bundles=[dict(b) for b in pg.bundles[:-1]],
+                        reshape_deadline=now
+                        + float(_config.get("remesh_wait_s")),
+                    )
+                    did_shrink = True
+                    ok = self.scheduler.reserve_placement_group(pg)
+                    while not ok and self._revoke_one_idle_lease():
+                        ok = self.scheduler.reserve_placement_group(pg)
+                if ok:
+                    self._remesh_announced.discard(pg.pg_id)
+                    self.events.emit(
+                        "INFO", "pg",
+                        "MESH gang re-meshed"
+                        + (" at reduced size" if did_shrink else ""),
+                        pg_id=pg.pg_id, size=len(pg.bundles),
+                        orig_size=len(pg.orig_bundles or pg.bundles),
+                        generation=pg.generation,
+                    )
+                    self._dispatch()
+        for pg in shrunk:
+            if self.scheduler.can_plan_full(pg):
+                with self.lock:
+                    if pg.state == "CREATED" and not pg.scale_up_ready:
+                        self.state.set_pg_state(
+                            pg.pg_id, "CREATED", scale_up_ready=True
+                        )
+                        self.events.emit(
+                            "INFO", "pg",
+                            "MESH gang can scale back to full size",
+                            pg_id=pg.pg_id, size=len(pg.bundles),
+                            orig_size=len(pg.orig_bundles),
+                        )
+
+    def pg_reshape(self, pg_id: str) -> bool:
+        """Trainer-initiated scale-up of a shrunk MESH gang back to its
+        original size: kill the gang, withdraw its reservations, and
+        re-enter RESHAPING at full size.  The reservation is attempted
+        inline (and by every sweep tick after); the caller polls pg_info
+        until generation advances."""
+        if faults.ENABLED:
+            faults.point("pg.reshape", key="expand")
+        from ray_tpu._private import config as _config
+
+        with self.lock:
+            pg = self.state.placement_groups.get(pg_id)
+            if (
+                pg is None
+                or pg.state != "CREATED"
+                or not pg.orig_bundles
+                or len(pg.bundles) >= len(pg.orig_bundles)
+            ):
+                return False
+            self._kill_gang_actors(pg_id)
+            self.scheduler.withdraw_gang(pg, dead_node="")
+            self.state.set_pg_state(
+                pg_id, "RESHAPING",
+                bundles=[dict(b) for b in pg.orig_bundles],
+                lost_node=None, scale_up_ready=False,
+                reshape_deadline=time.monotonic()
+                + float(_config.get("remesh_wait_s")),
+                reshaping_since=time.monotonic(),
+            )
+            self.events.emit(
+                "INFO", "pg", "MESH gang scale-up: RESHAPING to full size",
+                pg_id=pg_id, size=len(pg.bundles),
+            )
+            if self.scheduler.reserve_placement_group(pg):
+                self._remesh_announced.discard(pg_id)
+                self._dispatch()
+        return True
 
     # -- cluster info --------------------------------------------------------
 
@@ -5993,6 +6263,8 @@ class Runtime:
                 self.events.emit("INFO", "node", "node removed", node_id=node_id)
             self._daemon_send(node_id, ("shutdown",))
             self.node_daemons.pop(node_id, None)
+            # Planned or not, a MESH gang member leaving tears the gang.
+            self._withdraw_mesh_gangs(node_id)
         for h in victims:
             try:
                 h.proc.terminate()

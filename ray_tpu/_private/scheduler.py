@@ -58,6 +58,13 @@ class Scheduler:
         # lease policy prefers the node already holding the task's
         # arguments so big deps don't cross the wire).
         self.locality_fn = None
+        # Set by the Runtime: its EventLog, so planning failures that need
+        # operator attention (inconsistent mesh_coord labels) surface as
+        # cluster events instead of a silent None.
+        self.events = None
+        # Label-inconsistency warnings are per offending node-set, not per
+        # planning attempt: the pending-PG loop replans every tick.
+        self._warned_dim_sets: set = set()
 
     # -- resource accounting -------------------------------------------------
 
@@ -227,6 +234,10 @@ class Scheduler:
         """2-phase-commit-lite bundle reservation
         (ray: gcs_placement_group_scheduler.cc): all-or-nothing acquire."""
         with self.lock:
+            if pg.state == "REMOVED":
+                # Reshape sweep racing remove_placement_group: the removal
+                # wins, the sweep must not resurrect the gang.
+                return False
             assignment = self._plan_bundles(pg)
             if assignment is None:
                 return False
@@ -242,7 +253,15 @@ class Scheduler:
             pg.bundle_available = {
                 i: dict(pg.bundles[i]) for i in range(len(pg.bundles))
             }
-            pg.state = "CREATED"
+            # Journaled flip (PENDING|RESHAPING -> CREATED).  generation
+            # bumps on EVERY successful reservation: a trainer that joined
+            # generation g detects any subsequent re-reservation — the gang
+            # it bootstrapped no longer exists even if the size matches.
+            self.state.set_pg_state(
+                pg.pg_id, "CREATED",
+                generation=pg.generation + 1,
+                lost_node=None, scale_up_ready=False, reshape_deadline=None,
+            )
             return True
 
     def _plan_bundles(self, pg: PlacementGroupInfo) -> Optional[Dict[int, str]]:
@@ -333,8 +352,33 @@ class Scheduler:
             return None
         dims = {len(c) for c in by_coord}
         if len(dims) != 1:
-            return None  # inconsistent labels
+            # Inconsistent label dimensionality ("0,1" next to "3") makes
+            # every multi-host MESH gang unplaceable.  That is an operator
+            # mistake, not a capacity shortfall — name the minority nodes
+            # in a cluster event instead of failing silently forever.
+            majority = max(
+                dims, key=lambda k: sum(1 for c in by_coord if len(c) == k)
+            )
+            bad = sorted(
+                by_coord[c].node_id for c in by_coord if len(c) != majority
+            )
+            if self.events is not None and frozenset(bad) not in self._warned_dim_sets:
+                self._warned_dim_sets.add(frozenset(bad))
+                self.events.emit(
+                    "WARNING", "scheduler",
+                    "MESH placement failing: inconsistent mesh_coord label "
+                    "dimensionality across nodes",
+                    nodes=bad, dims=sorted(dims),
+                )
+            return None
         d = dims.pop()
+        # Torus extent per dim, inferred from the labeled population: hosts
+        # at opposite label edges are ICI-adjacent through the wraparound
+        # link, so a box may wrap (coords mod extent) — a gang can survive
+        # losing a middle host by re-planning around it.
+        extent = tuple(
+            max(c[i] for c in by_coord) + 1 for i in range(d)
+        )
 
         def factorizations(m: int, k: int):
             if k == 1:
@@ -345,11 +389,32 @@ class Scheduler:
                     for rest in factorizations(m // f, k - 1):
                         yield (f,) + rest
 
-        for shape in factorizations(n, d):
-            for anchor in by_coord:
+        def frag_score(box: set) -> int:
+            """Free labeled hosts torus-adjacent to the box: lower keeps
+            the free region contiguous (a mid-mesh box fragments it)."""
+            neighbors = set()
+            for coord in box:
+                for i in range(d):
+                    for step in (-1, 1):
+                        nb = list(coord)
+                        nb[i] = (nb[i] + step) % extent[i]
+                        nb = tuple(nb)
+                        if nb in by_coord and nb not in box:
+                            neighbors.add(nb)
+            return len(neighbors)
+
+        best: Optional[Dict[int, str]] = None
+        best_score: Optional[int] = None
+        for shape in sorted(factorizations(n, d)):
+            if any(s > e for s, e in zip(shape, extent)):
+                continue
+            for anchor in sorted(by_coord):
                 box = list(
                     itertools.product(
-                        *[range(a, a + s) for a, s in zip(anchor, shape)]
+                        *[
+                            [(a + i) % e for i in range(s)]
+                            for a, s, e in zip(anchor, shape, extent)
+                        ]
                     )
                 )
                 if any(c not in by_coord for c in box):
@@ -362,13 +427,56 @@ class Scheduler:
                         ok = False
                         break
                     assignment[i] = node.node_id
-                if ok:
-                    return assignment
-        return None
+                if not ok:
+                    continue
+                score = frag_score(set(box))
+                if best_score is None or score < best_score:
+                    best, best_score = assignment, score
+        return best
+
+    def withdraw_gang(self, pg: PlacementGroupInfo, dead_node: str) -> bool:
+        """Release a CREATED gang's reservations after a member host died
+        (the dead host's share left with the node), leaving the PG ready
+        to re-plan.  The caller flips state to RESHAPING (journaled)."""
+        with self.lock:
+            if pg.state != "CREATED":
+                return False
+            for idx, node_id in pg.bundle_nodes.items():
+                if node_id != dead_node:
+                    self.release(node_id, pg.bundles[idx])
+            pg.bundle_nodes = {}
+            pg.bundle_available = {}
+            return True
+
+    def can_plan_full(self, pg: PlacementGroupInfo) -> bool:
+        """Would a full-size (orig_bundles) box be plannable right now,
+        counting this gang's own reservations as free?  Read-only probe:
+        reservations are returned to the pool, the plan is attempted, and
+        the reservations re-acquired — all under the scheduler lock, so
+        nothing can race into the temporarily-freed capacity."""
+        with self.lock:
+            if pg.state != "CREATED" or len(pg.bundles) >= len(pg.orig_bundles):
+                return False
+            held = [
+                (node_id, pg.bundles[idx])
+                for idx, node_id in pg.bundle_nodes.items()
+            ]
+            for node_id, res in held:
+                self.release(node_id, res)
+            try:
+                probe = PlacementGroupInfo(
+                    pg_id=pg.pg_id,
+                    bundles=[dict(b) for b in pg.orig_bundles],
+                    strategy=pg.strategy,
+                )
+                return self._plan_bundles(probe) is not None
+            finally:
+                for node_id, res in held:
+                    self.acquire(node_id, res)
 
     def remove_placement_group(self, pg: PlacementGroupInfo) -> None:
         with self.lock:
             if pg.state == "CREATED":
                 for idx, node_id in pg.bundle_nodes.items():
                     self.release(node_id, pg.bundles[idx])
-            pg.state = "REMOVED"
+            self.state.set_pg_state(pg.pg_id, "REMOVED", reshape_deadline=None)

@@ -555,6 +555,31 @@ def task_stage_histogram():
     return _STAGE_HIST
 
 
+_REMESH_HIST = None
+
+
+def remesh_histogram():
+    """`remesh_seconds{stage=...}` — elastic-SPMD recovery wall clock
+    attributed per stage (detect → teardown → replan → respawn → resume,
+    plus total).  The trainer driver observes one sample per stage per
+    re-mesh episode; the chaos soak asserts the breakdown lands.  Lazy,
+    like task_stage_histogram: only a process that actually re-meshes
+    registers it.  Boundaries are seconds-scale: recovery is dominated by
+    the replacement-wait policy and worker respawn, not micro latencies."""
+    global _REMESH_HIST
+    if _REMESH_HIST is None:
+        from ray_tpu.util.metrics import Histogram
+
+        _REMESH_HIST = Histogram(
+            "remesh_seconds",
+            "elastic MESH gang recovery time per stage "
+            "(detect/teardown/replan/respawn/resume/total)",
+            boundaries=[0.05, 0.2, 0.5, 1.0, 2.0, 5.0, 15.0, 60.0, 300.0],
+            tag_keys=("stage",),
+        )
+    return _REMESH_HIST
+
+
 def summarize_task_events(
     events: List[Dict[str, Any]],
     live: Optional[List[Dict[str, Any]]] = None,

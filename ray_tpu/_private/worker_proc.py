@@ -1036,6 +1036,16 @@ def worker_main(address, authkey: bytes, worker_id: str, session_name: str, env_
                     rt.subscribe("profiler", "ctl", _on_prof_ctl)
                 except OSError:
                     prof_subscribed = False  # head away: retry next beat
+                else:
+                    try:
+                        # Catch up: a cluster-wide profile started before
+                        # this worker existed never reached it (pubsub is
+                        # live-only) — poll the head's sampler state once.
+                        st = rt.request("profile", ("status",), timeout=5)
+                        if st and st.get("running"):
+                            _on_prof_ctl(None, "start", st.get("hz"))
+                    except Exception:
+                        pass  # the next start broadcast still reaches us
             flush_task_events()
             if report_wire:
                 rt.oneway(("wire_stats", wire.stats()), droppable=True)

@@ -116,9 +116,7 @@ def ring_attention_sharded(
     shape-fitted — a dim that doesn't divide runs replicated, which is
     correct, just unsharded.
     """
-    from jax import shard_map
-
-    from ray_tpu.parallel.sharding import _fit_spec
+    from ray_tpu.parallel.sharding import _fit_spec, shard_map
 
     def fit(x):
         spec = P(batch_axes, seq_axis, head_axis, None)

@@ -26,6 +26,7 @@ from ray_tpu.parallel.mesh import (
     MeshSpec,
     build_mesh,
     mesh_axis_sizes,
+    remesh_spec,
     single_device_mesh,
 )
 from ray_tpu.parallel.sharding import (
@@ -60,6 +61,7 @@ __all__ = [
     "pick_coordinator_address",
     "recv",
     "reducescatter",
+    "remesh_spec",
     "resolve_rules",
     "send",
     "setup_mesh",

@@ -44,6 +44,20 @@ class MeshBootstrap:
             local_device_ids=self.local_device_ids,
         )
 
+    def shutdown(self):
+        """Leave the multi-host XLA runtime so this process can rejoin a
+        re-meshed gang (elastic SPMD: the coordinator and world size change
+        when the group reforms at N-1 or scales back up).  Safe to call
+        when initialize() never ran or the runtime is already down."""
+        if self.num_processes <= 1:
+            return
+        import jax
+
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            pass  # never initialized / coordinator already gone
+
 
 def pick_coordinator_address(port: int = 0) -> str:
     """Choose a reachable coordinator address on this host (rank-0 side)."""

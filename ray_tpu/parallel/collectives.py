@@ -306,8 +306,9 @@ def device_allreduce(x, mesh, axis: str = "data", op: str = "sum"):
     programs are cached per (mesh, axis, op) so repeated calls don't retrace.
     """
     import jax
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.parallel.sharding import shard_map
 
     key = (mesh, axis, op)
     run = _device_allreduce_cache.get(key)

@@ -71,6 +71,30 @@ class MeshSpec:
         return math.prod(s for s in self.shape() if s > 0)
 
 
+def remesh_spec(spec: MeshSpec, n_devices: int) -> MeshSpec:
+    """Re-resolve a mesh spec after an elastic re-mesh changed the device
+    count (host lost → shrink, replacement host returned → grow).
+
+    A spec with a -1 wildcard re-absorbs the new count directly.  A fully
+    fixed spec re-shapes along its "data" axis (the DCN-spanning axis —
+    replicas are what a host-count change adds or removes; ICI-bound axes
+    like tensor/fsdp would change the compiled program's communication
+    pattern) and fails with an actionable error when that isn't possible.
+    """
+    sizes = {a: getattr(spec, a) for a in AXIS_ORDER}
+    if any(s == -1 for s in sizes.values()):
+        return spec.resolve(n_devices)
+    other = math.prod(s for a, s in sizes.items() if a != "data")
+    if other <= 0 or n_devices % other != 0:
+        raise ValueError(
+            f"cannot re-mesh {sizes} onto {n_devices} devices: the non-data "
+            f"axes need a multiple of {other}; use data=-1 for elastic "
+            "training or resize the gang to a compatible host count"
+        )
+    sizes["data"] = n_devices // other
+    return MeshSpec(**sizes)
+
+
 def build_mesh(
     spec: Optional[MeshSpec] = None,
     *,

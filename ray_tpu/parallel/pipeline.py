@@ -167,7 +167,7 @@ def pipeline_apply(
     per stage per step, and their grads reduce-scatter back (ZeRO-style).
     Leaves with None (or dims that don't divide) stay replicated.
     """
-    from jax import shard_map
+    from ray_tpu.parallel.sharding import shard_map
 
     n_stages = mesh.shape[axis]
     batch_axes = tuple(a for a in batch_axes if a in mesh.axis_names and mesh.shape[a] > 1)
@@ -356,7 +356,7 @@ def pipeline_train_step_1f1b(
     last stage; gradients come back with the leading stage dim, mean-
     normalized over microbatches, and psum'd over the batch axes (data-
     parallel reduction included, like any SPMD train step)."""
-    from jax import shard_map
+    from ray_tpu.parallel.sharding import shard_map
 
     n_stages = mesh.shape[axis]
     batch_axes = tuple(

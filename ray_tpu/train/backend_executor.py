@@ -25,6 +25,13 @@ class TrainingFailedError(RuntimeError):
     pass
 
 
+class RemeshScaleUp(Exception):
+    """Internal control flow, not a failure: the head signalled that a
+    shrunk MESH gang can scale back to full size (pg_info scale_up_ready).
+    run_training raises it so the trainer can tear down, pg_reshape, and
+    restart at the original world size from the latest checkpoint."""
+
+
 class BackendExecutor:
     def __init__(
         self,
@@ -36,31 +43,85 @@ class BackendExecutor:
         self.scaling = scaling_config or ScalingConfig()
         self.worker_group: Optional[WorkerGroup] = None
         self._pg = None
+        # Elastic MESH gangs: generation of the reservation the current
+        # worker group was spawned into; the head bumps it on every re-mesh.
+        self._elastic = False
+        self._generation = 0
+        self.num_started_workers = 0
+        # How long start() waits for the gang reservation before failing
+        # with the PG state + unplaceable bundles (tests shrink this).
+        self.pg_wait_timeout_s = 60.0
 
     # -- lifecycle --------------------------------------------------------
-    def start(self):
+    def start(self, num_workers: Optional[int] = None):
+        """Spawn the worker group (no-op if already started).
+
+        The placement group is created ONCE and survives stop_workers():
+        elastic restarts re-spawn workers into the re-meshed gang.
+        num_workers overrides the scaling config's count (elastic MESH
+        gangs restart at the gang's current — possibly shrunk — size)."""
+        if self.worker_group is not None:
+            return
         sc = self.scaling
+        n = sc.num_workers if num_workers is None else num_workers
         if sc.num_workers > 1:
             # Gang-reserve the workers' resources (ray: Train reserves a PG
             # per trial via Tune — base_trainer.py:52 path).
-            from ray_tpu.util.placement_group import placement_group
+            if self._pg is None:
+                from ray_tpu.util.placement_group import placement_group
 
-            bundles = [sc.worker_resources() for _ in range(sc.num_workers)]
-            self._pg = placement_group(bundles, strategy=sc.placement_strategy)
-            self._pg.wait(timeout_seconds=60)
+                bundles = [sc.worker_resources() for _ in range(sc.num_workers)]
+                self._pg = placement_group(
+                    bundles, strategy=sc.placement_strategy
+                )
+            if not self._pg.wait(timeout_seconds=self.pg_wait_timeout_s):
+                info = self.pg_info() or {}
+                placed = set(info.get("bundle_nodes") or {})
+                unplaced = [
+                    i
+                    for i in range(len(self._pg.bundle_specs))
+                    if i not in placed
+                ]
+                raise TrainingFailedError(
+                    f"placement group {self._pg.id} not ready after "
+                    f"{self.pg_wait_timeout_s:.0f}s: "
+                    f"state={info.get('state') or 'UNKNOWN'}, unplaceable "
+                    f"bundles {unplaced} of {self._pg.bundle_specs}; the "
+                    "cluster cannot satisfy the reservation — check node "
+                    "resources"
+                    + (
+                        " and mesh_coord labels"
+                        if sc.placement_strategy == "MESH"
+                        else ""
+                    )
+                )
+            self._elastic = sc.placement_strategy == "MESH"
+            info = self.pg_info() or {}
+            self._generation = info.get("generation", 0)
+            if self._elastic:
+                n = min(n, info.get("size", n))
+        self.num_started_workers = n
         self.worker_group = WorkerGroup(
-            sc.num_workers, sc.worker_resources(), placement_group=self._pg
+            n, sc.worker_resources(), placement_group=self._pg
         )
         self.backend.on_start(self.worker_group, self.backend_config)
 
-    def shutdown(self):
+    def stop_workers(self):
+        """Tear down the worker group KEEPING the placement group — the
+        elastic-restart path re-spawns workers into the re-meshed gang."""
         if self.worker_group is not None:
             try:
                 self.backend.on_shutdown(self.worker_group, self.backend_config)
             except Exception:
                 pass
-            self.worker_group.shutdown()
+            try:
+                self.worker_group.shutdown()
+            except Exception:
+                pass  # gang actors may already be dead (head killed them)
             self.worker_group = None
+
+    def shutdown(self):
+        self.stop_workers()
         if self._pg is not None:
             from ray_tpu.util.placement_group import remove_placement_group
 
@@ -69,6 +130,61 @@ class BackendExecutor:
             except Exception:
                 pass
             self._pg = None
+
+    # -- elastic re-mesh ---------------------------------------------------
+    def pg_info(self) -> Optional[Dict[str, Any]]:
+        if self._pg is None:
+            return None
+        from ray_tpu._private.client import client
+
+        return client.pg_info(self._pg.id)
+
+    def remesh_in_progress(self) -> bool:
+        """True when the gang the current workers were spawned into no
+        longer exists: mid-RESHAPING, or already re-formed at a new
+        generation."""
+        if not self._elastic:
+            return False
+        info = self.pg_info()
+        return bool(info) and (
+            info["state"] == "RESHAPING"
+            or info["generation"] != self._generation
+        )
+
+    def wait_remesh(self, timeout_seconds: Optional[float] = None) -> Dict:
+        """Block until the gang re-forms (CREATED at a new generation);
+        returns the final pg_info.  Default timeout covers two head-side
+        wait-then-shrink windows plus placement slack."""
+        if timeout_seconds is None:
+            from ray_tpu._private import config as _config
+
+            timeout_seconds = 2.0 * float(_config.get("remesh_wait_s")) + 60.0
+        deadline = time.monotonic() + timeout_seconds
+        delay = 0.01
+        while True:
+            info = self.pg_info()
+            if info is None or info["state"] == "REMOVED":
+                raise TrainingFailedError(
+                    "placement group removed while waiting for re-mesh"
+                )
+            if info["state"] == "CREATED" and info["generation"] != self._generation:
+                self._generation = info["generation"]
+                return info
+            if time.monotonic() >= deadline:
+                raise TrainingFailedError(
+                    f"gang did not re-mesh within {timeout_seconds:.0f}s "
+                    f"(state={info['state']}, size={info['size']})"
+                )
+            time.sleep(delay)
+            delay = min(delay * 2, 0.25)
+
+    def request_scale_up(self) -> bool:
+        """Ask the head to re-mesh a shrunk gang back to full size."""
+        if self._pg is None:
+            return False
+        from ray_tpu._private.client import client
+
+        return bool(client.pg_reshape(self._pg.id))
 
     # -- training ---------------------------------------------------------
     def run_training(
@@ -99,8 +215,23 @@ class BackendExecutor:
         all_reports: List[List[Dict]] = [[] for _ in wg.workers]
         finished = [False] * len(wg.workers)
         error: Optional[BaseException] = None
+        last_pg_check = time.monotonic()
         while not all(finished) and error is None:
             time.sleep(poll_interval)
+            if self._elastic and time.monotonic() - last_pg_check >= 1.0:
+                # Shrunk gang: surface the head's scale-up cue so the
+                # trainer can reshape back to full size between steps.
+                last_pg_check = time.monotonic()
+                info = self.pg_info()
+                if (
+                    info is not None
+                    and info["state"] == "CREATED"
+                    and info["scale_up_ready"]
+                    and self.num_started_workers < info["orig_size"]
+                ):
+                    raise RemeshScaleUp(
+                        f"gang can scale {info['size']} -> {info['orig_size']}"
+                    )
             try:
                 polls = ray_tpu.get(
                     [w.poll.remote() for w in wg.workers], timeout=60

@@ -131,6 +131,45 @@ def span(name: str, parent: Optional[Dict[str, str]] = None,
             pass
 
 
+def record_span(
+    name: str,
+    start: float,
+    end: float,
+    parent: Optional[Dict[str, str]] = None,
+    attrs: Optional[Dict[str, Any]] = None,
+    ctx: Optional[Dict[str, str]] = None,
+) -> Optional[Dict[str, str]]:
+    """Record an ALREADY-FINISHED span with explicit epoch timestamps.
+
+    For intervals whose boundaries are only known after the fact — e.g.
+    the "detect" stage of an elastic re-mesh starts on the head before the
+    driver notices, and "resume" ends inside a report callback.  `ctx`
+    pins the span's own ids so sibling spans recorded earlier can already
+    have parented to it; returns the span's context for further chaining.
+    """
+    if not _enabled:
+        return None
+    c = {
+        "trace_id": (ctx or parent or {}).get("trace_id") or _new_id(16),
+        "span_id": (ctx or {}).get("span_id") or _new_id(8),
+    }
+    rec = {
+        "name": name,
+        "trace_id": c["trace_id"],
+        "span_id": c["span_id"],
+        "parent_span_id": (parent or {}).get("span_id"),
+        "start": start,
+        "end": end,
+        "attrs": dict(attrs or {}),
+        "pid": os.getpid(),
+    }
+    with _buffer_lock:
+        _buffer.append(rec)
+        while len(_buffer) > _MAX_BUFFER:
+            _buffer.pop(0)
+    return c
+
+
 def drain_spans() -> List[Dict[str, Any]]:
     """Take the buffered spans (worker flush loops ship them to the head)."""
     with _buffer_lock:

@@ -44,9 +44,20 @@ no lost results, no reply mismatches, per-task execution counts within
 retry budgets, zero lost serve requests.  The report lands in
 CHAOS_r01.json (or --out).
 
+A SECOND scenario (--trainer, ISSUE 16) proves elastic SPMD end to end:
+a MESH-gang DataParallelTrainer runs checkpointed steps across two
+mesh_coord-labeled gang hosts while the harness SIGKILLs one gang daemon
+mid-step.  The gang must re-mesh at N-1 within the RAY_TPU_REMESH_WAIT_S
+window, resume from the latest checkpoint with bounded lost steps, scale
+back to N when a replacement host (same coordinate) joins, and finish
+with every step reported exactly once — with the per-stage recovery
+breakdown (detect/teardown/replan/respawn/resume) in the remesh_seconds
+histogram.  Report lands in CHAOS_r11.json.
+
 Usage:
     python scripts/chaos_soak.py --duration 75 --seed 7 \
         [--spec '<fault spec>'] [--out CHAOS_r01.json] [--no-serve]
+    python scripts/chaos_soak.py --trainer [--out CHAOS_r11.json]
 """
 
 from __future__ import annotations
@@ -205,10 +216,14 @@ class AnonSoak:
 
 
 def _launch_daemon(head_json: str, node_id: str, num_cpus: int,
-                   spec_override: Optional[str] = None):
+                   spec_override: Optional[str] = None,
+                   resources: Optional[Dict[str, float]] = None,
+                   labels: Optional[Dict[str, str]] = None):
     """spec_override scopes the fault plan THIS daemon (and every worker
     it spawns) runs under; empty string = no faults; None = inherit the
-    ambient os.environ spec (the classic soak daemons)."""
+    ambient os.environ spec (the classic soak daemons).  labels carry the
+    mesh_coord topology tags the elastic-trainer scenario's gang hosts
+    need."""
     with open(head_json) as f:
         info = json.load(f)
     env = os.environ.copy()
@@ -227,8 +242,8 @@ def _launch_daemon(head_json: str, node_id: str, num_cpus: int,
                     "node_id": node_id,
                     "session": info["session"],
                     "num_cpus": num_cpus,
-                    "resources": {},
-                    "labels": {},
+                    "resources": resources or {},
+                    "labels": labels or {},
                 }
             ),
             "PYTHONPATH": os.pathsep.join(dict.fromkeys([REPO_ROOT] + sys.path)),
@@ -991,6 +1006,371 @@ def run_soak(
                 f.write("\n")
 
 
+# ---------------------------------------------------------------------------
+# Elastic-trainer scenario (ISSUE 16): gang re-mesh under a host SIGKILL.
+# ---------------------------------------------------------------------------
+
+
+def _elastic_train_fn(config):
+    """Elastic SPMD soak loop: one checkpointed step at a time.  World
+    size is whatever gang the driver respawned us into (2 -> 1 -> 2 over
+    the scenario); every step reports WITH a checkpoint, so a re-mesh
+    loses at most the in-flight step plus the undrained report window."""
+    import time as _t
+
+    from ray_tpu.train import session
+
+    ckpt = session.get_checkpoint()
+    start = int(ckpt["step"]) + 1 if ckpt else 0
+    rank = session.get_world_rank()
+    world = session.get_world_size()
+    for s in range(start, int(config["steps"])):
+        _append(config["log_path"], f"trainstep:{rank}/{world}:{s}")
+        _t.sleep(float(config["step_s"]))
+        session.report({"step": s, "world": world}, checkpoint={"step": s})
+
+
+class _TrainerLoad(threading.Thread):
+    """Runs fit() off the supervisor thread; remembers result/failure."""
+
+    def __init__(self, steps: int, step_s: float, log_path: str):
+        super().__init__(daemon=True, name="soak-trainer")
+        self.steps = steps
+        self.step_s = step_s
+        self.log_path = log_path
+        self.result = None
+        self.failure: Optional[str] = None
+
+    def run(self):
+        try:
+            from ray_tpu.air.config import (
+                FailureConfig,
+                RunConfig,
+                ScalingConfig,
+            )
+            from ray_tpu.train.backend import BackendConfig
+            from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+
+            trainer = DataParallelTrainer(
+                _elastic_train_fn,
+                train_loop_config={
+                    "steps": self.steps,
+                    "step_s": self.step_s,
+                    "log_path": self.log_path,
+                },
+                # Plain backend: the elasticity under test is the gang +
+                # worker-group machinery, not jax multiprocess (which the
+                # CPU backend cannot run anyway).
+                backend_config=BackendConfig(),
+                scaling_config=ScalingConfig(
+                    num_workers=2,
+                    resources_per_worker={"CPU": 1.0, "gang": 1.0},
+                    placement_strategy="MESH",
+                ),
+                run_config=RunConfig(failure_config=FailureConfig(max_failures=2)),
+            )
+            self.result = trainer.fit()
+            if self.result.error is not None:
+                self.failure = f"fit() returned error: {self.result.error}"
+        except BaseException as e:  # noqa: BLE001 — a soak failure is data
+            import traceback
+
+            self.failure = f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
+
+
+def _train_step_counts(log_path: str) -> Dict[tuple, int]:
+    """{(rank, world, step): executions} from the trainstep ledger."""
+    out: Dict[tuple, int] = {}
+    for line, n in _count_log(log_path).items():
+        if not line.startswith("trainstep:"):
+            continue
+        rw, step = line.split(":")[1:3]
+        rank, world = rw.split("/")
+        out[(int(rank), int(world), int(step))] = n
+    return out
+
+
+def _steps_at_world(counts: Dict[tuple, int], world: int) -> set:
+    return {step for (_r, w, step) in counts if w == world}
+
+
+def run_trainer_soak(
+    seed: int = 11,
+    out: Optional[str] = None,
+    num_cpus: int = 2,
+    watch_locks: bool = True,
+    steps: int = 140,
+    step_s: float = 0.2,
+    wait_s: float = 4.0,
+) -> Dict:
+    """The elastic SPMD gang-re-mesh scenario (report: CHAOS_r11.json).
+
+    Timeline: trainer runs on a 2-host MESH gang -> the harness SIGKILLs
+    gang host B mid-step -> the head withdraws the gang, waits wait_s for
+    a replacement, then re-plans a 1-host box -> the trainer resumes from
+    the latest checkpoint at world size 1 -> the harness launches a
+    replacement host at B's coordinate -> the sweep flags scale-up, the
+    trainer re-meshes back to world size 2 and finishes every step."""
+    from ray_tpu._private import lock_watchdog
+    from ray_tpu._private.head import launch_head_subprocess
+    from ray_tpu.util import tracing
+
+    workdir = tempfile.mkdtemp(prefix=f"chaos-trainer-{seed}-")
+    log_path = os.path.join(workdir, "executions.log")
+    session = f"remesh{seed}x{os.getpid():x}"
+    saved_env = {
+        k: os.environ.get(k)
+        for k in (
+            "RAY_TPU_FAULT_SPEC",
+            "RAY_TPU_REMESH_WAIT_S",
+            "RAY_TPU_TRACE",
+            "RAY_TPU_FLIGHT_DIR",
+            "RAY_TPU_LOCK_WATCHDOG",
+            "RAY_TPU_LOCK_WATCHDOG_DIR",
+            "RAY_TPU_LOCK_HOLD_S",
+            "RAY_TPU_METRICS_PUSH_MS",
+        )
+    }
+    # No ambient fault storm: the chaos here is the host SIGKILL itself
+    # (plus full telemetry/watchdog planes, which must stay clean).
+    os.environ.pop("RAY_TPU_FAULT_SPEC", None)
+    os.environ["RAY_TPU_REMESH_WAIT_S"] = str(wait_s)
+    flight_dir = os.path.join(workdir, "flight")
+    os.makedirs(flight_dir, exist_ok=True)
+    os.environ["RAY_TPU_TRACE"] = "1"
+    os.environ["RAY_TPU_FLIGHT_DIR"] = flight_dir
+    os.environ.setdefault("RAY_TPU_METRICS_PUSH_MS", "1000")
+    tracing.enable_tracing()  # driver process: spans for the remesh stages
+    watchdog_dir = os.path.join(workdir, "watchdog")
+    if watch_locks:
+        os.makedirs(watchdog_dir, exist_ok=True)
+        os.environ["RAY_TPU_LOCK_WATCHDOG"] = "1"
+        os.environ["RAY_TPU_LOCK_WATCHDOG_DIR"] = watchdog_dir
+        os.environ.setdefault("RAY_TPU_LOCK_HOLD_S", "2.0")
+        lock_watchdog._enable_for_tests(True)
+
+    report: Dict = {
+        "seed": seed,
+        "scenario": "elastic-trainer",
+        "steps": steps,
+        "step_s": step_s,
+        "remesh_wait_s": wait_s,
+        "kills": {"gang_daemon": 0},
+        "lock_watchdog": {"enabled": watch_locks, "reports": []},
+        "result": "FAIL",
+    }
+    head = gang_a = gang_b = None
+    import ray_tpu
+
+    try:
+        head, head_json = launch_head_subprocess(
+            workdir, num_cpus=num_cpus, session=session
+        )
+        # Two gang hosts on a 1-D mesh (coordinates "0" and "1"); the
+        # custom "gang" resource pins train workers onto them.
+        gang_a = _launch_daemon(head_json, "gang-a", 2, spec_override="",
+                                resources={"gang": 1.0},
+                                labels={"mesh_coord": "0"})
+        gang_b = _launch_daemon(head_json, "gang-b", 2, spec_override="",
+                                resources={"gang": 1.0},
+                                labels={"mesh_coord": "1"})
+        ray_tpu.init(address=head_json)
+
+        t0 = time.monotonic()
+
+        def note(msg):
+            print(f"[remesh t={time.monotonic() - t0:6.1f}s] {msg}",
+                  flush=True)
+
+        def wait_for(cond, what, deadline_s):
+            deadline = time.monotonic() + deadline_s
+            while time.monotonic() < deadline:
+                if trainer.failure:
+                    raise AssertionError(f"trainer failed: {trainer.failure}")
+                if cond():
+                    return time.monotonic() - t0
+                time.sleep(0.25)
+            raise AssertionError(f"timed out after {deadline_s}s waiting "
+                                 f"for {what}")
+
+        trainer = _TrainerLoad(steps, step_s, log_path)
+        trainer.start()
+
+        # Phase 1: the full gang trains.
+        wait_for(
+            lambda: len(_steps_at_world(_train_step_counts(log_path), 2)) >= 10,
+            "10 steps at world size 2", 120,
+        )
+        # Phase 2: SIGKILL gang host B mid-step (its PDEATHSIG-armed
+        # train worker dies with it — a whole-host loss, not a clean
+        # actor exit).
+        note("SIGKILL gang-b daemon (host loss mid-step)")
+        gang_b.kill()
+        report["kills"]["gang_daemon"] += 1
+        t_kill = time.monotonic() - t0
+        # Phase 3: the gang must re-form at N-1 and RESUME training.
+        steps_before_kill = _steps_at_world(_train_step_counts(log_path), 2)
+        t_world1 = wait_for(
+            lambda: len(_steps_at_world(_train_step_counts(log_path), 1)) >= 3,
+            "training to resume at world size 1",
+            wait_s + 60,
+        )
+        note(f"re-meshed at N-1, training resumed ({t_world1 - t_kill:.1f}s "
+             "after the kill)")
+        # Phase 4: a replacement host joins at B's coordinate; the sweep
+        # flags scale-up and the trainer re-meshes back to full size.
+        gang_b = _launch_daemon(head_json, "gang-b2", 2, spec_override="",
+                                resources={"gang": 1.0},
+                                labels={"mesh_coord": "1"})
+        t_relaunch = time.monotonic() - t0
+        note("replacement host gang-b2 launched at mesh_coord 1")
+        t_world2 = wait_for(
+            lambda: bool(
+                _steps_at_world(_train_step_counts(log_path), 2)
+                - steps_before_kill
+            ),
+            "training to scale back to world size 2", 90,
+        )
+        note(f"scaled back to N ({t_world2 - t_relaunch:.1f}s after the "
+             "replacement joined)")
+        # Phase 5: run to completion.
+        trainer.join(timeout=steps * step_s + 240)
+        assert not trainer.is_alive(), "trainer never finished (wedged)"
+        assert trainer.failure is None, f"trainer failed: {trainer.failure}"
+        result = trainer.result
+        t_done = time.monotonic() - t0
+        report["timeline"] = {
+            "kill_at_s": round(t_kill, 2),
+            "world1_resumed_at_s": round(t_world1, 2),
+            "replacement_at_s": round(t_relaunch, 2),
+            "world2_resumed_at_s": round(t_world2, 2),
+            "done_at_s": round(t_done, 2),
+            "shrink_recovery_s": round(t_world1 - t_kill, 2),
+            "scale_up_recovery_s": round(t_world2 - t_relaunch, 2),
+        }
+
+        # ---- zero lost results: every step reported exactly once, in
+        # order, across the whole elastic history.
+        got = [m["step"] for m in result.metrics_history]
+        assert got == list(range(steps)), (
+            f"step history wrong: {len(got)} reports, "
+            f"missing={sorted(set(range(steps)) - set(got))[:10]}, "
+            f"dups={sorted({s for s in got if got.count(s) > 1})[:10]}"
+        )
+        # ---- the gang provably shrank and recovered: world sizes form
+        # exactly the 2 -> 1 -> 2 envelope.
+        worlds = [m["world"] for m in result.metrics_history]
+        segments = [w for i, w in enumerate(worlds)
+                    if i == 0 or worlds[i - 1] != w]
+        assert segments == [2, 1, 2], (
+            f"world-size history {segments} != [2, 1, 2]"
+        )
+        report["world_segments"] = segments
+        # ---- bounded lost steps: re-executed (checkpointed-past) work
+        # per re-mesh is at most the in-flight step + the undrained
+        # report window per rank; across two episodes a generous cap
+        # still proves checkpoint resume did its job.
+        counts = _train_step_counts(log_path)
+        by_rank_step: Dict[tuple, int] = {}
+        for (rank, _w, step), n in counts.items():
+            by_rank_step[(rank, step)] = by_rank_step.get((rank, step), 0) + n
+        lost = sum(n - 1 for n in by_rank_step.values() if n > 1)
+        report["lost_steps_reexecuted"] = lost
+        assert lost <= 24, (
+            f"{lost} steps re-executed — checkpoint resume is not bounding "
+            "lost work"
+        )
+        # ---- recovery attribution: every stage of both episodes landed
+        # in the remesh_seconds histogram (driver-side — fit() ran here).
+        from ray_tpu._private import telemetry
+
+        snap = telemetry.remesh_histogram().snapshot()
+        stages = {dict(k).get("stage"): v for k, v in snap.items()}
+        report["remesh_stages"] = {
+            s: {"count": v["count"], "sum_s": round(v["sum"], 3)}
+            for s, v in sorted(stages.items())
+        }
+        for stage in ("detect", "teardown", "replan", "respawn", "resume",
+                      "total"):
+            assert stages.get(stage, {}).get("count", 0) >= 2, (
+                f"remesh stage {stage!r} missing from the histogram: "
+                f"{report['remesh_stages']} (expected one sample per "
+                "episode, 2 episodes)"
+            )
+        # Every episode's end-to-end recovery fits the 60s deadline (the
+        # histogram's >60s buckets stay empty).
+        h = telemetry.remesh_histogram()
+        over_idx = h.boundaries.index(60.0)
+        total_buckets = stages["total"]["buckets"]
+        assert sum(total_buckets[over_idx + 1:]) == 0, (
+            f"a re-mesh took >60s: total buckets {total_buckets} over "
+            f"boundaries {h.boundaries}"
+        )
+        # ---- the ledger converges: no leaked objects from the killed
+        # host's in-flight work.
+        from ray_tpu.util import state as state_api
+
+        mem = None
+        mem_deadline = time.monotonic() + 90
+        while time.monotonic() < mem_deadline:
+            try:
+                mem = state_api.memory_summary(top=0)
+            except Exception:
+                time.sleep(1.0)
+                continue
+            if mem["leak_suspects"] == 0:
+                break
+            time.sleep(1.0)
+        report["memory"] = {
+            "leak_suspects": mem["leak_suspects"] if mem else None,
+            "objects": mem["objects"] if mem else None,
+        }
+        assert mem is not None and mem["leak_suspects"] == 0, (
+            f"object ledger did not converge after the host kill: {mem}"
+        )
+        if watch_locks:
+            wd = lock_watchdog.collect_dir_reports(watchdog_dir)
+            wd.extend(f"driver: {r}" for r in lock_watchdog.reports())
+            report["lock_watchdog"]["reports"] = wd
+            assert not wd, f"lock watchdog reports under re-mesh: {wd}"
+        report["result"] = "PASS"
+        return report
+    except BaseException:
+        print(
+            "\n=== ELASTIC-TRAINER SOAK FAILED — replay with:\n"
+            f"    python scripts/chaos_soak.py --trainer --seed {seed}\n"
+            f"    (session dir kept at {workdir})",
+            file=sys.stderr,
+            flush=True,
+        )
+        raise
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        for proc in (gang_a, gang_b, head):
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        if watch_locks:
+            lock_watchdog._enable_for_tests(
+                os.environ.get("RAY_TPU_LOCK_WATCHDOG") == "1"
+            )
+        if out and report.get("result"):
+            with open(out, "w") as f:
+                json.dump(report, f, indent=1, sort_keys=True)
+                f.write("\n")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--duration", type=float, default=75.0)
@@ -1000,7 +1380,21 @@ def main(argv=None):
     ap.add_argument("--no-serve", action="store_true")
     ap.add_argument("--num-cpus", type=int, default=4)
     ap.add_argument("--no-lock-watchdog", action="store_true")
+    ap.add_argument(
+        "--trainer", action="store_true",
+        help="run the elastic SPMD gang re-mesh scenario instead "
+             "(report: CHAOS_r11.json)",
+    )
     args = ap.parse_args(argv)
+    if args.trainer:
+        report = run_trainer_soak(
+            seed=args.seed if args.seed != 7 else 11,
+            out=args.out or "CHAOS_r11.json",
+            num_cpus=args.num_cpus,
+            watch_locks=not args.no_lock_watchdog,
+        )
+        print(json.dumps(report, indent=1, sort_keys=True))
+        return 0
     report = run_soak(
         duration=args.duration,
         seed=args.seed,

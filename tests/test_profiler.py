@@ -189,6 +189,13 @@ def test_cluster_profile_start_stop_merges_multiple_pids(rt):
             sum(range(200))
         return 1
 
+    # Warm the pool first: on a slow host a cold worker's boot can outlive
+    # the whole profile window (nothing anywhere would sample the spin),
+    # and the ticker needs a beat to subscribe to the profiler channel.
+    assert ray_tpu.get(
+        [spin.remote(0.1) for _ in range(3)], timeout=60
+    ) == [1, 1, 1]
+    time.sleep(1.2)
     state_api.profile_start(hz=120)
     refs = [spin.remote(1.5) for _ in range(3)]
     time.sleep(1.6)

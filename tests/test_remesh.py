@@ -1,0 +1,397 @@
+"""Elastic SPMD re-mesh (ISSUE 16): topology-aware reshape of MESH gangs.
+
+Covers the scheduler leg of gang recovery: torus-wraparound box planning,
+the wait-then-shrink policy after a member-host loss, scale-up back to
+full size, the reshape/remove race, journal replay of a PG that died
+mid-RESHAPING, and the two satellite fixes (pg.wait() failure naming the
+PG state + unplaceable bundles; inconsistent mesh_coord dimensionality
+surfacing as a WARNING event instead of a silent None).
+
+The train-loop leg (BackendExecutor/DataParallelTrainer consuming the
+reshape) is chaos-proven end to end by `scripts/chaos_soak.py --trainer`.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.client import client
+from ray_tpu.util import placement_group, remove_placement_group
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _pg_nodes(pg):
+    from ray_tpu._private.runtime import get_runtime
+
+    return get_runtime().state.placement_groups[pg.id].bundle_nodes
+
+
+def _wait_pg(pg, predicate, timeout=30.0, what="condition"):
+    """Poll pg_info until predicate(info) holds; return the final info."""
+    deadline = time.monotonic() + timeout
+    info = None
+    while time.monotonic() < deadline:
+        info = client.pg_info(pg.id)
+        if info is not None and predicate(info):
+            return info
+        time.sleep(0.1)
+    pytest.fail(f"timed out waiting for {what}; last pg_info={info}")
+
+
+@pytest.fixture
+def fast_remesh():
+    """Shrink the wait-for-replacement window so tests don't sit out the
+    30s production default before the N-1 re-plan."""
+    from ray_tpu._private import config
+
+    config.set_system_config({"remesh_wait_s": 0.5})
+    yield
+    config.set_system_config({"remesh_wait_s": 30.0})
+
+
+@pytest.fixture
+def slow_remesh():
+    """The opposite: a window long enough that the gang provably stays
+    RESHAPING while the test races other transitions against it."""
+    from ray_tpu._private import config
+
+    config.set_system_config({"remesh_wait_s": 60.0})
+    yield
+    config.set_system_config({"remesh_wait_s": 30.0})
+
+
+# -- torus-aware box planning ------------------------------------------------
+
+
+def test_mesh_torus_wraparound_box(ray_start_cluster):
+    """Hosts at opposite label edges of the torus are ICI-adjacent through
+    the wraparound link: with capacity ONLY at coords 3 and 0 of a 4-wide
+    ring, the one feasible 2-box is the wrapped {3,0} — and bundle order
+    still follows coordinate order (0 before 3)."""
+    cluster = ray_start_cluster
+    nodes = {}
+    for c in ("0", "1", "2", "3"):
+        gang = {"gang": 1} if c in ("0", "3") else {}
+        nodes[c] = cluster.add_node(
+            num_cpus=2, resources=gang, labels={"mesh_coord": c}
+        )
+    pg = placement_group([{"CPU": 1, "gang": 1}] * 2, strategy="MESH")
+    assert pg.wait(timeout_seconds=15), "wraparound box was not planned"
+    assignment = _pg_nodes(pg)
+    assert assignment[0] == nodes["0"]
+    assert assignment[1] == nodes["3"]
+    remove_placement_group(pg)
+
+
+# -- host loss: wait-then-shrink, then scale back up -------------------------
+
+
+def test_remesh_shrink_after_host_loss(ray_start_cluster, fast_remesh):
+    """Losing a MESH gang member tears the whole gang into RESHAPING; with
+    no replacement inside remesh_wait_s the head re-plans a smaller
+    contiguous box at N-1 — here around the dead middle host via the
+    torus wraparound {2,0} — and bumps the generation.  When a labeled
+    host returns, the head raises the scale-up cue and pg_reshape
+    re-forms the gang at full size."""
+    cluster = ray_start_cluster
+    nodes = {}
+    for c in ("0", "1", "2"):
+        nodes[c] = cluster.add_node(
+            num_cpus=1, resources={"gang": 1}, labels={"mesh_coord": c}
+        )
+    pg = placement_group([{"CPU": 1, "gang": 1}] * 3, strategy="MESH")
+    assert pg.wait(timeout_seconds=15)
+    gen0 = client.pg_info(pg.id)["generation"]
+
+    cluster.remove_node(nodes["1"])
+    info = _wait_pg(
+        pg,
+        lambda i: i["state"] == "CREATED" and i["generation"] > gen0,
+        what="re-mesh at N-1",
+    )
+    assert info["size"] == 2
+    assert info["orig_size"] == 3
+    # Contiguity held: only the wraparound pair {2,0} is a valid 2-box of
+    # the surviving coords (extent 3; {0,1} and {1,2} contain the corpse).
+    assignment = _pg_nodes(pg)
+    assert assignment[0] == nodes["0"]
+    assert assignment[1] == nodes["2"]
+
+    # Replacement host arrives at the vacated coordinate: the sweep flags
+    # scale_up_ready; the (trainer-initiated) pg_reshape restores N.
+    nodes["1b"] = cluster.add_node(
+        num_cpus=1, resources={"gang": 1}, labels={"mesh_coord": "1"}
+    )
+    _wait_pg(pg, lambda i: i["scale_up_ready"], what="scale-up cue")
+    gen1 = client.pg_info(pg.id)["generation"]
+    assert client.pg_reshape(pg.id)
+    info = _wait_pg(
+        pg,
+        lambda i: i["state"] == "CREATED" and i["generation"] > gen1,
+        what="re-mesh back to full size",
+    )
+    assert info["size"] == 3
+    assert not info["scale_up_ready"]
+    assert sorted(_pg_nodes(pg).values()) == sorted(
+        [nodes["0"], nodes["1b"], nodes["2"]]
+    )
+    remove_placement_group(pg)
+
+
+def test_reshape_race_remove(ray_start_cluster, slow_remesh):
+    """remove_placement_group racing an in-flight RESHAPING episode: the
+    removal wins and the sweep must never resurrect the gang."""
+    cluster = ray_start_cluster
+    nodes = {}
+    for c in ("0", "1"):
+        nodes[c] = cluster.add_node(
+            num_cpus=1, resources={"gang": 1}, labels={"mesh_coord": c}
+        )
+    pg = placement_group([{"CPU": 1, "gang": 1}] * 2, strategy="MESH")
+    assert pg.wait(timeout_seconds=15)
+
+    cluster.remove_node(nodes["1"])
+    _wait_pg(pg, lambda i: i["state"] == "RESHAPING", what="RESHAPING entry")
+    remove_placement_group(pg)
+    # Outlast several 0.5s sweep ticks: state must stay REMOVED through
+    # every one of them (a resurrection would re-reserve host 0).
+    deadline = time.monotonic() + 2.5
+    while time.monotonic() < deadline:
+        assert client.pg_info(pg.id)["state"] == "REMOVED"
+        time.sleep(0.25)
+
+
+# -- satellite fixes ---------------------------------------------------------
+
+
+def test_pg_wait_failure_names_state_and_bundles(ray_start_regular):
+    """BackendExecutor.start must surface a PG that never places as a
+    TrainingFailedError naming the PG state and the unplaceable bundle
+    indices — not silently proceed into WorkerGroup creation."""
+    from ray_tpu.air.config import ScalingConfig
+    from ray_tpu.train.backend import BackendConfig
+    from ray_tpu.train.backend_executor import BackendExecutor, TrainingFailedError
+
+    executor = BackendExecutor(
+        BackendConfig(),
+        ScalingConfig(
+            num_workers=2,
+            resources_per_worker={"CPU": 1.0, "nonexistent_accel": 1.0},
+            placement_strategy="MESH",
+        ),
+    )
+    executor.pg_wait_timeout_s = 1.0
+    try:
+        with pytest.raises(TrainingFailedError) as exc:
+            executor.start()
+        msg = str(exc.value)
+        assert "state=PENDING" in msg
+        assert "unplaceable bundles [0, 1]" in msg
+        assert "mesh_coord labels" in msg
+        assert executor.worker_group is None
+    finally:
+        executor.shutdown()
+
+
+def test_plan_mesh_box_inconsistent_dims_warns(ray_start_cluster):
+    """Mixed mesh_coord dimensionality ("2,0" next to "0") makes every
+    multi-host MESH gang unplaceable — an operator mistake that must
+    surface as a WARNING cluster event naming the minority-dim nodes, not
+    as a silently forever-pending PG."""
+    from ray_tpu._private.runtime import get_runtime
+
+    cluster = ray_start_cluster
+    good_a = cluster.add_node(
+        num_cpus=1, resources={"gang": 1}, labels={"mesh_coord": "0"}
+    )
+    good_b = cluster.add_node(
+        num_cpus=1, resources={"gang": 1}, labels={"mesh_coord": "1"}
+    )
+    bad = cluster.add_node(
+        num_cpus=1, resources={"gang": 1}, labels={"mesh_coord": "2,0"}
+    )
+    pg = placement_group([{"CPU": 1, "gang": 1}] * 2, strategy="MESH")
+    assert not pg.wait(timeout_seconds=2), "inconsistent labels still placed"
+    events = [
+        e
+        for e in get_runtime().events.recent(
+            severity="WARNING", source="scheduler"
+        )
+        if "inconsistent mesh_coord" in e["message"]
+    ]
+    assert events, "no WARNING event for inconsistent label dimensionality"
+    assert events[-1]["nodes"] == [bad]
+    assert good_a not in events[-1]["nodes"]
+    assert good_b not in events[-1]["nodes"]
+    assert sorted(events[-1]["dims"]) == [1, 2]
+    remove_placement_group(pg)
+
+
+# -- journal replay of a PG dead mid-RESHAPING -------------------------------
+
+
+def _launch_daemon(head_json, node_id, num_cpus, resources, labels):
+    with open(head_json) as f:
+        info = json.load(f)
+    env = os.environ.copy()
+    env.update(
+        {
+            "RAY_TPU_DRIVER_HOST": info["host"],
+            "RAY_TPU_DRIVER_PORT": str(info["port"]),
+            "RAY_TPU_AUTHKEY": info["authkey"],
+            "RAY_TPU_NODE_CONFIG": json.dumps(
+                {
+                    "node_id": node_id,
+                    "session": info["session"],
+                    "num_cpus": num_cpus,
+                    "resources": resources,
+                    "labels": labels,
+                }
+            ),
+            "PYTHONPATH": os.pathsep.join(
+                dict.fromkeys([REPO_ROOT] + sys.path)
+            ),
+        }
+    )
+    return subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.node_daemon"],
+        env=env,
+        close_fds=True,
+    )
+
+
+def _pg_info_retry(pg_id, timeout=60.0):
+    """pg_info with reconnect retries across a head bounce."""
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            info = client.pg_info(pg_id)
+            if info is not None:
+                return info
+            last = info
+        except (ConnectionError, EOFError, OSError) as e:
+            last = e
+        time.sleep(0.5)
+    pytest.fail(f"pg_info({pg_id}) never answered after bounce: {last!r}")
+
+
+def test_remesh_journal_replay(tmp_path):
+    """Head dies mid-episode: a PG removed while RESHAPING must replay as
+    REMOVED (never resurrected by the restarted sweep), and a PG left
+    RESHAPING must come back RESHAPING with a fresh head-local wait
+    window — the deadline is deliberately not journaled."""
+    from ray_tpu._private.head import launch_head_subprocess
+
+    env_before = os.environ.get("RAY_TPU_REMESH_WAIT_S")
+    os.environ["RAY_TPU_REMESH_WAIT_S"] = "60"
+    daemons = []
+    proc = None
+    try:
+        proc, head_json = launch_head_subprocess(
+            str(tmp_path), num_cpus=2, session="remeshj"
+        )
+        ray_tpu.init(address=head_json)
+        # One unit of "ga" and "gb" per host: each gang's bundles demand a
+        # full unit, so BOTH placement groups must span BOTH hosts (a
+        # 2-bundle gang that fits one host would be trivially contiguous
+        # and dodge the member-loss path this test exercises).
+        daemons.append(
+            _launch_daemon(
+                head_json, "remesh-a", 2, {"ga": 1, "gb": 1},
+                {"mesh_coord": "0"},
+            )
+        )
+        daemons.append(
+            _launch_daemon(
+                head_json, "remesh-b", 2, {"ga": 1, "gb": 1},
+                {"mesh_coord": "1"},
+            )
+        )
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if ray_tpu.cluster_resources().get("gb", 0) >= 2:
+                break
+            time.sleep(0.5)
+        else:
+            pytest.fail("gang daemons never registered")
+
+        pg_removed = placement_group(
+            [{"CPU": 0.5, "ga": 1}] * 2, strategy="MESH"
+        )
+        pg_kept = placement_group(
+            [{"CPU": 0.5, "gb": 1}] * 2, strategy="MESH"
+        )
+        assert pg_removed.wait(timeout_seconds=30)
+        assert pg_kept.wait(timeout_seconds=30)
+
+        # Member-host loss: SIGKILL tears the daemon's conn, the head
+        # withdraws both gangs into journaled RESHAPING episodes.
+        daemons[1].kill()
+        daemons[1].wait(timeout=10)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            states = {
+                client.pg_info(pg_removed.id)["state"],
+                client.pg_info(pg_kept.id)["state"],
+            }
+            if states == {"RESHAPING"}:
+                break
+            time.sleep(0.25)
+        else:
+            pytest.fail("gangs never entered RESHAPING after host loss")
+
+        # One removal lands mid-episode, then the head dies and replays
+        # its journal on restart.
+        remove_placement_group(pg_removed)
+        assert client.pg_info(pg_removed.id)["state"] == "REMOVED"
+        time.sleep(1.0)  # journal group-commit window
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10)
+        proc, head_json = launch_head_subprocess(
+            str(tmp_path), num_cpus=2, session="remeshj"
+        )
+
+        info = _pg_info_retry(pg_kept.id)
+        assert info["state"] == "RESHAPING", (
+            f"RESHAPING episode did not survive the bounce: {info}"
+        )
+        # The restarted sweep re-arms a fresh 60s window for the survivor
+        # and must not resurrect the removed gang — watch several ticks.
+        # A REMOVED record replayed from the journal tail answers
+        # "REMOVED"; one already folded out by a snapshot (snapshots drop
+        # REMOVED rows) is forgotten and answers None.  Both mean dead.
+        deadline = time.monotonic() + 2.5
+        while time.monotonic() < deadline:
+            removed = client.pg_info(pg_removed.id)
+            assert removed is None or removed["state"] == "REMOVED", (
+                f"removed gang resurrected across the bounce: {removed}"
+            )
+            assert client.pg_info(pg_kept.id)["state"] == "RESHAPING"
+            time.sleep(0.25)
+    finally:
+        if env_before is None:
+            os.environ.pop("RAY_TPU_REMESH_WAIT_S", None)
+        else:
+            os.environ["RAY_TPU_REMESH_WAIT_S"] = env_before
+        ray_tpu.shutdown()
+        for d in daemons:
+            if d.poll() is None:
+                d.terminate()
+                try:
+                    d.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    d.kill()
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
