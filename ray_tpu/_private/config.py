@@ -233,6 +233,15 @@ _DEFS: Dict[str, tuple] = {
         "batching entirely (every frame is its own write — the unbatched "
         "comparison baseline; ray: gRPC stream buffering plays this role)",
     ),
+    "wire_guard": (
+        1, int,
+        "1 = bounds-check native frame bodies before marshal.loads "
+        "(every declared string length / container count must fit the "
+        "bytes present, cumulative allocation capped at O(body)) — a "
+        "corrupted or hostile 11-byte body can otherwise make the "
+        "decoder pre-allocate gigabytes; costs a few µs per native "
+        "frame; 0 trusts the fabric and decodes unguarded",
+    ),
     "wire_flush_us": (
         200, int,
         "linger bound on a pending control-frame batch: the background "
@@ -453,6 +462,49 @@ _ENV_ALIASES: Dict[str, tuple] = {
     "task_lease_idle_s": ("RAY_TPU_LEASE_IDLE_S",),
     "gcs_journal_flush_us": ("RAY_TPU_JOURNAL_FLUSH_US",),
     "gcs_journal_batch_bytes": ("RAY_TPU_JOURNAL_BATCH_BYTES",),
+}
+
+# Process-wiring environment variables: NOT knobs.  These carry bootstrap
+# plumbing between processes (spawn-time identity, fds, endpoints) or are
+# read before the config table can be imported (early-boot toggles), so
+# they are accessed directly via os.environ rather than config.get().
+# Declared here so the knob-registry lint can tell a deliberate wiring
+# access from a typo'd knob name (which silently no-ops).  Adding an env
+# var that is neither a knob nor declared here fails the lint.
+WIRING_ENV: Dict[str, str] = {
+    # spawn-time identity / topology (parent -> child)
+    "RAY_TPU_DRIVER_HOST": "head endpoint host handed to spawned processes",
+    "RAY_TPU_DRIVER_PORT": "head endpoint port handed to spawned processes",
+    "RAY_TPU_AUTHKEY": "hex cluster authkey handed to spawned processes",
+    "RAY_TPU_SESSION": "session id handed to spawned processes",
+    "RAY_TPU_WORKER_ID": "this worker's id (set by the spawning daemon)",
+    "RAY_TPU_NODE_ID": "this node's id (set by the spawning daemon)",
+    "RAY_TPU_NODE_CONFIG": "JSON node spec for a starting node daemon",
+    "RAY_TPU_HEAD_CONFIG": "JSON head spec for `ray_tpu head` boot",
+    "RAY_TPU_IO_SHARD_CONFIG": "JSON shard spec for a forked io shard",
+    "RAY_TPU_PEER_HOST": "host the worker's direct-call listener binds",
+    "RAY_TPU_HOST_IP": "this host's routable IP (parallel bootstrap)",
+    "RAY_TPU_STORE_DIR": "shm store directory handed to spawned processes",
+    "RAY_TPU_RUNTIME_ENV": "JSON runtime_env applied at worker boot",
+    "RAY_TPU_ENV_VARS": "JSON extra env vars applied at worker boot",
+    # inherited descriptors (SCM_RIGHTS / fork plumbing)
+    "RAY_TPU_ZYGOTE_FD": "inherited zygote control-pipe fd number",
+    "RAY_TPU_ARENA_FD": "inherited shm arena fd number",
+    # early-boot / dev toggles read before config import is safe
+    "RAY_TPU_TRACE": "1 = per-op wall-clock tracing to stderr",
+    "RAY_TPU_BOOT_TRACE": "1 = worker boot-phase timing to stderr",
+    "RAY_TPU_DEBUG_LOCKS": "1 = slow-lock diagnostics in the runtime",
+    "RAY_TPU_FAULTHANDLER": "1 = arm faulthandler in spawned workers",
+    "RAY_TPU_PDEATHSIG": "0 = skip parent-death signal on Linux children",
+    "RAY_TPU_CHIPS": "override detected accelerator chip count",
+    "RAY_TPU_LOCK_WATCHDOG": "1 = swap hot locks for instrumented wrappers",
+    "RAY_TPU_LOCK_HOLD_S": "lock-watchdog long-hold threshold (seconds)",
+    "RAY_TPU_LOCK_WATCHDOG_DIR": "per-pid lock-watchdog report directory",
+    # cache locations
+    "RAY_TPU_NATIVE_CACHE": "build cache dir for the native arena module",
+    "RAY_TPU_PKG_CACHE": "download cache dir for runtime_env packages",
+    # bench plumbing
+    "RAY_TPU_PERF_PERSIST": "keep ray_perf scratch dirs for inspection",
 }
 
 _lock = threading.Lock()

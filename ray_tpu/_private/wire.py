@@ -197,10 +197,12 @@ SCHEMAS: Dict[str, Tuple[int, Optional[int], tuple]] = {
     # daemon <-> head
     "daemon": (3, 3, (str,)),
     "heartbeat": (0, 1, ()),
-    "worker_exited": (1, 3, (str,)),
+    # worker_exited rides two channels: zygote -> daemon sends (wid, rc),
+    # daemon -> head adds the oom flag (wid, rc, oom).
+    "worker_exited": (2, 3, (str,)),
     "worker_oom_killed": (1, None, (str,)),
     "log_lines": (3, 3, (str, str, list)),
-    "spawn_worker": (1, None, (str,)),
+    "spawn_worker": (2, 2, (str,)),
     "kill_worker": (1, 1, (str,)),
     "delete_object": (1, 1, (str,)),
     # peer transport
@@ -273,6 +275,108 @@ def encode_body(obj: Any) -> bytes:
     return pickle.dumps(obj, protocol=5)
 
 
+# Allocation guard for the pickle path (RAY_TPU_WIRE_GUARD, shared with
+# the marshal-side guard in wire_native._scan_payload).  pickle.loads has
+# the same pre-allocation hazard marshal does: counted opcodes
+# (BINBYTES8, BYTEARRAY8 — the latter ZERO-FILLS) allocate the declared
+# size before checking the buffer holds it, and LONG_BINPUT grows the
+# memo table to the declared index — so a single byte flip in a pickled
+# body can make the decoder commit gigabytes.  The scan walks the opcode
+# stream, bounds every declared length/index against the bytes actually
+# present, and admits only opcodes a protocol-2+ pickler emits (our
+# encoder always writes protocol 5; a text-era opcode in a frame body is
+# corruption, not data).  It bounds ALLOCATION only — pickle still
+# executes reducers on scan-clean bodies; the trust model is unchanged.
+_PK_BAD, _PK_C1, _PK_C4, _PK_C8, _PK_PUT4 = -1, -2, -3, -4, -5
+_PK_ACTIONS = [_PK_BAD] * 256
+for _op, _skip in {
+    0x80: 1,          # PROTO
+    0x95: 8,          # FRAME (length hint; loads tolerates mismatch)
+    0x2E: 0,          # STOP
+    0x28: 0, 0x30: 0, 0x31: 0, 0x32: 0,        # MARK POP POP_MARK DUP
+    0x4E: 0, 0x88: 0, 0x89: 0,                 # NONE NEWTRUE NEWFALSE
+    0x29: 0, 0x85: 0, 0x86: 0, 0x87: 0, 0x74: 0,  # tuples
+    0x5D: 0, 0x61: 0, 0x65: 0,                 # EMPTY_LIST APPEND APPENDS
+    0x7D: 0, 0x73: 0, 0x75: 0,                 # EMPTY_DICT SETITEM(S)
+    0x8F: 0, 0x90: 0, 0x91: 0,                 # sets
+    0x52: 0, 0x62: 0, 0x81: 0, 0x92: 0,        # REDUCE BUILD NEWOBJ(_EX)
+    0x93: 0, 0x94: 0,                          # STACK_GLOBAL MEMOIZE
+    0x4A: 4, 0x4B: 1, 0x4D: 2, 0x47: 8,        # BININT/1/2 BINFLOAT
+    0x68: 1, 0x6A: 4, 0x71: 1,                 # BINGET LONG_BINGET BINPUT
+    0x51: 0, 0x97: 0, 0x98: 0,  # BINPERSID NEXT_BUFFER READONLY_BUFFER
+}.items():
+    _PK_ACTIONS[_op] = _skip
+_PK_ACTIONS[0x8C] = _PK_C1   # SHORT_BINUNICODE
+_PK_ACTIONS[0x58] = _PK_C4   # BINUNICODE
+_PK_ACTIONS[0x8D] = _PK_C8   # BINUNICODE8
+_PK_ACTIONS[0x43] = _PK_C1   # SHORT_BINBYTES
+_PK_ACTIONS[0x42] = _PK_C4   # BINBYTES
+_PK_ACTIONS[0x8E] = _PK_C8   # BINBYTES8
+_PK_ACTIONS[0x96] = _PK_C8   # BYTEARRAY8
+_PK_ACTIONS[0x8A] = _PK_C1   # LONG1
+_PK_ACTIONS[0x8B] = _PK_C4   # LONG4
+_PK_ACTIONS[0x72] = _PK_PUT4  # LONG_BINPUT: memo grows to the index
+del _op, _skip
+
+
+def _scan_pickle(data) -> None:
+    """Bounds-check a pickled body's opcode stream before pickle.loads.
+    Raises ProtocolError when a declared length/index outruns the bytes
+    present or an opcode outside the binary-protocol subset appears.
+    Stops at STOP like loads does; a stream that ends without STOP is
+    left for loads to reject (it can't over-allocate once every counted
+    opcode is bounded)."""
+    if type(data) is not bytes:
+        data = bytes(data)
+    n = len(data)
+    pos = 0
+    actions = _PK_ACTIONS
+    while pos < n:
+        op = data[pos]
+        act = actions[op]
+        pos += 1
+        if act > 0:
+            pos += act
+            continue
+        if act == 0:
+            if op == 0x2E:  # STOP: loads ignores anything after it
+                return
+            continue
+        if act == _PK_C1:
+            if pos >= n:
+                raise ProtocolError("truncated pickle opcode argument")
+            ln = data[pos]
+            pos += 1 + ln
+            continue
+        if act == _PK_C4 or act == _PK_C8:
+            width = 4 if act == _PK_C4 else 8
+            if pos + width > n:
+                raise ProtocolError("truncated pickle opcode argument")
+            ln = int.from_bytes(data[pos:pos + width], "little")
+            pos += width
+            if ln > n - pos:
+                raise ProtocolError(
+                    f"pickle opcode {op:#x} declares {ln} bytes, "
+                    f"{n - pos} remain — allocation bomb"
+                )
+            pos += ln
+            continue
+        if act == _PK_PUT4:
+            if pos + 4 > n:
+                raise ProtocolError("truncated pickle opcode argument")
+            idx = int.from_bytes(data[pos:pos + 4], "little")
+            if idx > n:
+                raise ProtocolError(
+                    f"pickle memo index {idx} outruns the body — the memo "
+                    "table would be grown to it"
+                )
+            pos += 4
+            continue
+        raise ProtocolError(
+            f"pickle opcode {op:#x} outside the binary-protocol subset"
+        )
+
+
 def decode_body(body) -> Any:
     """Decode + schema-validate ONE sub-frame body (pickled or native)."""
     if body and body[0] != 0x80:
@@ -282,7 +386,17 @@ def decode_body(body) -> Any:
             raise ProtocolError(str(e)) from None
         _count_codec(native_decodes=1)
     else:
-        obj = pickle.loads(body)
+        # A corrupt pickled body raises UnpicklingError/EOFError/etc. —
+        # wrap in ProtocolError so a torn frame is a boundary rejection
+        # (conn death), never an unhandled exception in a recv loop.
+        if wire_native._guard_enabled():
+            _scan_pickle(body)
+        try:
+            obj = pickle.loads(body)
+        except ProtocolError:
+            raise
+        except Exception as e:
+            raise ProtocolError(f"malformed pickled frame body: {e!r}") from None
         _count_codec(pickle_decodes=1)
     _validate(obj)
     return obj

@@ -1,25 +1,34 @@
 #!/usr/bin/env python
-"""Concurrency lint CLI — ray_tpu's TSAN/clang-annotation stand-in.
+"""Static-analysis lint CLI — ray_tpu's TSAN/clang-annotation stand-in.
 
-    python scripts/ray_tpu_lint.py [ray_tpu/] [--fix-allowlist] [-v]
+    python scripts/ray_tpu_lint.py [ray_tpu/] [--fix-allowlist] [-v] [--json]
 
-Runs the five analysis passes (blocking-under-lock, lock-order,
-fault-registry, hot-send, gcs-mutation — see ray_tpu/_private/analysis/) over the package and
-exits non-zero on any violation not covered by the reviewed allowlist
+Runs the eleven analysis passes (blocking-under-lock, lock-order,
+fault-registry, hot-send, gcs-mutation, journal-coverage, metric-names,
+span-names, copy-coverage, wire-schema, knob-registry — see
+ray_tpu/_private/analysis/) over the package and exits non-zero on any
+violation not covered by the reviewed allowlist
 (ray_tpu/_private/analysis/allowlist.txt).  Tier-1 tests run this same
 entry point (tests/test_concurrency_lint.py), so a new blocking call
-under a lock fails CI before it costs a chaos soak to find.
+under a lock — or a frame send that drifts from wire.SCHEMAS — fails CI
+before it costs a chaos soak to find.
 
 --fix-allowlist regenerates the allowlist DELIBERATELY (the only
 sanctioned way to grow it): current findings become the key set, existing
 justifications are preserved, new keys are marked "TODO: justify" (which
 the lint then reports until a human writes the reason).  It also rewrites
-the generated fault-point catalog (fault_points.txt).
+the generated catalogs (fault_points.txt, metric_names.txt,
+span_names.txt, knob_names.txt); a committed catalog that doesn't match
+regeneration fails the lint (the "forgot to regenerate" gap).
+
+--json emits a machine-readable report (per-pass findings/new counts,
+per-pass timing in seconds, every violation) instead of the text report.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
@@ -27,25 +36,20 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
-from ray_tpu._private.analysis import run_analysis  # noqa: E402
+from ray_tpu._private.analysis import PASSES, run_analysis  # noqa: E402
 from ray_tpu._private.analysis import allowlist as allowlist_mod  # noqa: E402
 from ray_tpu._private.analysis import fault_registry  # noqa: E402
+from ray_tpu._private.analysis import knob_registry  # noqa: E402
 from ray_tpu._private.analysis import metric_names  # noqa: E402
 from ray_tpu._private.analysis import span_names  # noqa: E402
 from ray_tpu._private.analysis.common import iter_py_files  # noqa: E402
 
-DEFAULT_ALLOWLIST = os.path.join(
-    _REPO_ROOT, "ray_tpu", "_private", "analysis", "allowlist.txt"
-)
-DEFAULT_CATALOG = os.path.join(
-    _REPO_ROOT, "ray_tpu", "_private", "analysis", "fault_points.txt"
-)
-DEFAULT_METRIC_CATALOG = os.path.join(
-    _REPO_ROOT, "ray_tpu", "_private", "analysis", "metric_names.txt"
-)
-DEFAULT_SPAN_CATALOG = os.path.join(
-    _REPO_ROOT, "ray_tpu", "_private", "analysis", "span_names.txt"
-)
+_ANALYSIS_DIR = os.path.join(_REPO_ROOT, "ray_tpu", "_private", "analysis")
+DEFAULT_ALLOWLIST = os.path.join(_ANALYSIS_DIR, "allowlist.txt")
+DEFAULT_CATALOG = os.path.join(_ANALYSIS_DIR, "fault_points.txt")
+DEFAULT_METRIC_CATALOG = os.path.join(_ANALYSIS_DIR, "metric_names.txt")
+DEFAULT_SPAN_CATALOG = os.path.join(_ANALYSIS_DIR, "span_names.txt")
+DEFAULT_KNOB_CATALOG = os.path.join(_ANALYSIS_DIR, "knob_names.txt")
 
 
 def main(argv=None) -> int:
@@ -57,20 +61,27 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--spec-roots", nargs="*",
         default=[os.path.join(_REPO_ROOT, "tests"), os.path.join(_REPO_ROOT, "scripts")],
-        help="where fault-spec literals are validated (default: tests/ scripts/)",
+        help="where fault-spec literals and knob env names are validated "
+        "(default: tests/ scripts/)",
     )
     ap.add_argument("--allowlist", default=DEFAULT_ALLOWLIST)
     ap.add_argument("--catalog", default=DEFAULT_CATALOG)
     ap.add_argument("--metric-catalog", default=DEFAULT_METRIC_CATALOG)
     ap.add_argument("--span-catalog", default=DEFAULT_SPAN_CATALOG)
+    ap.add_argument("--knob-catalog", default=DEFAULT_KNOB_CATALOG)
     ap.add_argument(
         "--no-catalog-check", action="store_true",
         help="skip the generated-catalog staleness checks (fixture trees)",
     )
     ap.add_argument(
         "--fix-allowlist", action="store_true",
-        help="regenerate allowlist keys + the fault-point catalog from "
+        help="regenerate allowlist keys + the generated catalogs from "
         "current findings (preserves existing justifications)",
+    )
+    ap.add_argument(
+        "--json", action="store_true", dest="json_out",
+        help="machine-readable report: per-pass counts + timings, every "
+        "violation, overall ok",
     )
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="also print allowlisted findings")
@@ -83,6 +94,7 @@ def main(argv=None) -> int:
         catalog_path=None if args.no_catalog_check else args.catalog,
         metric_catalog_path=None if args.no_catalog_check else args.metric_catalog,
         span_catalog_path=None if args.no_catalog_check else args.span_catalog,
+        knob_catalog_path=None if args.no_catalog_check else args.knob_catalog,
     )
 
     if args.fix_allowlist:
@@ -93,6 +105,7 @@ def main(argv=None) -> int:
         metric_names.write_catalog(metrics, args.metric_catalog)
         spans = span_names.collect_spans(files)
         span_names.write_catalog(spans, args.span_catalog)
+        n_knobs = knob_registry.write_catalog(args.knob_catalog)
         # Catalog staleness violations are cured by the rewrites above, so
         # they never become allowlist entries.
         keys = sorted(
@@ -102,6 +115,7 @@ def main(argv=None) -> int:
                 if not v.key.startswith("fault-registry:catalog:")
                 and not v.key.startswith("metric-names:catalog:")
                 and not v.key.startswith("span-names:catalog:")
+                and not v.key.startswith("knob-registry:catalog:")
             }
         )
         existing = result.allowlist
@@ -116,19 +130,56 @@ def main(argv=None) -> int:
             f"catalog: {len(metrics)} metric names -> {args.metric_catalog}"
         )
         print(f"catalog: {len(spans)} span names -> {args.span_catalog}")
+        print(f"catalog: {n_knobs} knob/wiring names -> {args.knob_catalog}")
         return 0
 
+    todo = allowlist_mod.unjustified(result.allowlist)
     by_pass = {}
     for v in result.violations:
         by_pass.setdefault(v.pass_name, []).append(v)
-    for pass_name in ("blocking-under-lock", "lock-order", "fault-registry",
-                      "hot-send", "gcs-mutation", "journal-coverage",
-                      "metric-names", "span-names", "copy-coverage"):
+
+    if args.json_out:
+        report = {
+            "ok": bool(not result.new and not todo),
+            "passes": {
+                p: {
+                    "findings": len(by_pass.get(p, [])),
+                    "allowlisted": sum(
+                        1 for v in by_pass.get(p, [])
+                        if v.key in result.allowlist
+                    ),
+                    "new": sum(
+                        1 for v in by_pass.get(p, [])
+                        if v.key not in result.allowlist
+                    ),
+                    "seconds": round(result.timings.get(p, 0.0), 4),
+                }
+                for p in PASSES
+            },
+            "violations": [
+                {
+                    "pass": v.pass_name,
+                    "file": v.rel,
+                    "line": v.line,
+                    "key": v.key,
+                    "message": v.message,
+                    "allowlisted": v.key in result.allowlist,
+                }
+                for v in result.violations
+            ],
+            "unjustified_allowlist": todo,
+            "stale_allowlist": result.stale_allowlist,
+        }
+        print(json.dumps(report, indent=2, sort_keys=True))
+        return 0 if report["ok"] else 1
+
+    for pass_name in PASSES:
         vs = by_pass.get(pass_name, [])
         new = [v for v in vs if v.key not in result.allowlist]
         print(
             f"[{pass_name}] {len(vs)} finding(s), "
-            f"{len(vs) - len(new)} allowlisted, {len(new)} new"
+            f"{len(vs) - len(new)} allowlisted, {len(new)} new "
+            f"({result.timings.get(pass_name, 0.0):.3f}s)"
         )
         for v in new:
             print(f"  NEW: {v.message}")
@@ -138,7 +189,6 @@ def main(argv=None) -> int:
                     print(f"  allowlisted: {v.message}")
                     print(f"    reason: {result.allowlist[v.key]}")
 
-    todo = allowlist_mod.unjustified(result.allowlist)
     for k in todo:
         print(f"  UNJUSTIFIED allowlist entry (write a reason): {k}")
     for k in result.stale_allowlist:
@@ -151,7 +201,7 @@ def main(argv=None) -> int:
             "review + run --fix-allowlist and write a justification."
         )
         return 1
-    print("\nOK: no new concurrency-lint violations.")
+    print("\nOK: no new static-analysis violations.")
     return 0
 
 

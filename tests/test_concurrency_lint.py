@@ -950,3 +950,289 @@ def test_gcs_mutation_exempts_the_mutator_module(tmp_path):
         """,
     )
     assert len(gcs_mutation.scan_file(p2, "other.py")) == 1
+
+
+# ---------------------------------------------------------------------------
+# pass 10: wire-schema
+
+
+def test_wire_schema_flags_unregistered_kind_send(tmp_path):
+    """The PR-7 bug class: a send site invents a frame kind ('refs_pushh')
+    that wire.SCHEMAS never registered — the peer's _validate kills the
+    conn on the first push, and nothing static said so."""
+    from ray_tpu._private.analysis import wire_schema
+
+    p = _write(
+        tmp_path,
+        "fixture_send.py",
+        """
+        class Pusher:
+            def push(self, conn, refs):
+                conn.send(("refs_pushh", refs))  # seeded typo'd kind
+                conn.send(("refs_push", refs))   # real kind, fine
+        """,
+    )
+    found = wire_schema.scan_file(p, "fixture_send.py")
+    keys = [v.key for v in found]
+    assert keys == ["wire-schema:send-kind:fixture_send.py:Pusher.push:refs_pushh"]
+    assert "refs_pushh" in found[0].message
+
+
+def test_wire_schema_flags_send_arity_and_leading_type(tmp_path):
+    from ray_tpu._private.analysis import wire_schema
+
+    p = _write(
+        tmp_path,
+        "fixture_arity.py",
+        """
+        def announce(conn, wid):
+            conn.send(("spawn_worker", wid))            # 1 extra, schema wants 2
+            conn.send(("worker_exited", 3, 0))          # field0 int, schema wants str
+            conn.send(("worker_exited", wid, 0))        # unknowable wid: fine
+        """,
+    )
+    keys = sorted(v.key for v in wire_schema.scan_file(p, "fixture_arity.py"))
+    assert keys == [
+        "wire-schema:send-arity:fixture_arity.py:announce:spawn_worker",
+        "wire-schema:send-type:fixture_arity.py:announce:worker_exited:field0",
+    ]
+
+
+def test_wire_schema_flags_recv_overread(tmp_path):
+    """The PR-4 bug class: a recv handler indexes past the schema MINIMUM
+    without a len() guard.  'ready' guarantees 3 extras (min) but carries
+    up to 7 — msg[4] works against new senders and IndexErrors against
+    old ones, exactly the skew that shipped."""
+    from ray_tpu._private.analysis import wire_schema
+
+    p = _write(
+        tmp_path,
+        "fixture_recv.py",
+        """
+        def loop(conn):
+            msg = conn.recv()
+            kind = msg[0]
+            if kind == "ready":
+                oid = msg[1]
+                size = msg[2]
+                announce = msg[4]          # seeded: beyond min, unguarded
+                if len(msg) > 5:
+                    tstamp = msg[5]        # guarded: fine
+        """,
+    )
+    keys = [v.key for v in wire_schema.scan_file(p, "fixture_recv.py")]
+    assert keys == ["wire-schema:recv-arity:fixture_recv.py:loop:ready:field4"]
+
+
+def test_wire_schema_flags_exact_unpack_of_variable_arity(tmp_path):
+    """Exact tuple unpack of a kind whose schema allows MORE fields than
+    unpacked: 'worker_exited' is (2, 3) — `_, wid, rc = msg` raises
+    ValueError the day a sender uses the third extra (the oom flag)."""
+    from ray_tpu._private.analysis import wire_schema
+
+    p = _write(
+        tmp_path,
+        "fixture_unpack.py",
+        """
+        def drain(conn):
+            msg = conn.recv()
+            if msg[0] == "worker_exited":
+                _, wid, rc = msg           # seeded: schema max is 3 extras
+        """,
+    )
+    found = wire_schema.scan_file(p, "fixture_unpack.py")
+    assert [v.key for v in found] == [
+        "wire-schema:recv-unpack:fixture_unpack.py:drain:worker_exited"
+    ]
+    assert "worker_exited" in found[0].message
+
+
+def test_wire_schema_clean_fixture_has_no_findings(tmp_path):
+    """Schema-conformant send + guarded recv produce zero findings — the
+    pass has no background noise to drown real drift in."""
+    from ray_tpu._private.analysis import wire_schema
+
+    p = _write(
+        tmp_path,
+        "fixture_clean.py",
+        """
+        def pump(conn):
+            conn.send(("heartbeat", 3))
+            msg = conn.recv()
+            if msg[0] == "worker_exited":
+                wid, rc = msg[1], msg[2]
+                oom = msg[3] if len(msg) > 3 else False
+        """,
+    )
+    assert wire_schema.scan_file(p, "fixture_clean.py") == []
+
+
+def test_wire_schema_native_tables_are_consistent():
+    """wire_native.KIND_IDS ⊆ wire.SCHEMAS with in-range ids and arities
+    — drift here means a frame encodes natively and fails validation on
+    arrival."""
+    from ray_tpu._private.analysis import wire_schema
+
+    assert wire_schema.check_native() == []
+
+
+def test_wire_schema_committed_wire_modules_are_clean():
+    """Every send/recv site in the real wire-speaking modules conforms to
+    wire.SCHEMAS or carries a reviewed allowlist justification."""
+    from ray_tpu._private.analysis import wire_schema
+    from ray_tpu._private.analysis import allowlist as allowlist_mod
+
+    allowed = allowlist_mod.load(
+        os.path.join(REPO, "ray_tpu", "_private", "analysis", "allowlist.txt")
+    )
+    for rel in sorted(wire_schema.WIRE_MODULES):
+        path = os.path.join(REPO, *rel.split("/"))
+        if not os.path.exists(path):
+            continue
+        new = [
+            v.key for v in wire_schema.scan_file(path, rel)
+            if v.key not in allowed
+        ]
+        assert new == [], new
+
+
+# ---------------------------------------------------------------------------
+# pass 11: knob-registry
+
+
+def test_knob_registry_flags_unknown_env_name(tmp_path):
+    """A typo'd knob env name silently no-ops — the exact failure mode
+    the fault-registry pass already kills for fault specs."""
+    from ray_tpu._private.analysis import knob_registry
+
+    p = _write(
+        tmp_path,
+        "uses_env.py",
+        """
+        import os
+
+        def boot():
+            os.environ.get("RAY_TPU_WIRE_BATCH_BYTE")   # seeded typo
+            os.environ.get("RAY_TPU_WIRE_BATCH_BYTES")  # declared: bypass, not unknown
+        """,
+    )
+    keys = sorted(v.key for v in knob_registry.scan_file(p, "uses_env.py"))
+    assert keys == [
+        "knob-registry:bypass:uses_env.py:RAY_TPU_WIRE_BATCH_BYTES",
+        "knob-registry:unknown:uses_env.py:RAY_TPU_WIRE_BATCH_BYTE",
+    ]
+
+
+def test_knob_registry_flags_bypass_read_but_not_wiring(tmp_path):
+    """Reading a KNOB's env form outside config.py skips resolution order
+    and type coercion; reading declared process WIRING (authkey, host)
+    is what wiring is for and stays silent."""
+    from ray_tpu._private.analysis import knob_registry
+
+    p = _write(
+        tmp_path,
+        "reader.py",
+        """
+        import os
+
+        def connect():
+            native = os.environ.get("RAY_TPU_WIRE_NATIVE")  # seeded bypass
+            host = os.environ.get("RAY_TPU_DRIVER_HOST")    # wiring: fine
+            os.environ["RAY_TPU_SESSION"] = "s"             # wiring write: fine
+        """,
+    )
+    keys = [v.key for v in knob_registry.scan_file(p, "reader.py")]
+    assert keys == ["knob-registry:bypass:reader.py:RAY_TPU_WIRE_NATIVE"]
+
+
+def test_knob_registry_flags_config_get_of_undeclared_knob(tmp_path):
+    from ray_tpu._private.analysis import knob_registry
+
+    p = _write(
+        tmp_path,
+        "getter.py",
+        """
+        from ray_tpu._private import config
+
+        def tune():
+            config.get("wire_nativ")   # seeded typo: KeyError at runtime
+            config.get("wire_native")  # declared: fine
+        """,
+    )
+    keys = [v.key for v in knob_registry.scan_file(p, "getter.py")]
+    assert keys == ["knob-registry:get-unknown:getter.py:wire_nativ"]
+
+
+def test_knob_registry_ignores_non_config_receivers(tmp_path):
+    """`config` as a plain function parameter (tune trial dicts) must not
+    be mistaken for the config module — receiver names come from the
+    file's imports, not the identifier."""
+    from ray_tpu._private.analysis import knob_registry
+
+    p = _write(
+        tmp_path,
+        "tuner_like.py",
+        """
+        def train_fn(config):
+            lr = config.get("train_loop_config")
+        """,
+    )
+    assert knob_registry.scan_file(p, "tuner_like.py") == []
+
+
+def test_knob_registry_spec_files_flag_unknown_only(tmp_path):
+    from ray_tpu._private.analysis import knob_registry
+
+    p = _write(
+        tmp_path,
+        "test_spec.py",
+        """
+        def test_knob(monkeypatch):
+            monkeypatch.setenv("RAY_TPU_NO_SUCH_KNOB", "1")   # seeded
+            monkeypatch.setenv("RAY_TPU_WIRE_NATIVE", "0")    # declared: fine
+        """,
+    )
+    keys = [v.key for v in knob_registry.scan_spec_file(p, "test_spec.py")]
+    assert keys == ["knob-registry:unknown:test_spec.py:RAY_TPU_NO_SUCH_KNOB"]
+
+
+def test_knob_registry_catalog_staleness_and_regen(tmp_path):
+    from ray_tpu._private.analysis import knob_registry
+
+    catalog = str(tmp_path / "knob_names.txt")
+    assert knob_registry.check_catalog(catalog)          # missing -> stale
+    knob_registry.write_catalog(catalog)
+    assert knob_registry.check_catalog(catalog) == []    # regenerated -> clean
+    with open(catalog, "a", encoding="utf-8") as f:
+        f.write("RAY_TPU_GHOST_KNOB knob\n")
+    stale = knob_registry.check_catalog(catalog)
+    assert stale and "RAY_TPU_GHOST_KNOB" in stale[0].message
+
+
+def test_committed_knob_catalog_matches_tree():
+    from ray_tpu._private.analysis import knob_registry
+
+    committed = os.path.join(
+        REPO, "ray_tpu", "_private", "analysis", "knob_names.txt"
+    )
+    assert knob_registry.check_catalog(committed) == []
+    lines = knob_registry.load_catalog(committed)
+    kinds = {ln.split()[1] for ln in lines}
+    assert kinds == {"knob", "alias", "wiring"}
+
+
+def test_knob_registry_no_dead_knobs_unallowlisted():
+    """Every knob in config._DEFS is read by a config.get literal
+    somewhere in the package, or carries a reviewed justification."""
+    from ray_tpu._private.analysis import knob_registry
+    from ray_tpu._private.analysis import allowlist as allowlist_mod
+
+    allowed = allowlist_mod.load(
+        os.path.join(REPO, "ray_tpu", "_private", "analysis", "allowlist.txt")
+    )
+    files = iter_py_files(os.path.join(REPO, "ray_tpu"))
+    dead = [
+        v.key for v in knob_registry.check_dead_knobs(files)
+        if v.key not in allowed
+    ]
+    assert dead == [], dead
