@@ -60,10 +60,21 @@ _RESTORE_EXEMPT = frozenset({"import_functions", "restore_pg"})
 #     wait deadline (the deadline itself is head-local, never persisted);
 #   lease — diagnostic only: leases are runtime state that cannot outlive
 #     the workers' resource reservations, a restarted head re-grants from
-#     live traffic (restore ignores them by design).
+#     live traffic (restore ignores them by design);
+#   node_lifecycle — applied by Runtime._restore_snapshot (per-node state
+#     merge onto Runtime.node_lifecycle): DEPARTED is terminal; DRAINING
+#     resumes draining after a head bounce (the daemon's re-registration
+#     re-marks NodeInfo.draining and the reconciler re-arms FRESH drain
+#     windows — wall-clock deadlines are head-local and never persisted);
+#     REQUESTED/STARTING are re-checked against the provider by the
+#     reconciler; ACTIVE is re-confirmed by daemon reconnect or flipped
+#     DEPARTED by the death path;
+#   demand — advisory demand-summary trail (throttled by the autoscaler
+#     reconciler) for post-mortem "why did it scale" analysis; restore
+#     ignores it by design: demand is recomputed from live queues.
 KNOWN_KINDS = frozenset({
     "actor_register", "actor_state", "job_state", "function", "lineage",
-    "lease", "pg_register", "pg_state",
+    "lease", "pg_register", "pg_state", "node_lifecycle", "demand",
 })
 
 

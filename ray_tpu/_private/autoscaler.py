@@ -1,0 +1,427 @@
+"""Elastic capacity: the head-side demand-driven autoscaler.
+
+Analogue of the reference's StandardAutoscaler reconcile loop
+(ray: autoscaler/_private/autoscaler.py:168) against a pluggable
+NodeProvider (ray: autoscaler/node_provider.py:13), rebuilt around this
+repo's journaled control plane:
+
+  * DEMAND comes from Runtime.demand_summary() — queued SchedulingKey
+    buckets with wait-age, pending/RESHAPING placement-group bundles,
+    serve replica targets — and is mirrored into the mutation journal
+    (kind "demand", advisory) whenever it materially changes, so a
+    post-mortem can replay WHY the fleet moved.
+  * The RECONCILER runs on its own thread, OFF the runtime lock: every
+    tick compares demand against the provider-managed fleet, launches
+    within [autoscale_min_nodes, autoscale_max_nodes] after the
+    autoscale_up_wait_s hysteresis, and drains nodes idle past
+    autoscale_idle_s back toward the floor.
+  * Node lifecycle (REQUESTED -> STARTING -> ACTIVE -> DRAINING ->
+    DEPARTED) is journaled by the runtime (kind "node_lifecycle") and
+    replayed across head bounces; per-transition wall clock lands in the
+    autoscale_seconds{stage=...} histogram.  All TIMING here is
+    head-local monotonic state — never journaled — so a restarted head
+    re-arms fresh windows instead of acting on stale clocks.
+  * Scale-DOWN is the loss-proof drain protocol (runtime.py): DRAINING
+    stops new leases, running tasks get drain_timeout_s to finish,
+    sole-copy objects evacuate to the head store over the transfer
+    plane, and only then does the daemon depart.  A node that dies
+    mid-drain falls back to the ordinary death path (lineage/retry).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from ray_tpu._private import faults, ids
+
+__all__ = [
+    "NodeProvider",
+    "LocalProcessProvider",
+    "Autoscaler",
+    "attach_autoscaler",
+]
+
+
+class NodeProvider:
+    """What the reconciler drives (ray: node_provider.py:13).  launch()
+    must be NON-BLOCKING: it starts the node coming up and returns; the
+    node is ACTIVE when its daemon registers with the head, and the
+    reconciler times the gap out via autoscale_launch_timeout_s."""
+
+    def launch(self, node_id: str) -> None:
+        raise NotImplementedError
+
+    def terminate(self, node_id: str) -> None:
+        raise NotImplementedError
+
+    def is_running(self, node_id: str) -> bool:
+        raise NotImplementedError
+
+
+class LocalProcessProvider(NodeProvider):
+    """Spawns/kills real `node_daemon` processes on this machine — the
+    test and single-host provider (the production analogue points the
+    same interface at a cloud instance API).  Spawned procs are shared
+    into Runtime._daemon_procs so head shutdown reaps them."""
+
+    def __init__(
+        self,
+        runtime,
+        num_cpus: float = 1.0,
+        resources: Optional[Dict[str, float]] = None,
+        labels: Optional[Dict[str, str]] = None,
+        store_root: Optional[str] = None,
+    ):
+        self._rt = runtime
+        self.num_cpus = num_cpus
+        self.resources = dict(resources or {})
+        self.labels = dict(labels or {})
+        self.store_root = store_root
+        self._procs: Dict[str, object] = {}
+
+    def launch(self, node_id: str) -> None:
+        import json
+        import subprocess
+        import sys
+
+        env = self._rt._child_env(
+            {
+                "RAY_TPU_NODE_CONFIG": json.dumps(
+                    {
+                        "node_id": node_id,
+                        "session": self._rt.session_name,
+                        "num_cpus": self.num_cpus,
+                        "resources": self.resources,
+                        "labels": self.labels,
+                        "store_root": self.store_root,
+                    }
+                ),
+            }
+        )
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.node_daemon"],
+            env=env,
+            close_fds=True,
+        )
+        self._procs[node_id] = proc
+        self._rt._daemon_procs[node_id] = proc
+
+    def terminate(self, node_id: str) -> None:
+        proc = self._procs.pop(node_id, None)
+        self._rt._daemon_procs.pop(node_id, None)
+        if proc is not None:
+            try:
+                proc.terminate()
+            except OSError:
+                pass
+
+    def is_running(self, node_id: str) -> bool:
+        proc = self._procs.get(node_id)
+        return proc is not None and proc.poll() is None
+
+
+class Autoscaler:
+    """The reconcile loop.  One daemon thread; every mutation step takes
+    the runtime lock briefly and re-checks — the loop itself never
+    blocks under it (subprocess spawns and evacuation pulls are long)."""
+
+    def __init__(self, runtime, provider: Optional[NodeProvider] = None):
+        from ray_tpu._private import config
+
+        self._rt = runtime
+        self.provider = provider or LocalProcessProvider(runtime)
+        self.min_nodes = config.get("autoscale_min_nodes")
+        self.max_nodes = config.get("autoscale_max_nodes")
+        self.interval_s = config.get("autoscale_interval_s")
+        self.up_wait_s = config.get("autoscale_up_wait_s")
+        self.idle_s = config.get("autoscale_idle_s")
+        self.launch_timeout_s = config.get("autoscale_launch_timeout_s")
+        self.drain_timeout_s = config.get("autoscale_drain_timeout_s")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # Head-local monotonic bookkeeping — NEVER journaled (a bounced
+        # head re-arms every window fresh; see node_lifecycle restore).
+        self._requested_at: Dict[str, float] = {}
+        self._idle_since: Dict[str, float] = {}
+        self._drain: Dict[str, dict] = {}
+        self._unmet_since: Optional[float] = None
+        self._last_demand_key = None
+        self._last_demand_t = 0.0
+        self.ticks = 0  # observability for tests/soaks
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="raytpu-autoscaler"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            if self._rt._shutdown:
+                return
+            try:
+                self.reconcile()
+            except Exception:
+                # The control loop must outlive any single bad tick; the
+                # next tick re-reads the world.
+                continue
+
+    # -- one reconcile tick --------------------------------------------
+
+    def reconcile(self) -> None:
+        rt = self._rt
+        now = time.monotonic()
+        self.ticks += 1
+        demand = rt.demand_summary()
+        self._journal_demand(demand, now)
+        with rt.lock:
+            lifecycle = {
+                nid: dict(rec) for nid, rec in rt.node_lifecycle.items()
+            }
+        for nid, rec in lifecycle.items():
+            state = rec.get("state")
+            if state in ("REQUESTED", "STARTING"):
+                self._check_launch(nid, now)
+            elif state == "ACTIVE":
+                t0 = self._requested_at.pop(nid, None)
+                if t0 is not None:
+                    self._observe("launch", now - t0)
+            elif state == "DRAINING":
+                self._advance_drain(nid, now)
+            elif state == "DEPARTED":
+                self._requested_at.pop(nid, None)
+                self._idle_since.pop(nid, None)
+                self._drain.pop(nid, None)
+        managed = {
+            nid: rec
+            for nid, rec in lifecycle.items()
+            if rec.get("src") == "autoscaler"
+            and rec.get("state") != "DEPARTED"
+        }
+        n = len(managed)
+        unmet = bool(
+            demand["queued_tasks"]
+            or demand["pending_bundles"]
+            or any(
+                d.get("target", 0) > d.get("live", 0)
+                for d in demand["serve_targets"].values()
+            )
+        )
+        if n < self.min_nodes:
+            for _ in range(self.min_nodes - n):
+                self._launch_one("floor")
+            self._unmet_since = None
+            return
+        if unmet:
+            if self._unmet_since is None:
+                self._unmet_since = now
+            elif now - self._unmet_since >= self.up_wait_s:
+                if n < self.max_nodes:
+                    self._launch_one("demand")
+                # Re-arm per launch: one node per hysteresis window, so a
+                # slow-to-boot node doesn't trigger a launch stampede.
+                self._unmet_since = now
+            return
+        self._unmet_since = None
+        # Scale down: drain ONE idle node at a time back toward the floor
+        # (serial drains keep the evacuation fan-in bounded).
+        if n <= self.min_nodes or any(
+            rec.get("state") == "DRAINING" for rec in managed.values()
+        ):
+            return
+        for nid, rec in managed.items():
+            if rec.get("state") != "ACTIVE":
+                continue
+            if not self._node_idle(nid):
+                self._idle_since.pop(nid, None)
+                continue
+            since = self._idle_since.setdefault(nid, now)
+            if now - since >= self.idle_s:
+                self._idle_since.pop(nid, None)
+                rt.start_node_drain(nid)
+                break
+
+    # -- launches ------------------------------------------------------
+
+    def _launch_one(self, reason: str) -> None:
+        rt = self._rt
+        nid = ids.node_id()
+        if faults.ENABLED:
+            faults.point("autoscale.launch", key=nid)
+        with rt.lock:
+            rt._set_node_lifecycle(nid, "REQUESTED", src="autoscaler")
+        self._requested_at[nid] = time.monotonic()
+        try:
+            self.provider.launch(nid)
+        except Exception:
+            with rt.lock:
+                rt._set_node_lifecycle(
+                    nid, "DEPARTED", src="autoscaler", reason="launch-failed"
+                )
+            return
+        with rt.lock:
+            rt._set_node_lifecycle(nid, "STARTING", src="autoscaler")
+
+    def _check_launch(self, nid: str, now: float) -> None:
+        """Advance a REQUESTED/STARTING node: declare it failed when its
+        process died pre-registration or the launch window expired (a
+        head bounce re-arms the window — _requested_at is head-local)."""
+        rt = self._rt
+        with rt.lock:
+            node = rt.state.nodes.get(nid)
+            if node is not None and node.alive:
+                # Providers that register in-process nodes (no daemon
+                # hello) reach ACTIVE here; the daemon path flips it at
+                # registration time.
+                rt._set_node_lifecycle(nid, "ACTIVE")
+                return
+        t0 = self._requested_at.setdefault(nid, now)
+        waited = now - t0
+        if waited < 1.0:
+            return  # give the spawn a beat before polling the provider
+        dead = False
+        try:
+            dead = not self.provider.is_running(nid)
+        except Exception:
+            dead = False
+        if dead or waited > self.launch_timeout_s:
+            try:
+                self.provider.terminate(nid)
+            except Exception:
+                pass
+            self._requested_at.pop(nid, None)
+            with rt.lock:
+                rt._set_node_lifecycle(
+                    nid, "DEPARTED",
+                    reason="launch-died" if dead else "launch-timeout",
+                )
+
+    # -- drains --------------------------------------------------------
+
+    def _node_idle(self, nid: str) -> bool:
+        rt = self._rt
+        with rt.lock:
+            node = rt.state.nodes.get(nid)
+            if node is None or not node.alive or node.draining:
+                return False
+            for h in rt.workers.values():
+                if h.node_id != nid or h.state == "dead":
+                    continue
+                if h.current_task is not None or h.state == "actor":
+                    return False
+        return True
+
+    def _advance_drain(self, nid: str, now: float) -> None:
+        """One drain step for a DRAINING node: wait for running tasks
+        (bounded), evacuate sole-copy objects, then depart.  Mid-drain
+        death is detected here and simply abandoned — _on_daemon_death
+        already flipped the lifecycle and lineage covers the bytes."""
+        rt = self._rt
+        st = self._drain.setdefault(nid, {"since": now})
+        with rt.lock:
+            node = rt.state.nodes.get(nid)
+            gone = node is None or not node.alive
+        if gone:
+            # Died (or vanished across a head bounce) mid-drain.  If the
+            # daemon is about to reconnect it will re-enter DRAINING via
+            # registration; give it the launch window, then close the
+            # record so it cannot dangle forever.
+            if now - st["since"] > self.launch_timeout_s:
+                self._drain.pop(nid, None)
+                with rt.lock:
+                    if (
+                        rt.node_lifecycle.get(nid, {}).get("state")
+                        == "DRAINING"
+                    ):
+                        rt._set_node_lifecycle(
+                            nid, "DEPARTED", reason="lost-mid-drain"
+                        )
+            return
+        busy = rt.node_busy_count(nid)
+        if busy and now - st["since"] < self.drain_timeout_s:
+            return  # running tasks get the drain window to finish
+        if "quiesced_at" not in st:
+            st["quiesced_at"] = now
+            self._observe("drain_wait", now - st["since"])
+        # Evacuate sole-copy objects (off-lock pulls into the head store).
+        # Bounded per tick so the loop stays responsive; remaining objects
+        # continue next tick.  The depart below happens ONLY on a clean
+        # ledger or after the forced-depart deadline (2x drain window) —
+        # then lineage/retry covers the loss like a node death.
+        ev = rt.evacuate_node_objects(
+            nid, deadline=time.monotonic() + self.drain_timeout_s
+        )
+        with rt.lock:
+            node = rt.state.nodes.get(nid)
+            if node is None or not node.alive:
+                # Died UNDER the evacuation (its locations were purged,
+                # so remaining==0 lies): the death path owns the record.
+                return
+        forced = now - st["since"] > 2 * self.drain_timeout_s
+        if ev["remaining"] == 0 or forced:
+            self._observe("evacuate", time.monotonic() - st["quiesced_at"])
+            t_depart = time.monotonic()
+            rt.depart_node(nid)
+            self._observe("depart", time.monotonic() - t_depart)
+            self._observe("total", time.monotonic() - st["since"])
+            self._drain.pop(nid, None)
+
+    # -- demand journal / telemetry ------------------------------------
+
+    def _journal_demand(self, demand: dict, now: float) -> None:
+        """Mirror a materially-changed demand summary into the journal
+        (kind "demand", ADVISORY: restore ignores it — live queues are
+        authoritative — it exists so a post-mortem journal read shows
+        the demand the reconciler acted on).  Throttled to 1/s."""
+        key = (
+            demand["queued_tasks"],
+            len(demand["pending_bundles"]),
+            tuple(
+                sorted(
+                    (k, d.get("target", 0), d.get("live", 0))
+                    for k, d in demand["serve_targets"].items()
+                )
+            ),
+        )
+        if key == self._last_demand_key or now - self._last_demand_t < 1.0:
+            return
+        self._last_demand_key = key
+        self._last_demand_t = now
+        self._rt._journal_append(
+            ("demand", {
+                "queued_tasks": demand["queued_tasks"],
+                "max_wait_s": demand["max_wait_s"],
+                "pending_bundles": len(demand["pending_bundles"]),
+                "serve_targets": demand["serve_targets"],
+            })
+        )
+
+    def _observe(self, stage: str, seconds: float) -> None:
+        try:
+            from ray_tpu._private import telemetry
+
+            telemetry.autoscale_histogram().observe(
+                max(seconds, 0.0), tags={"stage": stage}
+            )
+        except Exception:
+            pass
+
+
+def attach_autoscaler(runtime, provider: Optional[NodeProvider] = None):
+    """Build + start an Autoscaler on `runtime` and flip the runtime into
+    park-infeasible mode (the fleet may grow to fit parked tasks — ray's
+    default posture when an autoscaler is present)."""
+    a = Autoscaler(runtime, provider)
+    runtime._autoscaler = a
+    runtime.allow_pending_infeasible = True
+    a.start()
+    return a

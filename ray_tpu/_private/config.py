@@ -452,6 +452,54 @@ _DEFS: Dict[str, tuple] = {
         "smaller contiguous box at N-1 (wait-vs-shrink policy; 0 = shrink "
         "immediately)",
     ),
+    "autoscale_enabled": (
+        0, int,
+        "1 = the head attaches the demand-driven autoscaler "
+        "(_private/autoscaler.py) at boot: a reconcile loop grows the "
+        "node fleet toward unmet demand and drains idle nodes back to "
+        "the floor; infeasible tasks PARK instead of erroring while it "
+        "is on (the fleet may grow to fit them)",
+    ),
+    "autoscale_interval_s": (
+        0.5, float,
+        "autoscaler reconcile period: how often demand is compared "
+        "against the fleet (each tick runs OFF the runtime lock)",
+    ),
+    "autoscale_min_nodes": (
+        0, int,
+        "autoscaler floor: provider-managed worker nodes are never "
+        "drained below this count (the head node is not counted)",
+    ),
+    "autoscale_max_nodes": (
+        4, int,
+        "autoscaler ceiling: at most this many provider-managed worker "
+        "nodes exist at once, however deep the unmet demand",
+    ),
+    "autoscale_up_wait_s": (
+        1.0, float,
+        "launch hysteresis: demand must stay unmet this long before a "
+        "node launch — a burst the current fleet absorbs within the "
+        "window never scales up",
+    ),
+    "autoscale_idle_s": (
+        10.0, float,
+        "drain hysteresis: a provider-managed node must sit fully idle "
+        "(no running tasks, no actors, no held leases) this long before "
+        "the autoscaler starts draining it",
+    ),
+    "autoscale_launch_timeout_s": (
+        30.0, float,
+        "a REQUESTED/STARTING node that has not registered within this "
+        "window is declared failed: its process is terminated and the "
+        "slot retried",
+    ),
+    "autoscale_drain_timeout_s": (
+        30.0, float,
+        "drain patience: how long a DRAINING node may wait for its "
+        "running tasks to finish before the daemon departs anyway (the "
+        "in-flight tasks then re-drive on their retry budget, exactly "
+        "like a node death)",
+    ),
 }
 
 # Back-compat env names from before the knob table existed, plus the

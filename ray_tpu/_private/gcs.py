@@ -37,6 +37,13 @@ class NodeInfo:
     alive: bool = True
     labels: Dict[str, str] = field(default_factory=dict)
     is_head: bool = False
+    # Scale-down drain (ray: DrainNode RPC / NodeDeathInfo EXPECTED_TERMINATION):
+    # a draining node takes no NEW placements — the scheduler filters it from
+    # every candidate set — while existing work finishes and still-referenced
+    # objects evacuate.  Volatile like the rest of the node table; the durable
+    # record is the runtime's journaled node_lifecycle table, which re-marks
+    # the flag when a mid-drain daemon re-registers after a head bounce.
+    draining: bool = False
 
 
 @dataclass
@@ -193,6 +200,16 @@ class GlobalState:
     def alive_nodes(self) -> List[NodeInfo]:
         with self.lock:
             return [n for n in self.nodes.values() if n.alive]
+
+    def set_node_draining(self, node_id: str, draining: bool = True) -> None:
+        """Flip the drain flag on a live node-table row.  NOT journaled:
+        the node table is volatile (rebuilt from daemon re-registration),
+        and the durable drain record is the runtime's node_lifecycle
+        journal kind — restore re-applies this flag from there."""
+        with self.lock:
+            n = self.nodes.get(node_id)
+            if n:
+                n.draining = draining
 
     # -- functions -----------------------------------------------------------
 

@@ -833,6 +833,22 @@ class Runtime:
         self._expected_node_removals: "Set[str]" = set()
         # workers on nodes being removed: their EOFs are routine stops
         self._expected_worker_stops: "Set[str]" = set()
+        # Elastic capacity (autoscaler plane): journaled node lifecycle —
+        # node_id -> {"node_id", "state", ...riders}.  States walk
+        # REQUESTED -> STARTING -> ACTIVE -> DRAINING -> DEPARTED; every
+        # transition goes through _set_node_lifecycle (journal kind
+        # "node_lifecycle") so a restarted head replays them — a node that
+        # died mid-DRAINING resumes draining when its daemon re-registers.
+        # Only persistable fields live in the record; head-local timing
+        # stays in the autoscaler (the PR-11 monotonic-field rule).
+        self.node_lifecycle: Dict[str, dict] = {}
+        # node_id -> daemon OS pid (from the registration hello): lets the
+        # state API name the process a chaos harness must crash-kill to
+        # simulate a node death mid-drain.
+        self.node_daemon_pids: Dict[str, int] = {}
+        # Attached by _private/autoscaler.attach_autoscaler when the
+        # autoscale_enabled knob is on (or a test attaches one directly).
+        self._autoscaler = None
         # Attached driver clients (head-split mode, head.py): did -> conn,
         # plus the pseudo-node each non-co-located driver reads objects as,
         # and per-driver ref borrows dropped on driver death
@@ -998,6 +1014,13 @@ class Runtime:
             ):
                 self._spawn_worker(self.head_node_id, None, None, prestart=True)
 
+        # Elastic capacity: the demand-driven reconcile loop (its own
+        # thread, off the runtime lock) when the knob asks for it.
+        if _config.get("autoscale_enabled"):
+            from ray_tpu._private.autoscaler import attach_autoscaler
+
+            attach_autoscaler(self)
+
     # ------------------------------------------------------------------
     # log pipeline (ray: log_monitor.py + worker print redirection)
 
@@ -1099,6 +1122,31 @@ class Runtime:
                 ),
                 "tasks_finished": float(self.metrics["tasks_finished"]),
                 "tasks_failed": float(self.metrics["tasks_failed"]),
+                # Elastic-capacity demand gauges (O(shapes): bucket heads
+                # are the oldest entries, counts come from deque lengths).
+                "autoscale_demand_tasks": float(len(self.ready_queue)),
+                "autoscale_demand_buckets": float(
+                    len(self.ready_queue.buckets)
+                ),
+                "autoscale_pending_bundles": float(
+                    sum(
+                        len(pg.bundles)
+                        for pg in self.state.placement_groups.values()
+                        if pg.state in ("PENDING", "RESHAPING")
+                    )
+                ),
+                "autoscale_nodes_active": float(
+                    sum(
+                        1 for r in self.node_lifecycle.values()
+                        if r.get("state") == "ACTIVE"
+                    )
+                ),
+                "autoscale_nodes_draining": float(
+                    sum(
+                        1 for r in self.node_lifecycle.values()
+                        if r.get("state") == "DRAINING"
+                    )
+                ),
             }
         internal["object_store_bytes_used"] = float(self.store.shm_usage())
         internal["objects_spilled"] = float(len(self.store._spilled))
@@ -1147,6 +1195,89 @@ class Runtime:
         self.metrics["journal_fsyncs"] = j.fsyncs
         if j.size_bytes() >= self._journal_compact_bytes:
             self._snapshot_kick.set()
+
+    def _set_node_lifecycle(self, node_id: str, state: str, **kw) -> None:
+        """Journaled node-lifecycle transition (REQUESTED -> STARTING ->
+        ACTIVE -> DRAINING -> DEPARTED).  Caller holds self.lock.  The
+        record carries only persistable riders (reason, provider tag);
+        head-local monotonic timing lives with the autoscaler, never in
+        the journal — a replayed DRAINING node re-arms fresh windows."""
+        rec = self.node_lifecycle.setdefault(node_id, {"node_id": node_id})
+        if rec.get("state") == "DEPARTED":
+            # Terminal: a node that died mid-drain must keep its death
+            # record even if the in-flight drain step finishes its (now
+            # empty) evacuation and tries to close the drain as planned.
+            return
+        if rec.get("state") == state and all(
+            rec.get(k) == v for k, v in kw.items()
+        ):
+            return  # no-op re-assertion: don't re-journal it
+        rec["state"] = state
+        rec.update(kw)
+        self._journal_append(("node_lifecycle", node_id, state, dict(kw)))
+        self.events.emit(
+            "INFO", "autoscale", "node lifecycle", node_id=node_id,
+            state=state, **kw,
+        )
+
+    def demand_summary(self) -> dict:
+        """The head's published resource-demand view — what the autoscaler
+        reconciles against and `ray_tpu status` renders: unplaceable/queued
+        SchedulingKey buckets with wait-age, pending + RESHAPING placement
+        -group bundles, and serve deployments' replica targets (published
+        into the KV plane by the serve controller's reconcile loop)."""
+        now_wall = time.time()
+        with self.lock:
+            buckets = []
+            for shape, q in self.ready_queue.buckets.items():
+                # Buckets are FIFO: the head task is the oldest, so the
+                # scan stays O(shapes), never O(queued tasks).
+                head = None
+                for tid in q:
+                    rec = self.tasks.get(tid)
+                    if rec is not None and not rec.cancelled:
+                        head = rec
+                        break
+                if head is None:
+                    continue
+                t = head.stages.get("queued") or head.stages.get("submit")
+                buckets.append(
+                    {
+                        "key": repr(shape),
+                        "resources": dict(head.spec.resources),
+                        "count": len(q),
+                        "wait_s": round(max(now_wall - t, 0.0), 3)
+                        if t is not None
+                        else 0.0,
+                    }
+                )
+            spilled = self._ready_spill.count if self._ready_spill else 0
+            pending_bundles = []
+            for pg in self.state.placement_groups.values():
+                if pg.state in ("PENDING", "RESHAPING"):
+                    pending_bundles.append(
+                        {
+                            "pg_id": pg.pg_id,
+                            "state": pg.state,
+                            "bundles": [dict(b) for b in pg.bundles],
+                        }
+                    )
+        import json as _json
+
+        serve_targets = {}
+        raw = self.state.kv_get("replica_targets", "serve")
+        if raw:
+            try:
+                serve_targets = _json.loads(raw.decode())
+            except (ValueError, UnicodeDecodeError):
+                serve_targets = {}
+        return {
+            "task_buckets": buckets,
+            "queued_tasks": sum(b["count"] for b in buckets) + spilled,
+            "max_wait_s": max((b["wait_s"] for b in buckets), default=0.0),
+            "pending_bundles": pending_bundles,
+            "serve_targets": serve_targets,
+        }
 
     def _write_snapshot(self) -> None:
         from ray_tpu._private.gcs import actor_record
@@ -1203,6 +1334,11 @@ class Runtime:
                 "object_sizes": dict(self.object_sizes),
                 "inflight_tasks": inflight,
                 "jobs": {jid: dict(rec) for jid, rec in self.state.jobs.items()},
+                # Autoscaler node-lifecycle table (journal kind
+                # "node_lifecycle" folds in on top at restore).
+                "node_lifecycle": {
+                    nid: dict(rec) for nid, rec in self.node_lifecycle.items()
+                },
                 # Completed inline results' producer specs (bounded: a
                 # subset of the lineage table, which lineage_max_bytes /
                 # lineage_max_entries already cap) — these bytes live only
@@ -1265,6 +1401,10 @@ class Runtime:
         jobs: Dict[str, dict] = {
             jid: dict(rec) for jid, rec in snap.get("jobs", {}).items()
         }
+        node_lc: Dict[str, dict] = {
+            nid: dict(rec)
+            for nid, rec in snap.get("node_lifecycle", {}).items()
+        }
         restored_lineage = list(snap.get("lineage", []))
         for entry in journal_entries:
             try:
@@ -1293,6 +1433,11 @@ class Runtime:
                     if rec is not None:
                         rec["state"] = pstate
                         rec.update(kw)
+                elif kind == "node_lifecycle":
+                    _, nid, nstate, kw = entry
+                    rec = node_lc.setdefault(nid, {"node_id": nid})
+                    rec["state"] = nstate
+                    rec.update(kw)
                 elif kind == "lineage":
                     restored_lineage.append((entry[1], entry[2]))
                 elif kind == "function":
@@ -1306,6 +1451,19 @@ class Runtime:
         for jid, rec in jobs.items():
             kw = {k: v for k, v in rec.items() if k not in ("job_id", "state")}
             self.state.set_job_state(jid, rec.get("state", "RUNNING"), **kw)
+        # Node-lifecycle restore decisions (the journal-coverage lint's
+        # KNOWN_KINDS entry documents these):
+        #   DEPARTED  — stays departed (terminal; a retried drain across the
+        #               bounce answers instead of re-draining a ghost);
+        #   DRAINING  — resumes draining: the daemon's re-registration
+        #               re-marks NodeInfo.draining and the reconciler picks
+        #               the drain back up with FRESH timing windows (the
+        #               PR-11 rule: never skip ahead on stale wall-clock);
+        #   REQUESTED/STARTING — kept as-is; the reconciler re-checks them
+        #               against the provider and re-arms the launch timeout;
+        #   ACTIVE    — re-confirmed by the daemon's reconnect (the death
+        #               path flips it to DEPARTED if it never comes back).
+        self.node_lifecycle.update(node_lc)
         for pid, rec in pgs_by_id.items():
             if pid in self.state.placement_groups:
                 continue
@@ -1930,11 +2088,23 @@ class Runtime:
         self.node_daemons.pop(node_id, None)
         self.node_object_endpoints.pop(node_id, None)
         self._daemon_heartbeats.pop(node_id, None)
+        self.node_daemon_pids.pop(node_id, None)
         if node_id in self._expected_node_removals:
             self._expected_node_removals.discard(node_id)
             self.events.emit("INFO", "node", "node removed", node_id=node_id)
+            planned = True
         else:
             self.events.emit("ERROR", "node", "node died", node_id=node_id)
+            planned = False
+        # Lifecycle: any tracked node leaving — planned depart OR death
+        # (including a death MID-DRAIN, which from here on is exactly the
+        # existing death path: lineage/retry covers what evacuation had
+        # not yet moved) — lands in the terminal DEPARTED state.
+        if node_id in self.node_lifecycle:
+            self._set_node_lifecycle(
+                node_id, "DEPARTED",
+                reason="removed" if planned else "died",
+            )
         # Copies on the dead node are gone; objects whose ONLY copy lived
         # there become lost-bytes (gets fall through to lineage
         # reconstruction, exactly like a lost spill file).
@@ -2789,7 +2959,20 @@ class Runtime:
                     self.node_object_endpoints[node_id] = tuple(ep)
                 self.node_daemons[node_id] = reg
                 self._conn_to_daemon[reg] = node_id
+                self.node_daemon_pids[node_id] = int(_pid)
                 self._conns_version += 1
+                # Lifecycle: a provider-launched node registering flips
+                # REQUESTED/STARTING -> ACTIVE; a node that was DRAINING
+                # when the head bounced RESUMES draining — the volatile
+                # NodeInfo.draining flag is re-derived from the journaled
+                # lifecycle record, so no new leases land on it and the
+                # reconciler picks the drain back up.
+                lc = self.node_lifecycle.get(node_id)
+                if lc is not None:
+                    if lc.get("state") in ("REQUESTED", "STARTING"):
+                        self._set_node_lifecycle(node_id, "ACTIVE")
+                    elif lc.get("state") == "DRAINING":
+                        self.state.set_node_draining(node_id, True)
                 self.events.emit("INFO", "node", "node registered", node_id=node_id)
                 # Fresh liveness clock: a stale entry from a previous
                 # incarnation of this node_id would instantly time the
@@ -4001,6 +4184,23 @@ class Runtime:
         if op == "telemetry":
             # Attached-driver surface for `ray_tpu metrics` / `status`.
             return self.telemetry.summary()
+        if op == "demand_summary":
+            # Elastic-capacity demand view (`ray_tpu status` / the
+            # autoscaler's attached-mode consumers).
+            return self.demand_summary()
+        if op == "node_lifecycle":
+            # Journaled node-lifecycle records (tests/soaks verify replay
+            # across head bounces through this).
+            with self.lock:
+                return {
+                    nid: dict(rec)
+                    for nid, rec in self.node_lifecycle.items()
+                }
+        if op == "node_drain":
+            # Attached-mode drain trigger (the soak's scale-down lever;
+            # ray: DrainNode RPC).  The embedded reconciler advances the
+            # drain through evacuation + depart.
+            return self.start_node_drain(payload)
         if op == "telemetry_series":
             return self.telemetry.series_snapshot(payload)
         if op == "memory_summary":
@@ -4930,6 +5130,14 @@ class Runtime:
         for le in list(leases):
             if le.idle_since is None:
                 continue
+            node = self.state.nodes.get(le.node_id)
+            if node is not None and node.draining:
+                # A late same-key task must NOT ride an idle lease onto a
+                # draining node — revoke the binding (resources released,
+                # worker returned for the depart to reap) so the task
+                # re-drives through full placement elsewhere.
+                self._revoke_lease_locked(le, cause="drain")
+                continue
             h = self.workers.get(le.worker_id)
             if h is None or h.state != "busy" or h.current_task is not None:
                 # Defensive: the crash path revokes synchronously, so a
@@ -4999,6 +5207,15 @@ class Runtime:
         le.idle_since = time.monotonic()
         if h is not None:
             h.current_task = None
+        node = self.state.nodes.get(le.node_id)
+        if node is not None and node.draining:
+            # Drain-revoke instead of re-arm: chaining the next same-key
+            # task here would keep re-busying capacity that is leaving.
+            # The queued siblings re-drive through full placement onto
+            # surviving nodes on the dispatch below.
+            self._revoke_lease_locked(le, cause="drain")
+            self._dispatch()
+            return
         q = self.ready_queue.buckets.get(le.key)
         while q:
             tid = q[0]
@@ -6261,6 +6478,10 @@ class Runtime:
                 # expectation entry.
                 self._expected_node_removals.discard(node_id)
                 self.events.emit("INFO", "node", "node removed", node_id=node_id)
+                if node_id in self.node_lifecycle:
+                    self._set_node_lifecycle(
+                        node_id, "DEPARTED", reason="removed"
+                    )
             self._daemon_send(node_id, ("shutdown",))
             self.node_daemons.pop(node_id, None)
             # Planned or not, a MESH gang member leaving tears the gang.
@@ -6273,6 +6494,121 @@ class Runtime:
         # crash handling happens via conn EOF in the io loop
 
     # ------------------------------------------------------------------
+    # elastic capacity: the loss-proof drain protocol.  DRAINING stops new
+    # leases landing (scheduler filters + lease drain-revokes), the
+    # reconciler waits for running tasks, sole-copy objects evacuate over
+    # the PR-10 transfer plane, and only then does the daemon depart.  A
+    # node that dies MID-DRAIN falls into _on_daemon_death unchanged —
+    # lineage/retry covers whatever evacuation had not yet moved.
+
+    def start_node_drain(self, node_id: str) -> bool:
+        """Enter DRAINING: journaled lifecycle flip + the volatile
+        NodeInfo.draining mark, idle leases on the node drain-revoked,
+        parked same-key tasks re-driven elsewhere.  Idempotent."""
+        if faults.ENABLED:
+            faults.point("node.drain", key=node_id)
+        with self.lock:
+            node = self.state.nodes.get(node_id)
+            if (
+                node is None
+                or not node.alive
+                or node.is_head
+                or node_id == self.head_node_id
+            ):
+                return False
+            if not node.draining:
+                self.state.set_node_draining(node_id, True)
+                self._set_node_lifecycle(node_id, "DRAINING")
+                for pool in list(self.task_leases.values()):
+                    for le in list(pool):
+                        if (
+                            le.node_id == node_id
+                            and le.idle_since is not None
+                        ):
+                            self._revoke_lease_locked(le, cause="drain")
+                self._dispatch()
+        return True
+
+    def node_busy_count(self, node_id: str) -> int:
+        """Workers on node_id still holding work: running/pushed tasks
+        plus resident actors.  0 = quiesced (safe to evacuate+depart)."""
+        with self.lock:
+            busy = 0
+            for h in self.workers.values():
+                if h.node_id != node_id or h.state == "dead":
+                    continue
+                if h.current_task is not None or h.state == "actor":
+                    busy += 1
+            return busy
+
+    def sole_copy_objects(self, node_id: str) -> List[str]:
+        """Objects whose ONLY sealed copy lives on node_id (no head-store
+        copy, no other node in the directory) — the bytes a depart would
+        lose without evacuation."""
+        with self.lock:
+            return [
+                oid
+                for oid, locs in self.object_locations.items()
+                if locs == {node_id} and not self.store.has_local(oid)
+            ]
+
+    def evacuate_node_objects(
+        self, node_id: str, deadline: Optional[float] = None
+    ) -> dict:
+        """Pull every sole-copy object off node_id into the head store
+        over the transfer plane (the head is a surviving node; its store
+        re-serves the bytes to any later consumer).  Runs OFF the runtime
+        lock — each pull is a network transfer.  Returns the evacuation
+        ledger; `remaining` > 0 means bytes were NOT saved (deadline hit
+        or the node died under us) and the caller decides whether to
+        depart anyway (lineage then covers the loss)."""
+        moved = failed = 0
+        moved_bytes = 0
+        for oid in self.sole_copy_objects(node_id):
+            if deadline is not None and time.monotonic() > deadline:
+                break
+            if faults.ENABLED:
+                faults.point("node.evacuate", key=oid)
+            ok = False
+            try:
+                ok = self._fetch_remote(oid)
+            except Exception:
+                ok = False
+            if ok and self.store.has_local(oid):
+                moved += 1
+                moved_bytes += self.object_sizes.get(oid, 0)
+            else:
+                failed += 1
+        remaining = len(self.sole_copy_objects(node_id))
+        if moved or failed or remaining:
+            self.events.emit(
+                "INFO" if remaining == 0 else "WARNING",
+                "autoscale", "node evacuation",
+                node_id=node_id, moved=moved, moved_bytes=moved_bytes,
+                failed=failed, remaining=remaining,
+            )
+        return {
+            "moved": moved,
+            "moved_bytes": moved_bytes,
+            "failed": failed,
+            "remaining": remaining,
+        }
+
+    def depart_node(self, node_id: str) -> None:
+        """Final drain step: planned removal (remove_node) + the terminal
+        DEPARTED lifecycle record.  Workers still running tasks here die
+        as EXPECTED stops — their in-flight tasks re-drive on their retry
+        budget, same as any worker death."""
+        if faults.ENABLED:
+            faults.point("node.depart", key=node_id)
+        self.remove_node(node_id)
+        with self.lock:
+            if node_id in self.node_lifecycle:
+                self._set_node_lifecycle(
+                    node_id, "DEPARTED", reason="removed"
+                )
+
+    # ------------------------------------------------------------------
 
     def shutdown(self) -> None:
         if self._shutdown:
@@ -6280,6 +6616,11 @@ class Runtime:
         self._shutdown = True
         atexit.unregister(self.shutdown)
         set_ref_hooks(None, None)
+        if self._autoscaler is not None:
+            try:
+                self._autoscaler.stop()
+            except Exception:
+                pass
         if getattr(self, "_snapshot_storage", None) is not None:
             self._snapshot_storage.close()
         if getattr(self, "_journal", None) is not None:

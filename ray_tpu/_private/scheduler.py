@@ -109,6 +109,14 @@ class Scheduler:
                     if strategy.soft:
                         return self._hybrid(resources, deps=spec.deps)
                     raise ValueError(f"affinity node {strategy.node_id} is dead")
+                if node.draining:
+                    # Drain-in-progress: no new placements land here.  Soft
+                    # affinity re-drives elsewhere; hard affinity waits (the
+                    # node either finishes draining and dies — then the dead
+                    # branch above errors — or the drain is cancelled).
+                    if strategy.soft:
+                        return self._hybrid(resources, deps=spec.deps)
+                    return None
                 if _available(node, resources):
                     return node.node_id
                 if strategy.soft:
@@ -120,7 +128,16 @@ class Scheduler:
         return self._hybrid(resources, deps=spec.deps)
 
     def _alive_feasible(self, resources) -> List[NodeInfo]:
-        nodes = [n for n in self.state.alive_nodes() if _feasible(n, resources)]
+        # Draining nodes are excluded from every candidate set: a scale-down
+        # drain must converge, and new placements would re-busy it forever.
+        # When the ONLY feasible nodes are draining the task is infeasible
+        # for now — it parks under allow_pending (the autoscaler's demand
+        # summary then shows it, prompting a scale-up) instead of landing on
+        # capacity that is leaving.
+        nodes = [
+            n for n in self.state.alive_nodes()
+            if not n.draining and _feasible(n, resources)
+        ]
         if not nodes:
             raise ValueError(
                 f"no node is feasible for resources {resources}; cluster has "
@@ -265,7 +282,9 @@ class Scheduler:
             return True
 
     def _plan_bundles(self, pg: PlacementGroupInfo) -> Optional[Dict[int, str]]:
-        nodes = self.state.alive_nodes()
+        # Same drain exclusion as _alive_feasible: a gang reserved onto a
+        # departing host would be torn down moments later.
+        nodes = [n for n in self.state.alive_nodes() if not n.draining]
         strategy = pg.strategy
         bundles = pg.bundles
 

@@ -580,6 +580,33 @@ def remesh_histogram():
     return _REMESH_HIST
 
 
+_AUTOSCALE_HIST = None
+
+
+def autoscale_histogram():
+    """`autoscale_seconds{stage=...}` — elastic-capacity transition wall
+    clock attributed per node-lifecycle edge (launch = REQUESTED→ACTIVE,
+    drain_wait = DRAINING→quiesced, evacuate = quiesced→objects-safe,
+    depart = DRAINING→DEPARTED, plus total for a full drain).  The
+    head-side reconciler observes one sample per transition per node;
+    the autoscale chaos soak asserts the breakdown lands.  Lazy like
+    remesh_histogram — only a head that actually autoscales registers
+    it.  Seconds-scale boundaries: launches are dominated by daemon
+    boot, drains by task completion and evacuation."""
+    global _AUTOSCALE_HIST
+    if _AUTOSCALE_HIST is None:
+        from ray_tpu.util.metrics import Histogram
+
+        _AUTOSCALE_HIST = Histogram(
+            "autoscale_seconds",
+            "elastic-capacity node transition time per stage "
+            "(launch/drain_wait/evacuate/depart/total)",
+            boundaries=[0.05, 0.2, 0.5, 1.0, 2.0, 5.0, 15.0, 60.0, 300.0],
+            tag_keys=("stage",),
+        )
+    return _AUTOSCALE_HIST
+
+
 def summarize_task_events(
     events: List[Dict[str, Any]],
     live: Optional[List[Dict[str, Any]]] = None,

@@ -60,11 +60,29 @@ def cmd_status(args) -> int:
     from ray_tpu.util import state as state_api
 
     wr = _init_maybe_attached(args)
+    # Per-node elastic-capacity rows: lifecycle state (ACTIVE/DRAINING/...),
+    # lease count and remote-store bytes — the drain protocol's progress is
+    # readable straight off `ray_tpu status` (attached or head-local; both
+    # ride the state_list "nodes" verb).
+    nodes = state_api.list_nodes()
+    node_rows = [
+        {
+            "node_id": n["node_id"],
+            "state": n.get("state"),
+            "is_head": n["is_head"],
+            "leases": n.get("lease_count", 0),
+            "store_bytes": n.get("store_bytes", 0),
+            "available": n.get("available", {}),
+        }
+        for n in nodes
+    ]
     if wr is not None:
         tele = wr.request("telemetry", None)
         out = {
+            "nodes": node_rows,
             "resources": ray_tpu.cluster_resources(),
             "available": ray_tpu.available_resources(),
+            "demand": state_api.demand_summary(),
             "telemetry_processes": tele.get("processes", {}),
             "telemetry": tele.get("internal", {}),
             "io_shards": _io_shard_rows(tele.get("processes")),
@@ -72,9 +90,11 @@ def cmd_status(args) -> int:
     else:
         tele = state_api.telemetry_summary()
         out = {
-            "nodes": state_api.list_nodes(),
+            "nodes": nodes,
+            "node_states": node_rows,
             "resources": ray_tpu.cluster_resources(),
             "available": ray_tpu.available_resources(),
+            "demand": state_api.demand_summary(),
             "metrics": state_api.cluster_metrics(),
             "telemetry_processes": tele.get("processes", {}),
             "io_shards": _io_shard_rows(tele.get("processes")),

@@ -257,6 +257,35 @@ class ServeController:
         if changed:
             with self._lock:
                 self._bump_version_locked()
+        self._publish_replica_targets()
+
+    def _publish_replica_targets(self) -> None:
+        """Publish {deployment: {target, live}} to GCS kv so the head's
+        demand summary (and the elastic autoscaler behind it) can see serve
+        capacity pressure without holding an actor handle to this
+        controller.  Best-effort: the kv row is advisory demand telemetry,
+        a missed publish just means the autoscaler acts one reconcile
+        period later."""
+        import json
+
+        with self._lock:
+            targets = {
+                name: {
+                    "target": st.target_replicas(),
+                    "live": len(st.replicas),
+                }
+                for name, st in self._deployments.items()
+            }
+        try:
+            from ray_tpu._private.client import client
+
+            client.kv_put(
+                "replica_targets",
+                json.dumps(targets, sort_keys=True).encode(),
+                namespace="serve",
+            )
+        except Exception:
+            pass
 
     def _check_health(self, st: _DeploymentState) -> bool:
         """Pull-based health check (ray: gcs_health_check_manager.h:39 at the

@@ -133,19 +133,55 @@ def list_nodes() -> List[Dict[str, Any]]:
     if routed:
         return res
     rt = _rt()
-    with rt.state.lock:
-        return [
-            {
-                "node_id": n.node_id,
-                "alive": n.alive,
-                "is_head": n.is_head,
-                "resources": dict(n.resources),
-                "available": dict(n.available),
-                "labels": dict(n.labels),
-                "has_daemon": n.node_id in rt.node_daemons,
-            }
-            for n in rt.state.nodes.values()
-        ]
+    with rt.lock, rt.state.lock:
+        lease_counts: Dict[str, int] = {}
+        for leases in rt.task_leases.values():
+            for le in leases:
+                lease_counts[le.node_id] = lease_counts.get(le.node_id, 0) + 1
+        store_bytes: Dict[str, int] = {}
+        for oid, locs in rt.object_locations.items():
+            sz = rt.object_sizes.get(oid, 0)
+            for nid in locs:
+                store_bytes[nid] = store_bytes.get(nid, 0) + sz
+        out = []
+        for n in rt.state.nodes.values():
+            lc = rt.node_lifecycle.get(n.node_id)
+            # Lifecycle is only journaled for autoscaler-managed / drained
+            # nodes; statically-launched nodes read as plain ACTIVE.
+            state = (lc or {}).get("state") or ("ACTIVE" if n.alive else "DEAD")
+            if n.alive and n.draining:
+                state = "DRAINING"
+            out.append(
+                {
+                    "node_id": n.node_id,
+                    "alive": n.alive,
+                    "is_head": n.is_head,
+                    "state": state,
+                    "resources": dict(n.resources),
+                    "available": dict(n.available),
+                    "labels": dict(n.labels),
+                    "has_daemon": n.node_id in rt.node_daemons,
+                    "daemon_pid": rt.node_daemon_pids.get(n.node_id),
+                    "lease_count": lease_counts.get(n.node_id, 0),
+                    "store_bytes": store_bytes.get(
+                        n.node_id, rt.store.shm_usage() if n.is_head else 0
+                    ),
+                }
+            )
+        return out
+
+
+def demand_summary() -> Dict[str, Any]:
+    """The head's resource-demand summary (what the elastic autoscaler
+    reconciles against): unplaceable SchedulingKey buckets with wait ages,
+    pending/RESHAPING placement-group bundles, and serve replica targets
+    published by the serve controller."""
+    from ray_tpu._private.worker_proc import get_worker_runtime
+
+    wr = get_worker_runtime()
+    if wr is not None:
+        return wr.request("demand_summary", None)
+    return _rt().demand_summary()
 
 
 def list_workers() -> List[Dict[str, Any]]:

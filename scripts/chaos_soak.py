@@ -65,6 +65,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import subprocess
 import sys
 import tempfile
@@ -168,6 +169,15 @@ def _append(path: str, line: str) -> None:
 def produce(i, r, log_path):
     _append(log_path, f"produce:{r}:{i}")
     return np.full((ARR,), i, dtype=np.int64)
+
+
+@ray_tpu.remote(max_retries=TASK_RETRIES)
+def wave_work(i, delay, log_path):
+    """Demand wave for the autoscale scenario: 1-CPU sleepers sized so the
+    queue outlives the up-wait hysteresis and the fleet provably grows."""
+    _append(log_path, f"wave:{i}")
+    time.sleep(delay)
+    return i
 
 
 @ray_tpu.remote(max_retries=TASK_RETRIES)
@@ -1371,6 +1381,482 @@ def run_trainer_soak(
                 f.write("\n")
 
 
+def run_autoscale_soak(
+    seed: int = 12,
+    out: Optional[str] = None,
+    watch_locks: bool = True,
+) -> Dict:
+    """The elastic-capacity scenario (report: CHAOS_r12.json).
+
+    Timeline: the head boots with the demand-driven autoscaler ON
+    (min=1/max=4, LocalProcessProvider) -> serve replicas + a 1-CPU task
+    wave push demand and the fleet grows to max -> sole-copy shm objects
+    are pinned onto two autoscaled nodes -> node A is drained and its
+    daemon SIGKILLed MID-EVACUATION (the spec delays every evacuation
+    pull, widening the window) -> the death path + lineage re-derive A's
+    results -> node B is drained and the HEAD is SIGKILLed mid-drain ->
+    the relaunched head replays every journaled lifecycle transition,
+    the resumed reconciler finishes B's evacuation with a clean ledger
+    (zero lost bytes: B's producers run exactly once) -> the idle fleet
+    drains itself back to the floor.  PASS requires zero lost results,
+    zero lost sole-copy bytes, a converged object ledger, and a silent
+    lock watchdog."""
+    from ray_tpu._private import faults, lock_watchdog
+    from ray_tpu._private.head import launch_head_subprocess
+    from ray_tpu.util import state as state_api
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    # The only spec clause: stretch each evacuation pull so the daemon
+    # SIGKILL and the head SIGKILL both land INSIDE the evacuation loop.
+    spec = "node.evacuate:delay=0.3"
+    faults.configure(spec, seed)
+    faults.disable()  # driver stays clean; the head enables from env
+
+    workdir = tempfile.mkdtemp(prefix=f"chaos-autoscale-{seed}-")
+    log_path = os.path.join(workdir, "executions.log")
+    session = f"elastic{seed}x{os.getpid():x}"
+    saved_env = {
+        k: os.environ.get(k)
+        for k in (
+            "RAY_TPU_FAULT_SPEC",
+            "RAY_TPU_FAULT_SEED",
+            "RAY_TPU_RECONNECT_WINDOW_S",
+            "RAY_TPU_TRACE",
+            "RAY_TPU_FLIGHT_DIR",
+            "RAY_TPU_LOCK_WATCHDOG",
+            "RAY_TPU_LOCK_WATCHDOG_DIR",
+            "RAY_TPU_LOCK_HOLD_S",
+            "RAY_TPU_METRICS_PUSH_MS",
+            "RAY_TPU_AUTOSCALE_ENABLED",
+            "RAY_TPU_AUTOSCALE_INTERVAL_S",
+            "RAY_TPU_AUTOSCALE_MIN_NODES",
+            "RAY_TPU_AUTOSCALE_MAX_NODES",
+            "RAY_TPU_AUTOSCALE_UP_WAIT_S",
+            "RAY_TPU_AUTOSCALE_IDLE_S",
+            "RAY_TPU_AUTOSCALE_LAUNCH_TIMEOUT_S",
+            "RAY_TPU_AUTOSCALE_DRAIN_TIMEOUT_S",
+        )
+    }
+    os.environ["RAY_TPU_FAULT_SPEC"] = spec
+    os.environ["RAY_TPU_FAULT_SEED"] = str(seed)
+    os.environ["RAY_TPU_RECONNECT_WINDOW_S"] = "45"
+    # Elastic knobs: every head incarnation (launch_head_subprocess copies
+    # os.environ) runs the embedded reconciler with the same aggressive
+    # cadence, so the post-bounce head resumes B's drain on its own.
+    os.environ["RAY_TPU_AUTOSCALE_ENABLED"] = "1"
+    os.environ["RAY_TPU_AUTOSCALE_INTERVAL_S"] = "0.25"
+    os.environ["RAY_TPU_AUTOSCALE_MIN_NODES"] = "1"
+    os.environ["RAY_TPU_AUTOSCALE_MAX_NODES"] = "4"
+    os.environ["RAY_TPU_AUTOSCALE_UP_WAIT_S"] = "0.5"
+    # Long enough that autonomous idle-drain never races the scripted
+    # chaos on A/B, short enough that wind-down fits the soak budget.
+    os.environ["RAY_TPU_AUTOSCALE_IDLE_S"] = "15"
+    os.environ["RAY_TPU_AUTOSCALE_LAUNCH_TIMEOUT_S"] = "20"
+    os.environ["RAY_TPU_AUTOSCALE_DRAIN_TIMEOUT_S"] = "6"
+    flight_dir = os.path.join(workdir, "flight")
+    os.makedirs(flight_dir, exist_ok=True)
+    os.environ["RAY_TPU_TRACE"] = "1"
+    os.environ["RAY_TPU_FLIGHT_DIR"] = flight_dir
+    os.environ.setdefault("RAY_TPU_METRICS_PUSH_MS", "1000")
+    watchdog_dir = os.path.join(workdir, "watchdog")
+    if watch_locks:
+        os.makedirs(watchdog_dir, exist_ok=True)
+        os.environ["RAY_TPU_LOCK_WATCHDOG"] = "1"
+        os.environ["RAY_TPU_LOCK_WATCHDOG_DIR"] = watchdog_dir
+        os.environ.setdefault("RAY_TPU_LOCK_HOLD_S", "2.0")
+        lock_watchdog._enable_for_tests(True)
+
+    report: Dict = {
+        "seed": seed,
+        "scenario": "elastic-autoscale",
+        "spec": spec,
+        "kills": {"head": 0, "daemon": 0},
+        "lock_watchdog": {"enabled": watch_locks, "reports": []},
+        "result": "FAIL",
+    }
+    RANK = {
+        "REQUESTED": 0, "STARTING": 1, "ACTIVE": 2,
+        "DRAINING": 3, "DEPARTED": 4,
+    }
+    PINS = 4
+    head = None
+    daemon_pids: Dict[str, int] = {}
+    import ray_tpu
+
+    try:
+        head, head_json = launch_head_subprocess(
+            workdir, num_cpus=2, session=session
+        )
+        ray_tpu.init(address=head_json)
+        t0 = time.monotonic()
+
+        def note(msg):
+            print(f"[elastic t={time.monotonic() - t0:6.1f}s] {msg}",
+                  flush=True)
+
+        def _req(op, payload=None):
+            from ray_tpu._private.worker_proc import get_worker_runtime
+
+            return get_worker_runtime().request(op, payload)
+
+        def lifecycle() -> Dict[str, Dict]:
+            try:
+                return _req("node_lifecycle")
+            except Exception:
+                return {}  # head mid-bounce: answer again next poll
+
+        def managed(*states) -> Dict[str, Dict]:
+            return {
+                nid: rec
+                for nid, rec in lifecycle().items()
+                if rec.get("src") == "autoscaler"
+                and (not states or rec.get("state") in states)
+            }
+
+        def wait_for(cond, what, deadline_s):
+            deadline = time.monotonic() + deadline_s
+            while time.monotonic() < deadline:
+                try:
+                    # Conditions poll THROUGH head bounces: a dropped
+                    # request is "not yet", never a verdict.
+                    if cond():
+                        return time.monotonic() - t0
+                except Exception:
+                    pass
+                time.sleep(0.25)
+            raise AssertionError(
+                f"timed out after {deadline_s}s waiting for {what}"
+            )
+
+        def _counts(prefix: str) -> Dict[str, int]:
+            c: Dict[str, int] = {}
+            try:
+                with open(log_path) as f:
+                    for line in f:
+                        line = line.strip()
+                        if line.startswith(prefix + ":"):
+                            c[line] = c.get(line, 0) + 1
+            except FileNotFoundError:
+                pass
+            return c
+
+        def _note_pids():
+            for row in state_api.list_nodes():
+                if row.get("daemon_pid"):
+                    daemon_pids[row["node_id"]] = row["daemon_pid"]
+
+        # ---- phase 1: the floor launch (min_nodes=1, zero demand).
+        t_floor = wait_for(
+            lambda: len(managed("ACTIVE")) >= 1, "the floor node", 30
+        )
+        note("floor node ACTIVE")
+
+        # ---- phase 2: demand wave.  Serve replica targets land in the
+        # demand summary; a 1-CPU task wave outlives the up-wait window
+        # and the reconciler grows the fleet to max.
+        from ray_tpu import serve as serve_mod
+
+        serve_mod.start(http_options={"host": "127.0.0.1", "port": 0})
+
+        @serve_mod.deployment(
+            name="elastic",
+            num_replicas=2,
+            ray_actor_options={"max_restarts": 100},
+        )
+        def elastic_dep(body=None):
+            return {"ok": True}
+
+        serve_mod.run(elastic_dep.bind())
+        wait_for(
+            lambda: "elastic" in state_api.demand_summary()["serve_targets"],
+            "serve replica targets in the demand summary", 30,
+        )
+        note("serve targets visible in demand summary")
+
+        wave_refs = [wave_work.remote(i, 1.5, log_path) for i in range(24)]
+        t_max = wait_for(
+            lambda: len(managed("ACTIVE")) >= 4,
+            "the fleet to reach max_nodes=4", 90,
+        )
+        note(f"fleet at max ({t_max - t_floor:.1f}s after the floor)")
+        _note_pids()
+        wave_out = ray_tpu.get(wave_refs, timeout=240)
+        assert sorted(wave_out) == list(range(24)), (
+            f"lost wave results: {sorted(wave_out)}"
+        )
+        del wave_refs, wave_out
+        serve_mod.shutdown()  # replicas off the fleet before the chaos
+
+        # ---- phase 3: pin sole-copy shm objects onto two autoscaled
+        # nodes (soft affinity; ARR int64 payloads are store-sealed).
+        fleet = sorted(managed("ACTIVE"))
+        assert len(fleet) >= 3, f"fleet shrank early: {fleet}"
+        node_a, node_b = fleet[0], fleet[1]
+
+        def _fleet_idle():
+            # Serve teardown + wave lease expiry are asynchronous; pins
+            # only target a node reliably once its CPU is back in the pool.
+            rws = {r["node_id"]: r for r in state_api.list_nodes()}
+            return all(
+                rws[nid]["available"].get("CPU")
+                == rws[nid]["resources"].get("CPU")
+                for nid in fleet
+            )
+
+        wait_for(_fleet_idle, "the fleet to go idle before pinning", 30)
+
+        def _pin(nid, tag):
+            # SERIAL submissions: the target has 1 CPU, and soft affinity
+            # spills a busy node's overflow elsewhere — one in flight at
+            # a time keeps every pin (and its lease reuse) on the target.
+            strat = NodeAffinitySchedulingStrategy(nid, soft=True)
+            refs = []
+            for i in range(PINS):
+                r = produce.options(scheduling_strategy=strat).remote(
+                    i, tag, log_path
+                )
+                ready, _ = ray_tpu.wait(
+                    [r], timeout=60,
+                    fetch_local=False,  # a driver fetch breaks sole-copy-ness
+                )
+                assert ready, f"pin {tag}:{i} did not finish"
+                refs.append(r)
+            return refs
+
+        pin_a = _pin(node_a, "pinA")
+        pin_b = _pin(node_b, "pinB")
+        rows = {r["node_id"]: r for r in state_api.list_nodes()}
+        for nid in (node_a, node_b):
+            assert rows[nid]["store_bytes"] >= PINS * ARR * 8, (
+                f"pins did not land on {nid}: {rows[nid]}"
+            )
+        _note_pids()
+
+        # ---- phase 4: drain A, SIGKILL its daemon mid-evacuation.  The
+        # drain must fall back to the DEATH path: lineage re-derives A's
+        # sole copies on the survivors.
+        pid_a = rows[node_a]["daemon_pid"]
+        assert pid_a, f"no daemon pid for {node_a}"
+        assert _req("node_drain", node_a) is True
+        wait_for(
+            lambda: lifecycle().get(node_a, {}).get("state")
+            in ("DRAINING", "DEPARTED"),
+            "A's drain to journal", 10,
+        )
+        time.sleep(0.7)  # quiesce beat + first delayed evacuation pulls
+        note(f"SIGKILL {node_a} daemon mid-evacuation")
+        os.kill(pid_a, signal.SIGKILL)
+        report["kills"]["daemon"] += 1
+        wait_for(
+            lambda: lifecycle().get(node_a, {}).get("state") == "DEPARTED",
+            "A to close DEPARTED via the death path", 30,
+        )
+        rec_a = lifecycle()[node_a]
+        assert rec_a.get("reason") == "died", rec_a
+        out_a = ray_tpu.get(pin_a, timeout=120)
+        for i, arr in enumerate(out_a):
+            assert arr.shape == (ARR,) and int(arr[0]) == i, (
+                f"pinA[{i}] wrong after mid-evacuation kill"
+            )
+        report["pin_a_exec_counts"] = _counts("produce:pinA")
+        note("A's results re-derived via lineage after the kill")
+        del pin_a, out_a
+
+        # ---- phase 5: drain B, SIGKILL the HEAD mid-drain.  The
+        # relaunched head must replay every journaled transition and the
+        # resumed reconciler must finish B's evacuation losslessly.
+        pre = lifecycle()
+        assert pre, "lifecycle table empty before the bounce"
+        assert _req("node_drain", node_b) is True
+        wait_for(
+            lambda: lifecycle().get(node_b, {}).get("state") == "DRAINING",
+            "B's drain to journal", 10,
+        )
+        time.sleep(0.6)  # land inside B's delayed evacuation loop
+        note("SIGKILL head mid-drain (bounce mid-reconcile)")
+        head.kill()
+        try:
+            head.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+        report["kills"]["head"] += 1
+        head, _ = launch_head_subprocess(workdir, num_cpus=2, session=session)
+        note("head relaunched; waiting for lifecycle replay")
+        wait_for(
+            lambda: lifecycle().get(node_b, {}).get("state")
+            in ("DRAINING", "DEPARTED"),
+            "the restored lifecycle table", 60,
+        )
+        post = lifecycle()
+        for nid, rec in pre.items():
+            assert nid in post, f"journaled node {nid} lost in the bounce"
+            assert RANK[post[nid]["state"]] >= RANK[rec["state"]], (
+                f"{nid} regressed across the bounce: "
+                f"{rec['state']} -> {post[nid]['state']}"
+            )
+            if rec.get("src"):
+                assert post[nid].get("src") == rec["src"], (nid, post[nid])
+        assert post[node_a].get("reason") == "died", post[node_a]
+        report["lifecycle_replayed"] = {
+            nid: post[nid]["state"] for nid in sorted(pre)
+        }
+        t_b = wait_for(
+            lambda: lifecycle().get(node_b, {}).get("state") == "DEPARTED",
+            "the resumed reconciler to finish B's drain", 60,
+        )
+        rec_b = lifecycle()[node_b]
+        assert rec_b.get("reason") == "removed", (
+            f"B's drain did not finish cleanly: {rec_b}"
+        )
+        note(f"B drained clean by the post-bounce reconciler (t={t_b:.1f}s)")
+        # The evacuation ledger on the NEW head: B's final pass must
+        # report remaining=0 (zero lost sole-copy bytes) and have moved
+        # at least one object post-bounce.
+        evs = [
+            e
+            for e in state_api.list_cluster_events(
+                limit=200, source="autoscale"
+            )
+            if e.get("message") == "node evacuation"
+            and e.get("node_id") == node_b
+        ]
+        assert evs, "no evacuation ledger events for B on the new head"
+        assert evs[-1].get("remaining") == 0, f"lost bytes on B: {evs[-1]}"
+        moved = sum(e.get("moved", 0) for e in evs)
+        assert moved >= 1, f"nothing evacuated post-bounce: {evs}"
+        report["evacuation"] = {
+            "events": len(evs),
+            "moved": moved,
+            "moved_bytes": sum(e.get("moved_bytes", 0) for e in evs),
+            "failed": sum(e.get("failed", 0) for e in evs),
+        }
+        # Zero lost bytes, PROVEN: B's results come back correct and its
+        # producers ran exactly ONCE — the bytes moved, nothing re-ran.
+        out_b = ray_tpu.get(pin_b, timeout=120)
+        for i, arr in enumerate(out_b):
+            assert arr.shape == (ARR,) and int(arr[0]) == i, (
+                f"pinB[{i}] wrong after the drained depart"
+            )
+        cb = _counts("produce:pinB")
+        assert len(cb) == PINS and all(v == 1 for v in cb.values()), (
+            f"B's producers re-ran — evacuation lost bytes: {cb}"
+        )
+        report["pin_b_exec_counts"] = cb
+        note("B's sole copies survived: values intact, zero re-executions")
+        del pin_b, out_b
+
+        # ---- phase 6: wind-down.  With demand gone the reconciler
+        # idle-drains the surplus back to the floor on its own.
+        t_down = wait_for(
+            lambda: len(
+                managed("REQUESTED", "STARTING", "ACTIVE", "DRAINING")
+            ) <= 1,
+            "the fleet to drain back to the floor", 120,
+        )
+        assert len(managed("ACTIVE")) == 1
+        note(f"fleet back at the floor (t={t_down:.1f}s)")
+        report["timeline"] = {
+            "floor_at_s": round(t_floor, 2),
+            "max_fleet_at_s": round(t_max, 2),
+            "b_drained_at_s": round(t_b, 2),
+            "floor_again_at_s": round(t_down, 2),
+        }
+
+        # ---- the stage histogram made it to the pushed-metrics plane.
+        def _hist_count():
+            agg = state_api.telemetry_summary()["aggregate"]
+            return sum(
+                v for k, v in agg.items()
+                if k.startswith("autoscale_seconds_count")
+            )
+
+        wait_for(
+            lambda: _hist_count() >= 1,
+            "autoscale_seconds samples on the metrics plane", 30,
+        )
+        report["autoscale_seconds_samples"] = _hist_count()
+
+        # ---- the object ledger converges after both kills.
+        mem = None
+        mem_deadline = time.monotonic() + 90
+        while time.monotonic() < mem_deadline:
+            try:
+                mem = state_api.memory_summary(top=0)
+            except Exception:
+                time.sleep(1.0)
+                continue
+            if mem["leak_suspects"] == 0:
+                break
+            time.sleep(1.0)
+        report["memory"] = {
+            "leak_suspects": mem["leak_suspects"] if mem else None,
+            "objects": mem["objects"] if mem else None,
+        }
+        assert mem is not None and mem["leak_suspects"] == 0, (
+            f"object ledger did not converge after the chaos: {mem}"
+        )
+
+        # ---- every lifecycle state the soak produced is a known state.
+        final = lifecycle()
+        bad = {
+            nid: rec for nid, rec in final.items()
+            if rec.get("state") not in RANK
+        }
+        assert not bad, f"unknown lifecycle states: {bad}"
+        report["final_lifecycle"] = {
+            nid: {"state": rec["state"], "reason": rec.get("reason")}
+            for nid, rec in sorted(final.items())
+        }
+
+        if watch_locks:
+            wd = lock_watchdog.collect_dir_reports(watchdog_dir)
+            wd.extend(f"driver: {r}" for r in lock_watchdog.reports())
+            report["lock_watchdog"]["reports"] = wd
+            assert not wd, f"lock watchdog reports under autoscale: {wd}"
+        report["result"] = "PASS"
+        return report
+    except BaseException:
+        print(
+            "\n=== ELASTIC-AUTOSCALE SOAK FAILED — replay with:\n"
+            f"    python scripts/chaos_soak.py --autoscale --seed {seed}\n"
+            f"    (session dir kept at {workdir})",
+            file=sys.stderr,
+            flush=True,
+        )
+        raise
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        if head is not None and head.poll() is None:
+            head.terminate()
+            try:
+                head.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                head.kill()
+        # Autoscaled daemons are children of (possibly SIGKILLed) head
+        # incarnations — reap any stragglers so the box stays clean.
+        for nid, pid in daemon_pids.items():
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        if watch_locks:
+            lock_watchdog._enable_for_tests(
+                os.environ.get("RAY_TPU_LOCK_WATCHDOG") == "1"
+            )
+        if out and report.get("result"):
+            with open(out, "w") as f:
+                json.dump(report, f, indent=1, sort_keys=True)
+                f.write("\n")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--duration", type=float, default=75.0)
@@ -1385,7 +1871,20 @@ def main(argv=None):
         help="run the elastic SPMD gang re-mesh scenario instead "
              "(report: CHAOS_r11.json)",
     )
+    ap.add_argument(
+        "--autoscale", action="store_true",
+        help="run the elastic-capacity autoscaler scenario instead "
+             "(report: CHAOS_r12.json)",
+    )
     args = ap.parse_args(argv)
+    if args.autoscale:
+        report = run_autoscale_soak(
+            seed=args.seed if args.seed != 7 else 12,
+            out=args.out or "CHAOS_r12.json",
+            watch_locks=not args.no_lock_watchdog,
+        )
+        print(json.dumps(report, indent=1, sort_keys=True))
+        return 0
     if args.trainer:
         report = run_trainer_soak(
             seed=args.seed if args.seed != 7 else 11,

@@ -26,11 +26,31 @@ os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 # "caller holds self.lock" internal verifies ownership at entry.
 os.environ.setdefault("RAY_TPU_DEBUG_LOCKS", "1")
 
+import time
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
 
 import pytest
+
+
+def wait_for_resource_release(resource, target, timeout_s=10.0):
+    """Poll available_resources()[resource] until it returns to `target`
+    (lease reuse holds reservations across same-shape tasks; the pool
+    only refills once the lease idles out or is demand-revoked).  Shared
+    by the autoscaler test files — returns the last observed value so
+    callers can assert on it."""
+    import ray_tpu
+
+    deadline = time.monotonic() + timeout_s
+    avail = None
+    while time.monotonic() < deadline:
+        avail = ray_tpu.available_resources().get(resource)
+        if avail == target:
+            break
+        time.sleep(0.2)
+    return avail
 
 
 def pytest_configure(config):

@@ -1,6 +1,13 @@
 """Autoscaler + job submission + TPU resource tests (reference intents:
 python/ray/tests/test_autoscaler.py with mock providers,
 test_autoscaler_fake_multinode.py, dashboard job tests).
+
+Naming note: this file exercises the PUBLIC `ray_tpu.autoscaler` package
+(StandardAutoscaler driven by explicit update() calls — the user-facing
+cluster launcher surface).  The head-embedded elastic-capacity control
+loop (`ray_tpu._private.autoscaler`, its own reconcile thread + the
+loss-proof drain protocol) is covered by test_elastic_autoscaler.py —
+keep the two from growing overlapping tests.
 """
 
 import os
@@ -8,6 +15,8 @@ import sys
 import time
 
 import pytest
+
+from conftest import wait_for_resource_release
 
 import ray_tpu
 from ray_tpu.autoscaler import (
@@ -128,17 +137,9 @@ def test_tpu_resource_discovery_env():
 
         assert ray_tpu.get(on_chip.remote(), timeout=60) == "ok"
         # The full chip pool returns once the task's lease idles out
-        # (lease reuse holds the reservation across same-shape tasks;
-        # another shape would reclaim it immediately via demand
+        # (another shape would reclaim it immediately via demand
         # revocation — RAY_TPU_LEASE_IDLE_S is only the IDLE bound).
-        deadline = time.monotonic() + 10
-        avail = None
-        while time.monotonic() < deadline:
-            avail = ray_tpu.available_resources().get("TPU")
-            if avail == 4.0:
-                break
-            time.sleep(0.2)
-        assert avail == 4.0
+        assert wait_for_resource_release("TPU", 4.0) == 4.0
     finally:
         ray_tpu.shutdown()
         os.environ.pop("RAY_TPU_CHIPS", None)

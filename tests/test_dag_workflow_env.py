@@ -230,6 +230,7 @@ def test_pip_runtime_env_local_package(rt, tmp_path):
     assert ray_tpu.get(plain.remote(), timeout=60) == "isolated"
 
 
+@pytest.mark.slow  # local_package covers the pip runtime-env path fast
 def test_pip_runtime_env_bad_spec_fails_clearly(rt):
     """An uninstallable pip spec surfaces a setup error, not a hang."""
 
@@ -243,6 +244,7 @@ def test_pip_runtime_env_bad_spec_fails_clearly(rt):
         ray_tpu.get(f.remote(), timeout=180)
 
 
+@pytest.mark.slow  # same bad-spec plumbing as test_pip_runtime_env_bad_spec_fails_clearly (the tier-1 twin), via the actor path
 def test_pip_runtime_env_bad_spec_fails_actor_creation(rt):
     """A broken env on an ACTOR fails creation with the setup error
     immediately — no 3x generic creation-crash retries re-running the

@@ -57,6 +57,7 @@ def _cleanup(proc):
             proc.kill()
 
 
+@pytest.mark.slow  # 8s double-bounce; chaos soak r10 asserts the same anoninit>=2 restart, and the no-budget twin stays tier-1
 def test_anonymous_actor_restarts_after_overlapping_kill(tmp_path):
     """The overlapping-kill shape the soak was forbidden from scheduling
     before this PR: the actor's worker dies WHILE the head is down, so it

@@ -1,0 +1,376 @@
+"""Elastic capacity: the head-embedded demand-driven autoscaler
+(`ray_tpu._private.autoscaler`) and its loss-proof node drain protocol
+(ISSUE 18; ray: autoscaler/_private/autoscaler.py reconcile loop +
+DrainNode RPC semantics).
+
+Scope split vs test_autoscaler_jobs.py: that file drives the PUBLIC
+`ray_tpu.autoscaler` package (StandardAutoscaler, explicit update()
+calls); this one covers the head's own reconcile thread, the journaled
+REQUESTED -> STARTING -> ACTIVE -> DRAINING -> DEPARTED lifecycle, the
+demand summary, and drain/evacuation edge cases.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from conftest import wait_for_resource_release
+
+import ray_tpu
+from ray_tpu._private.autoscaler import Autoscaler, NodeProvider
+from ray_tpu._private.gcs import NodeInfo
+from ray_tpu._private.runtime import get_runtime
+from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+
+@pytest.fixture(autouse=True)
+def _unit_speed_budget(request):
+    """Every test here must stay a UNIT test: the reconcile interval and
+    all hysteresis windows are tuned to fractions of a second, so a test
+    crossing 5s wall clock means a knob regressed back to production
+    defaults (or a poll went unbounded) — fail loudly instead of letting
+    tier-1 absorb it."""
+    t0 = time.monotonic()
+    yield
+    dur = time.monotonic() - t0
+    assert dur < 5.0, (
+        f"{request.node.name} took {dur:.2f}s; elastic-autoscaler unit "
+        "tests must stay under 5s each"
+    )
+
+
+class InProcessProvider(NodeProvider):
+    """Registers nodes in-process (no daemon subprocess): the fastest
+    possible fleet for reconcile-logic tests.  launch() makes the node
+    alive immediately; the reconciler's own alive-check flips ACTIVE."""
+
+    def __init__(self, rt, num_cpus=2.0):
+        self.rt = rt
+        self.num_cpus = num_cpus
+        self.launched = []
+        self.terminated = []
+
+    def launch(self, node_id):
+        self.launched.append(node_id)
+        res = {"CPU": float(self.num_cpus)}
+        self.rt.state.register_node(NodeInfo(node_id, dict(res), dict(res)))
+        with self.rt.lock:
+            self.rt._dispatch()
+
+    def terminate(self, node_id):
+        self.terminated.append(node_id)
+
+    def is_running(self, node_id):
+        return node_id in self.launched and node_id not in self.terminated
+
+
+def _attach(rt, provider, **knobs):
+    """Build an autoscaler with test-speed windows and start it."""
+    a = Autoscaler(rt, provider=provider)
+    a.interval_s = knobs.get("interval_s", 0.05)
+    a.up_wait_s = knobs.get("up_wait_s", 0.1)
+    a.idle_s = knobs.get("idle_s", 0.3)
+    a.min_nodes = knobs.get("min_nodes", 0)
+    a.max_nodes = knobs.get("max_nodes", 2)
+    a.launch_timeout_s = knobs.get("launch_timeout_s", 5.0)
+    a.drain_timeout_s = knobs.get("drain_timeout_s", 2.0)
+    rt._autoscaler = a
+    rt.allow_pending_infeasible = True
+    a.start()
+    return a
+
+
+def _lifecycle(rt):
+    with rt.lock:
+        return {nid: dict(rec) for nid, rec in rt.node_lifecycle.items()}
+
+
+def _wait_for(cond, what, timeout_s=4.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        got = cond()
+        if got:
+            return got
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_demand_summary_buckets_and_gauges():
+    """Queued work shows up as SchedulingKey buckets with wait-age, the
+    serve kv row folds in, and the head telemetry gauges mirror it."""
+    ray_tpu.init(num_cpus=1, ignore_reinit_error=True)
+    rt = get_runtime()
+    try:
+
+        @ray_tpu.remote
+        def hold(sec):
+            time.sleep(sec)
+            return 1
+
+        refs = [hold.remote(0.8) for _ in range(3)]  # 1 runs, 2 queue
+        ds = _wait_for(
+            lambda: (d := rt.demand_summary())["queued_tasks"] >= 2 and d,
+            "queued demand",
+        )
+        assert ds["task_buckets"], ds
+        b = ds["task_buckets"][0]
+        assert b["count"] >= 2 and b["resources"].get("CPU") == 1.0
+        assert ds["max_wait_s"] >= 0.0
+        # Serve replica targets ride the kv plane (controller publishes).
+        rt.state.kv_put(
+            "replica_targets",
+            json.dumps({"d": {"target": 3, "live": 1}}).encode(),
+            "serve",
+        )
+        ds2 = rt.demand_summary()
+        assert ds2["serve_targets"] == {"d": {"target": 3, "live": 1}}
+        gauges = rt.head_telemetry_snapshot()["internal"]
+        assert gauges["autoscale_demand_tasks"] >= 2
+        assert gauges["autoscale_demand_buckets"] >= 1
+        assert ray_tpu.get(refs, timeout=30) == [1, 1, 1]
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_scale_up_then_idle_drain_down():
+    """The full reconcile arc on an in-process fleet: parked infeasible
+    demand launches a node (REQUESTED->STARTING->ACTIVE journaled), the
+    cap holds, and once idle the node drains and departs back to the
+    floor."""
+    ray_tpu.init(num_cpus=1, ignore_reinit_error=True)
+    rt = get_runtime()
+    try:
+        provider = InProcessProvider(rt, num_cpus=2.0)
+        _attach(rt, provider, max_nodes=2, idle_s=0.2)
+
+        @ray_tpu.remote(num_cpus=2)
+        def heavy(i):
+            return i * 10
+
+        refs = [heavy.remote(i) for i in range(4)]  # head (1 CPU) can't
+        assert ray_tpu.get(refs, timeout=20) == [0, 10, 20, 30]
+        assert provider.launched, "demand never launched a node"
+        assert len(provider.launched) <= 2, "max_nodes cap breached"
+        lc = _lifecycle(rt)
+        nid = provider.launched[0]
+        assert lc[nid]["src"] == "autoscaler"
+        # Idle hysteresis reclaims the fleet: every launched node departs.
+        _wait_for(
+            lambda: all(
+                _lifecycle(rt).get(n, {}).get("state") == "DEPARTED"
+                for n in provider.launched
+            ),
+            "idle nodes to drain + depart",
+        )
+        assert _lifecycle(rt)[nid]["reason"] == "removed"
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_floor_launch_and_launch_failure():
+    """min_nodes launches with zero demand; a provider whose launch()
+    throws journals DEPARTED(launch-failed) instead of wedging the
+    reconcile loop."""
+    ray_tpu.init(num_cpus=1, ignore_reinit_error=True)
+    rt = get_runtime()
+    try:
+        provider = InProcessProvider(rt)
+        _attach(rt, provider, min_nodes=2, max_nodes=3, idle_s=60.0)
+        _wait_for(
+            lambda: sum(
+                1
+                for r in _lifecycle(rt).values()
+                if r.get("state") == "ACTIVE" and r.get("src") == "autoscaler"
+            )
+            >= 2,
+            "floor launches",
+        )
+        assert len(provider.launched) == 2  # floors exactly, no stampede
+
+        class Broken(NodeProvider):
+            def launch(self, node_id):
+                raise RuntimeError("cloud says no")
+
+        rt2_scaler = rt._autoscaler
+        rt2_scaler.stop()
+        broken = Autoscaler(rt, provider=Broken())
+        broken._launch_one("demand")
+        lc = _lifecycle(rt)
+        failed = [
+            r for r in lc.values() if r.get("reason") == "launch-failed"
+        ]
+        assert failed and failed[0]["state"] == "DEPARTED"
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_drain_protocol_evacuates_sole_copies(tmp_path):
+    """The loss-proof core: a DRAINING node's sole-copy objects move to
+    the head store (ledger-verified: zero lost bytes) BEFORE the daemon
+    departs, and the consumer reads the bytes without re-executing the
+    producer."""
+    marker = tmp_path / "runs.log"
+    ray_tpu.init(num_cpus=1, ignore_reinit_error=True)
+    rt = get_runtime()
+    try:
+        nid = rt.add_daemon_node(num_cpus=2)
+
+        @ray_tpu.remote(max_retries=2)
+        def produce(path):
+            with open(path, "a") as f:
+                f.write("run\n")
+            return np.full((1 << 15,), 7, dtype=np.int64)  # 256 KiB
+
+        ref = produce.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(nid, soft=True)
+        ).remote(str(marker))
+        _wait_for(
+            lambda: rt.object_locations.get(ref.id) == {nid},
+            "sole copy sealed on the doomed node",
+        )
+        assert rt.sole_copy_objects(nid) == [ref.id]
+
+        assert rt.start_node_drain(nid)
+        assert rt.start_node_drain(nid)  # idempotent
+        assert _lifecycle(rt)[nid]["state"] == "DRAINING"
+        ledger = rt.evacuate_node_objects(nid)
+        assert ledger["moved"] == 1 and ledger["failed"] == 0
+        assert ledger["moved_bytes"] >= (1 << 15) * 8
+        assert ledger["remaining"] == 0, "bytes left behind at depart"
+        assert rt.store.has_local(ref.id)
+        rt.depart_node(nid)
+        assert _lifecycle(rt)[nid]["state"] == "DEPARTED"
+        out = ray_tpu.get(ref, timeout=20)
+        assert int(out[0]) == 7 and out.shape == (1 << 15,)
+        assert marker.read_text().count("run") == 1, (
+            "producer re-executed: evacuation lost the sole copy"
+        )
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_draining_node_rejects_new_leases_and_redrives(tmp_path):
+    """DRAINING = unschedulable: idle leases on the node are revoked with
+    cause=drain and a late same-key task re-drives onto a surviving node
+    instead of landing on the draining one."""
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    rt = get_runtime()
+    try:
+        nid = rt.add_daemon_node(num_cpus=2)
+
+        @ray_tpu.remote(num_cpus=2)
+        def where():
+            return os.environ.get("RAY_TPU_NODE_ID", "head")
+
+        # Establish a warm lease ON the doomed node (head's 2 CPUs are
+        # blocked by a sibling task so the second must take the node).
+        blocked = where.remote()
+        on_node = where.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(nid, soft=False)
+        ).remote()
+        assert ray_tpu.get(on_node, timeout=20) == nid
+        ray_tpu.get(blocked, timeout=20)
+
+        assert rt.start_node_drain(nid)
+        with rt.lock:
+            live_on_node = [
+                le
+                for pool in rt.task_leases.values()
+                for le in pool
+                if le.node_id == nid
+            ]
+        assert not live_on_node, "drain left idle leases on the node"
+
+        # Same key again: must re-drive off the draining node.
+        landed = ray_tpu.get(where.remote(), timeout=20)
+        assert landed != nid, "new lease granted on a DRAINING node"
+        rt.depart_node(nid)
+        # Drain-revocation returns the reservations: the head's own pool
+        # refills once the departed node's leases are gone.
+        assert wait_for_resource_release("CPU", 2.0) == 2.0
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_kill_during_evacuation_falls_back_to_lineage(tmp_path):
+    """A node SIGKILLed mid-drain (before evacuation finished) takes the
+    ordinary death path: lifecycle flips DEPARTED(died) and the consumer
+    reconstructs the lost sole-copy via lineage re-execution."""
+    marker = tmp_path / "runs.log"
+    ray_tpu.init(num_cpus=1, ignore_reinit_error=True)
+    rt = get_runtime()
+    try:
+        nid = rt.add_daemon_node(num_cpus=2)
+
+        @ray_tpu.remote(max_retries=3)
+        def produce(path):
+            with open(path, "a") as f:
+                f.write("run\n")
+            return np.full((1 << 15,), 3, dtype=np.int64)
+
+        ref = produce.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(nid, soft=True)
+        ).remote(str(marker))
+        _wait_for(
+            lambda: rt.object_locations.get(ref.id) == {nid},
+            "sole copy sealed on the doomed node",
+        )
+        assert rt.start_node_drain(nid)
+        # Mid-drain crash: the daemon dies BEFORE any evacuation pull.
+        proc = rt._daemon_procs.get(nid)
+        assert proc is not None
+        proc.kill()
+        _wait_for(
+            lambda: _lifecycle(rt).get(nid, {}).get("state") == "DEPARTED",
+            "death path to claim the mid-drain node",
+        )
+        assert _lifecycle(rt)[nid]["reason"] == "died"
+        out = ray_tpu.get(ref, timeout=20)  # lineage re-executes
+        assert int(out[0]) == 3
+        assert marker.read_text().count("run") >= 2, (
+            "no lineage re-execution after mid-drain death"
+        )
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_node_lifecycle_replays_across_head_bounce(tmp_path):
+    """A mid-DRAINING node survives a head bounce DRAINING: lifecycle
+    records restore from the snapshot with post-snapshot journal entries
+    folded on top, DEPARTED stays terminal, and no head-local monotonic
+    field (drain windows, deadlines) leaks into the persisted records —
+    the restarted reconciler re-arms fresh windows."""
+    from ray_tpu._private.runtime import Runtime
+
+    snap_path = str(tmp_path / "head-snap")
+    rt = Runtime(num_cpus=1, session_name="lcbounce", snapshot_path=snap_path)
+    try:
+        with rt.lock:
+            rt._set_node_lifecycle("n-a", "REQUESTED", src="autoscaler")
+            rt._set_node_lifecycle("n-a", "STARTING", src="autoscaler")
+            rt._set_node_lifecycle("n-a", "ACTIVE")
+            rt._set_node_lifecycle("n-gone", "DEPARTED", reason="removed")
+        rt._write_snapshot()
+        # Post-snapshot transitions ride the mutation journal only.
+        with rt.lock:
+            rt._set_node_lifecycle("n-a", "DRAINING")
+            rt._set_node_lifecycle("n-b", "REQUESTED", src="autoscaler")
+        snap = rt._snapshot_storage.load(rt.session_name)
+        assert snap["node_lifecycle"]["n-a"]["state"] == "ACTIVE"
+        for rec in snap["node_lifecycle"].values():
+            assert not any("since" in k or "deadline" in k for k in rec)
+    finally:
+        rt.shutdown()
+
+    rt2 = Runtime(num_cpus=1, session_name="lcbounce", snapshot_path=snap_path)
+    try:
+        lc = {nid: dict(r) for nid, r in rt2.node_lifecycle.items()}
+        assert lc["n-a"]["state"] == "DRAINING", "journal lost the drain"
+        assert lc["n-a"]["src"] == "autoscaler"
+        assert lc["n-gone"]["state"] == "DEPARTED"
+        assert lc["n-b"]["state"] == "REQUESTED"
+    finally:
+        rt2.shutdown()

@@ -38,13 +38,22 @@ def test_param_count_matches_config():
 @pytest.mark.parametrize(
     "strategy,spec",
     [
+        # dp stays tier-1 as the fast agreement twin; the sharded
+        # strategies (10-20s of XLA CPU compile EACH, and sensitive to
+        # host-platform partitioner numerics) run via -m slow.
         ("dp", MeshSpec(data=8)),
-        ("fsdp", MeshSpec(data=2, fsdp=4)),
-        ("tp", MeshSpec(data=2, tensor=4)),
-        ("fsdp_tp", MeshSpec(data=2, fsdp=2, tensor=2)),
-        ("sp", MeshSpec(data=2, seq=4)),
-        ("pp", MeshSpec(data=4, pipeline=2)),
-        ("pp_fsdp", MeshSpec(data=2, fsdp=2, pipeline=2)),
+        pytest.param("fsdp", MeshSpec(data=2, fsdp=4),
+                     marks=pytest.mark.slow),
+        pytest.param("tp", MeshSpec(data=2, tensor=4),
+                     marks=pytest.mark.slow),
+        pytest.param("fsdp_tp", MeshSpec(data=2, fsdp=2, tensor=2),
+                     marks=pytest.mark.slow),
+        pytest.param("sp", MeshSpec(data=2, seq=4),
+                     marks=pytest.mark.slow),
+        pytest.param("pp", MeshSpec(data=4, pipeline=2),
+                     marks=pytest.mark.slow),
+        pytest.param("pp_fsdp", MeshSpec(data=2, fsdp=2, pipeline=2),
+                     marks=pytest.mark.slow),
     ],
 )
 def test_train_step_strategies_agree(strategy, spec):
@@ -105,6 +114,7 @@ def test_sp_actually_runs_ring_attention():
     assert hlo.count("all-gather") == 0, "sequence is being all-gathered"
 
 
+@pytest.mark.slow  # pp_fsdp compile cost; sharding twins stay via sp tests
 def test_pp_fsdp_params_sharded_at_rest():
     """pp_fsdp's point: params + optimizer state occupy 1/(P*F) of the
     model per device (pipeline stages x fsdp shards), not 1/P."""

@@ -36,6 +36,7 @@ def _store_files(root) -> set:
     return out
 
 
+@pytest.mark.slow  # 100MB pull is bandwidth-bound; the staggered-broadcast twin keeps the transfer plane tier-1
 def test_worker_to_worker_transfer_100mb(two_isolated_nodes):
     """A >=100MB array produced on node A is consumed on node B with no
     shared store path between them."""

@@ -283,6 +283,7 @@ def _pg_info_retry(pg_id, timeout=60.0):
     pytest.fail(f"pg_info({pg_id}) never answered after bounce: {last!r}")
 
 
+@pytest.mark.slow  # chaos soak replays the remesh journal end-to-end
 def test_remesh_journal_replay(tmp_path):
     """Head dies mid-episode: a PG removed while RESHAPING must replay as
     REMOVED (never resurrected by the restarted sweep), and a PG left

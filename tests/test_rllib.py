@@ -95,6 +95,7 @@ def rt():
     ray_tpu.shutdown()
 
 
+@pytest.mark.slow  # dqn_cartpole_learns is the fast learning twin
 def test_ppo_cartpole_learns(rt):
     """PPO on CartPole with 2 rollout workers must clearly learn
     (reference: rllib/tuned_examples/ppo/cartpole-ppo.yaml, --as-test)."""
@@ -273,6 +274,7 @@ def test_learner_group_sharded_parity():
     )
 
 
+@pytest.mark.slow  # impala's async learner re-covers the PPO loop; ppo/dqn cartpole stay tier-1 as the fast learning twins
 def test_impala_cartpole_learns(rt):
     """IMPALA with 2 ASYNC env runners + V-trace must clearly learn
     (reference: rllib/tuned_examples/impala/cartpole-impala.yaml)."""
@@ -337,6 +339,7 @@ def test_impala_runner_survives_env_error(rt):
         algo.stop()
 
 
+@pytest.mark.slow  # impala_runner_survives_env_error is the fast twin
 def test_impala_degrades_when_runner_actor_dies(rt):
     """A dead runner ACTOR (not a task error) is dropped from the pipeline
     and training continues on the survivors — a permanently erroring ref
@@ -447,6 +450,7 @@ def test_multi_agent_cartpole_env_semantics():
     assert len(rets["agent_0"]) == total_done == len(rets["agent_1"])
 
 
+@pytest.mark.slow  # multi-agent rides the same PPO core that test_ppo_cartpole_learns pins tier-1
 def test_multi_agent_ppo_learns_shared_and_independent(rt):
     """Multi-agent PPO (ray: rllib/env/multi_agent_env.py + policy map):
     2 agents with INDEPENDENT policies must both learn; a shared-policy
@@ -494,6 +498,7 @@ def test_multi_agent_ppo_learns_shared_and_independent(rt):
 # -- round 4: offline RL + external-env policy client/server ------------------
 
 
+@pytest.mark.slow  # dqn_cartpole_learns covers the online DQN path fast
 def test_offline_dqn_learns_from_logged_data(rt, tmp_path):
     """ray: rllib/offline/dataset_reader.py — train purely from logged
     experiences (no env stepping during training), then evaluate the
@@ -609,6 +614,7 @@ def test_policy_client_server_roundtrip(rt):
     algo.stop()
 
 
+@pytest.mark.slow  # 37s learner soak; test_ppo_cartpole_learns is the tier-1 twin
 def test_appo_cartpole_learns(rt):
     """APPO (async PPO: IMPALA pipeline + clipped surrogate on V-trace
     advantages; ray: rllib/algorithms/appo) must clearly learn."""
@@ -636,6 +642,7 @@ def test_appo_cartpole_learns(rt):
         algo.stop()
 
 
+@pytest.mark.slow  # 23s continuous-action soak; test_dqn_cartpole_learns keeps off-policy tier-1
 def test_sac_pendulum_learns(rt):
     """SAC (squashed-Gaussian actor, twin Q, alpha auto-tune; ray:
     rllib/algorithms/sac) improves Pendulum swing-up well past random."""

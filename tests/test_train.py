@@ -102,6 +102,7 @@ def test_spmd_two_workers_global_mesh(ray4):
     assert result.checkpoint is not None
 
 
+@pytest.mark.slow  # chaos trainer soak resumes from checkpoints end-to-end
 def test_resume_from_checkpoint(ray4):
     trainer = JaxTrainer(
         _spmd_loop,

@@ -290,6 +290,7 @@ def test_tpe_drives_tuner(tune_cluster):
     assert abs(best.metrics["config"]["x"] - 0.3) < 0.15
 
 
+@pytest.mark.slow  # pbt test is the fast population-based twin
 def test_pb2_gp_explore_within_bounds(tune_cluster):
     """PB2: exploit inherits PBT's checkpoint copy; explore picks bounded
     hyperparams via the GP-UCB model, always inside the declared bounds."""

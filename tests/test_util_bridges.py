@@ -53,6 +53,7 @@ def test_dataset_iter_torch_batches(rt):
     assert b["x"].dtype == torch.float32 and b["y"].dtype == torch.int64
 
 
+@pytest.mark.slow  # other bridge tests in this file are the fast twins
 def test_joblib_backend(rt):
     """scikit-learn's joblib parallelism over the cluster (ray:
     util/joblib register_ray): cross-validation folds run as tasks."""
